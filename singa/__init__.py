@@ -1,0 +1,25 @@
+"""`singa` compatibility alias — the frozen Python surface of
+BASELINE.json:5 ("The Python singa.model API and the sonnx ONNX importer
+run unmodified ... with a one-line device change").  All implementation
+lives in singa_tpu."""
+
+import sys as _sys
+
+import singa_tpu as _impl
+from singa_tpu import (autograd, device, graph, layer, model, opt,  # noqa: F401
+                       ops, parallel, tensor, utils)
+
+__version__ = _impl.__version__
+
+# make `import singa.tensor` style imports resolve to the impl modules
+for _name in ("device", "tensor", "autograd", "layer", "model", "opt",
+              "graph", "ops", "parallel", "utils"):
+    _sys.modules[f"singa.{_name}"] = getattr(_impl, _name)
+
+
+def __getattr__(name):
+    if name in ("sonnx", "models"):
+        mod = getattr(_impl, name)
+        _sys.modules[f"singa.{name}"] = mod
+        return mod
+    raise AttributeError(name)

@@ -1,0 +1,39 @@
+"""singa_tpu — a TPU-native distributed deep-learning training system.
+
+Scope (reference: /root/reference README.md:1-4 — "Distributed deep
+learning training system"; capability contract /root/repo/BASELINE.json:5):
+the full SINGA surface — device / tensor / autograd / layer / model /
+opt(DistOpt) / sonnx — rebuilt TPU-first on JAX/XLA/Pallas: imperative
+Python API on top, single-XLA-module compiled training steps underneath,
+collectives over ICI via mesh axes.
+
+The `singa` package alias re-exports these modules so reference user
+scripts run with only the device line changed.
+"""
+
+__version__ = "0.1.0"
+
+from . import device
+from . import tensor
+from . import autograd
+from . import layer
+from . import model
+from . import opt
+from . import graph
+from . import ops
+from . import parallel
+from . import utils
+
+__all__ = ["device", "tensor", "autograd", "layer", "model", "opt",
+           "graph", "ops", "parallel", "utils", "sonnx", "models"]
+
+
+def __getattr__(name):
+    # lazy: sonnx pulls in the onnx proto machinery, models pulls model zoo
+    if name == "sonnx":
+        from . import sonnx
+        return sonnx
+    if name == "models":
+        from . import models
+        return models
+    raise AttributeError(name)

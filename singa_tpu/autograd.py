@@ -1,0 +1,1265 @@
+"""singa_tpu.autograd — tape-based reverse-mode autodiff over XLA math.
+
+Capability parity: the reference's ``singa.autograd`` (~90 Operator
+classes with explicit forward/backward and a tape; BASELINE.json:5 "the
+Graph/Scheduler that buffers singa.autograd ops").  TPU-first design:
+
+* Every ``Operator.fwd`` is a *pure jnp function* — so an eager call runs
+  via XLA eagerly, and the same Python code traced under ``jax.jit``
+  (see singa_tpu.model graph mode) captures forward + backward + update
+  into ONE XLA HLO module, which is the north-star execution model.
+* ``backward()`` walks the creator graph in reverse topological order —
+  the tape IS the captured graph; in graph mode the tape is rebuilt per
+  trace, then frozen inside the compiled executable.
+* Hand-written backwards for the hot/simple ops; everything else uses
+  ``jax.vjp`` of the op's pure ``fwd`` — identical semantics, and XLA
+  DCEs unused residuals in eval mode.
+
+No torch anywhere; no data-dependent Python control flow inside ops.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import tensor as tensor_mod
+from .tensor import Tensor
+
+__all__ = [
+    "training", "set_training", "is_training", "Operator", "backward",
+    "grad_of", "add", "sub", "mul", "div", "neg", "pow", "abs", "exp",
+    "log", "sqrt", "rsqrt", "cast", "clip", "matmul", "einsum", "reshape",
+    "transpose", "flatten", "squeeze", "unsqueeze", "cat", "stack",
+    "split", "index", "gather", "embedding", "relu", "sigmoid", "tanh",
+    "gelu", "silu", "softplus", "leakyrelu", "elu", "softmax",
+    "log_softmax", "dropout", "reduce_sum", "reduce_mean", "reduce_max",
+    "reduce_min", "cross_entropy", "softmax_cross_entropy", "mse_loss",
+    "nll_loss", "binary_cross_entropy", "conv2d", "max_pool2d",
+    "avg_pool2d", "batchnorm", "layernorm", "rmsnorm", "linear",
+    "add_bias", "pad", "cossim", "where", "erf",
+]
+
+# global train/eval flag (reference: autograd.training)
+training: bool = False
+
+
+def set_training(flag: bool) -> None:
+    global training
+    training = bool(flag)
+
+
+def is_training() -> bool:
+    return training
+
+
+class _TrainingScope:
+    def __init__(self, flag):
+        self.flag = flag
+
+    def __enter__(self):
+        self.prev = training
+        set_training(self.flag)
+
+    def __exit__(self, *a):
+        set_training(self.prev)
+
+
+def train_mode():
+    return _TrainingScope(True)
+
+
+def eval_mode():
+    return _TrainingScope(False)
+
+
+# ---------------------------------------------------------------------------
+# Operator base
+# ---------------------------------------------------------------------------
+
+class Operator:
+    """A differentiable op: node in the captured graph.
+
+    Subclasses either
+      * define ``fwd(self, *arrays) -> array`` (pure jnp) and inherit the
+        jax.vjp-derived backward, or
+      * override ``forward``/``backward`` for a hand-written rule.
+    """
+
+    def __init__(self):
+        self.src: List[Tuple[Tensor, bool]] = []   # (input tensor, needs grad)
+        self.requires_grad = False
+        self._vjp: Optional[Callable] = None
+
+    # -- to be provided by subclasses ---------------------------------------
+    def fwd(self, *arrays):  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def forward(self, *arrays):
+        if self.requires_grad:
+            out, self._vjp = jax.vjp(self.fwd, *arrays)
+            return out
+        return self.fwd(*arrays)
+
+    def backward(self, dy):
+        return self._vjp(dy)
+
+    # -- tape machinery ------------------------------------------------------
+    def __call__(self, *inputs: Tensor):
+        arrays = []
+        for x in inputs:
+            if not isinstance(x, Tensor):
+                raise TypeError(f"{type(self).__name__} got non-Tensor input {type(x)}")
+            arrays.append(x.data)
+        self.requires_grad = training and any(x.requires_grad for x in inputs)
+        out = self.forward(*arrays)
+        if self.requires_grad:
+            self.src = [(x, x.requires_grad) for x in inputs]
+        dev = inputs[0].device if inputs else None
+        creator = self if self.requires_grad else None
+        if isinstance(out, tuple):
+            return tuple(Tensor(data=o, device=dev, requires_grad=self.requires_grad,
+                                creator=creator) for o in out)
+        return Tensor(data=out, device=dev, requires_grad=self.requires_grad,
+                      creator=creator)
+
+
+def _unbroadcast(g, shape):
+    """Reduce gradient ``g`` back to ``shape`` after numpy broadcasting."""
+    if tuple(g.shape) == tuple(shape):
+        return g
+    # sum leading broadcast dims
+    extra = g.ndim - len(shape)
+    if extra > 0:
+        g = jnp.sum(g, axis=tuple(range(extra)))
+    # sum dims that were 1
+    axes = tuple(i for i, s in enumerate(shape) if s == 1 and g.shape[i] != 1)
+    if axes:
+        g = jnp.sum(g, axis=axes, keepdims=True)
+    return g
+
+
+# ---------------------------------------------------------------------------
+# reverse pass
+# ---------------------------------------------------------------------------
+
+def backward(y: Tensor, dy: Optional[Any] = None):
+    """Reverse-topological walk of the creator graph from ``y``.
+
+    Returns a list of (param_tensor, grad_tensor) for every reachable leaf
+    with ``stores_grad=True``; also sets ``leaf.grad``.  Mirrors the
+    reference's ``autograd.backward`` contract.
+    """
+    if y.creator is None:
+        return []
+    if dy is None:
+        dy = jnp.ones_like(y.data)
+    elif isinstance(dy, Tensor):
+        dy = dy.data
+
+    # topological order over ops via DFS
+    order: List[Operator] = []
+    seen = set()
+
+    def visit(op: Operator):
+        if id(op) in seen:
+            return
+        seen.add(id(op))
+        for (t, needs) in op.src:
+            if needs and t.creator is not None:
+                visit(t.creator)
+        order.append(op)
+
+    visit(y.creator)
+
+    # accumulate output-grads per tensor id
+    grads: Dict[int, Any] = {id(y): dy}
+    tensors: Dict[int, Tensor] = {id(y): y}
+    # map op -> its output tensor ids handled implicitly: each Tensor holds
+    # its creator, so walk ops in reverse and pull grads of their outputs.
+    # We track output grads keyed by tensor identity.
+    out_of: Dict[int, List[Tensor]] = {}
+    for op in order:
+        for (t, needs) in op.src:
+            tensors[id(t)] = t
+
+    results = []
+    for op in reversed(order):
+        # gather grad(s) of this op's output(s)
+        g_out = _collect_op_output_grad(op, grads)
+        if g_out is None:
+            continue
+        gs = op.backward(g_out)
+        if not isinstance(gs, (tuple, list)):
+            gs = (gs,)
+        for (t, needs), g in zip(op.src, gs):
+            if not needs or g is None:
+                continue
+            tid = id(t)
+            if tid in grads:
+                grads[tid] = grads[tid] + g
+            else:
+                grads[tid] = g
+
+    for tid, t in tensors.items():
+        if t.stores_grad and tid in grads:
+            gt = Tensor(data=grads[tid], device=t.device, requires_grad=False)
+            t.grad = gt
+            results.append((t, gt))
+    return results
+
+
+def _collect_op_output_grad(op: Operator, grads: Dict[int, Any]):
+    # Tensors referencing this op as creator are its outputs; we stored the
+    # grads keyed by the tensor id, which we find via the _outputs hook set
+    # below. For single-output ops (the overwhelming majority) the output
+    # tensor registered its id at creation time via grads lookup by the
+    # caller; to keep this O(1) we stash output ids on the op.
+    ids = getattr(op, "_out_ids", None)
+    if ids is None:
+        return None
+    gs = [grads.get(i) for i in ids]
+    if all(g is None for g in gs):
+        return None
+    # multi-output: missing grads become zeros of recorded shape
+    if len(gs) == 1:
+        return gs[0]
+    shapes = op._out_shapes
+    dtypes = op._out_dtypes
+    return tuple(g if g is not None else jnp.zeros(s, d)
+                 for g, s, d in zip(gs, shapes, dtypes))
+
+
+# hook output registration into Operator.__call__ (kept separate for clarity)
+_orig_call = Operator.__call__
+
+
+def _call_with_registration(self, *inputs):
+    out = _orig_call(self, *inputs)
+    if self.requires_grad:
+        outs = out if isinstance(out, tuple) else (out,)
+        self._out_ids = [id(o) for o in outs]
+        self._out_shapes = [o.data.shape for o in outs]
+        self._out_dtypes = [o.data.dtype for o in outs]
+        # keep outputs alive for the duration of the tape walk: ids are only
+        # valid while the tensors exist
+        self._outs_ref = outs
+    return out
+
+
+Operator.__call__ = _call_with_registration
+
+
+def grad_of(t: Tensor) -> Optional[Tensor]:
+    return t.grad
+
+
+# ---------------------------------------------------------------------------
+# elementwise arithmetic (hand-written backwards)
+# ---------------------------------------------------------------------------
+
+class Add(Operator):
+    def forward(self, a, b):
+        self._sa, self._sb = a.shape, b.shape
+        return jnp.add(a, b)
+
+    def backward(self, dy):
+        return _unbroadcast(dy, self._sa), _unbroadcast(dy, self._sb)
+
+
+class Sub(Operator):
+    def forward(self, a, b):
+        self._sa, self._sb = a.shape, b.shape
+        return jnp.subtract(a, b)
+
+    def backward(self, dy):
+        return _unbroadcast(dy, self._sa), _unbroadcast(-dy, self._sb)
+
+
+class Mul(Operator):
+    def forward(self, a, b):
+        self._a, self._b = a, b
+        return jnp.multiply(a, b)
+
+    def backward(self, dy):
+        return (_unbroadcast(dy * self._b, self._a.shape),
+                _unbroadcast(dy * self._a, self._b.shape))
+
+
+class Div(Operator):
+    def forward(self, a, b):
+        self._a, self._b = a, b
+        return jnp.divide(a, b)
+
+    def backward(self, dy):
+        ga = dy / self._b
+        gb = -dy * self._a / (self._b * self._b)
+        return _unbroadcast(ga, self._a.shape), _unbroadcast(gb, self._b.shape)
+
+
+class Neg(Operator):
+    def forward(self, a):
+        return -a
+
+    def backward(self, dy):
+        return (-dy,)
+
+
+class Pow(Operator):
+    def __init__(self, p):
+        super().__init__()
+        self.p = p
+
+    def forward(self, a):
+        self._a = a
+        return jnp.power(a, self.p)
+
+    def backward(self, dy):
+        return (dy * self.p * jnp.power(self._a, self.p - 1),)
+
+
+class Abs(Operator):
+    def forward(self, a):
+        self._a = a
+        return jnp.abs(a)
+
+    def backward(self, dy):
+        return (dy * jnp.sign(self._a),)
+
+
+class Exp(Operator):
+    def forward(self, a):
+        self._y = jnp.exp(a)
+        return self._y
+
+    def backward(self, dy):
+        return (dy * self._y,)
+
+
+class Log(Operator):
+    def forward(self, a):
+        self._a = a
+        return jnp.log(a)
+
+    def backward(self, dy):
+        return (dy / self._a,)
+
+
+class Sqrt(Operator):
+    def forward(self, a):
+        self._y = jnp.sqrt(a)
+        return self._y
+
+    def backward(self, dy):
+        return (dy * 0.5 / self._y,)
+
+
+class Rsqrt(Operator):
+    def fwd(self, a):
+        return jax.lax.rsqrt(a)
+
+
+class Cast(Operator):
+    def __init__(self, dtype):
+        super().__init__()
+        self.dtype = dtype
+
+    def forward(self, a):
+        self._from = a.dtype
+        return a.astype(self.dtype)
+
+    def backward(self, dy):
+        return (dy.astype(self._from),)
+
+
+class Clip(Operator):
+    def __init__(self, lo, hi):
+        super().__init__()
+        self.lo, self.hi = lo, hi
+
+    def forward(self, a):
+        self._mask = ((a >= self.lo) & (a <= self.hi))
+        return jnp.clip(a, self.lo, self.hi)
+
+    def backward(self, dy):
+        return (dy * self._mask.astype(dy.dtype),)
+
+
+class Erf(Operator):
+    def fwd(self, a):
+        return jax.lax.erf(a)
+
+
+def add(a, b):
+    return Add()(a, _as_t(b, a))
+
+
+def sub(a, b):
+    return Sub()(a, _as_t(b, a))
+
+
+def mul(a, b):
+    return Mul()(a, _as_t(b, a))
+
+
+def div(a, b):
+    return Div()(a, _as_t(b, a))
+
+
+def neg(a):
+    return Neg()(a)
+
+
+def pow(a, p):
+    return Pow(p)(a)
+
+
+def abs(a):
+    return Abs()(a)
+
+
+def exp(a):
+    return Exp()(a)
+
+
+def log(a):
+    return Log()(a)
+
+
+def sqrt(a):
+    return Sqrt()(a)
+
+
+def rsqrt(a):
+    return Rsqrt()(a)
+
+
+def cast(a, dtype):
+    return Cast(dtype)(a)
+
+
+def clip(a, lo, hi):
+    return Clip(lo, hi)(a)
+
+
+def erf(a):
+    return Erf()(a)
+
+
+def _as_t(x, like: Tensor) -> Tensor:
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(data=jnp.asarray(x, dtype=like.dtype), device=like.device,
+                  requires_grad=False)
+
+
+# ---------------------------------------------------------------------------
+# matmul / einsum / linear — MXU territory: keep batched, let XLA tile
+# ---------------------------------------------------------------------------
+
+class Matmul(Operator):
+    def forward(self, a, b):
+        self._a, self._b = a, b
+        return jnp.matmul(a, b)
+
+    def backward(self, dy):
+        a, b = self._a, self._b
+        ga = jnp.matmul(dy, jnp.swapaxes(b, -1, -2))
+        gb = jnp.matmul(jnp.swapaxes(a, -1, -2), dy)
+        return _unbroadcast(ga, a.shape), _unbroadcast(gb, b.shape)
+
+
+class Einsum(Operator):
+    def __init__(self, subscripts):
+        super().__init__()
+        self.subscripts = subscripts
+
+    def fwd(self, *arrays):
+        return jnp.einsum(self.subscripts, *arrays)
+
+
+class Linear(Operator):
+    """y = x @ W (+ b). Fused affine — one MXU call + bias fusion."""
+
+    def __init__(self, has_bias: bool):
+        super().__init__()
+        self.has_bias = has_bias
+
+    def forward(self, x, w, *b):
+        self._x, self._w = x, w
+        y = jnp.matmul(x, w)
+        if self.has_bias:
+            y = y + b[0]
+        return y
+
+    def backward(self, dy):
+        x, w = self._x, self._w
+        gx = jnp.matmul(dy, w.T)
+        lead = int(np.prod(x.shape[:-1]))
+        gw = jnp.matmul(x.reshape(lead, x.shape[-1]).T,
+                        dy.reshape(lead, dy.shape[-1]))
+        if self.has_bias:
+            gb = jnp.sum(dy.reshape(lead, dy.shape[-1]), axis=0)
+            return gx, gw, gb
+        return gx, gw
+
+
+def matmul(a, b):
+    return Matmul()(a, b)
+
+
+def einsum(subscripts, *ts):
+    return Einsum(subscripts)(*ts)
+
+
+def linear(x, w, b=None):
+    if b is None:
+        return Linear(False)(x, w)
+    return Linear(True)(x, w, b)
+
+
+class AddBias(Operator):
+    def __init__(self, axis=1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x, b):
+        shape = [1] * x.ndim
+        shape[self.axis] = b.shape[0]
+        self._xnd = x.ndim
+        return x + b.reshape(shape)
+
+    def backward(self, dy):
+        axes = tuple(i for i in range(self._xnd) if i != self.axis)
+        return dy, jnp.sum(dy, axis=axes)
+
+
+def add_bias(x, b, axis=1):
+    return AddBias(axis)(x, b)
+
+
+# ---------------------------------------------------------------------------
+# shape ops
+# ---------------------------------------------------------------------------
+
+class Reshape(Operator):
+    def __init__(self, shape):
+        super().__init__()
+        self.shape = tuple(shape)
+
+    def forward(self, a):
+        self._orig = a.shape
+        return a.reshape(self.shape)
+
+    def backward(self, dy):
+        return (dy.reshape(self._orig),)
+
+
+class Transpose(Operator):
+    def __init__(self, axes=None):
+        super().__init__()
+        self.axes = tuple(axes) if axes is not None else None
+
+    def forward(self, a):
+        if self.axes is None:
+            self._inv = None
+            return a.T
+        self._inv = tuple(np.argsort(self.axes))
+        return jnp.transpose(a, self.axes)
+
+    def backward(self, dy):
+        if self._inv is None:
+            return (dy.T,)
+        return (jnp.transpose(dy, self._inv),)
+
+
+class Flatten(Operator):
+    def __init__(self, start_axis=0):
+        super().__init__()
+        self.start_axis = start_axis
+
+    def forward(self, a):
+        self._orig = a.shape
+        s = self.start_axis
+        lead = a.shape[:s]
+        return a.reshape(lead + (-1,))
+
+    def backward(self, dy):
+        return (dy.reshape(self._orig),)
+
+
+class Squeeze(Operator):
+    def __init__(self, axis=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, a):
+        self._orig = a.shape
+        return jnp.squeeze(a, self.axis)
+
+    def backward(self, dy):
+        return (dy.reshape(self._orig),)
+
+
+class Unsqueeze(Operator):
+    def __init__(self, axis):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, a):
+        self._orig = a.shape
+        ax = self.axis if isinstance(self.axis, (list, tuple)) else [self.axis]
+        out = a
+        for x in sorted(ax):
+            out = jnp.expand_dims(out, x)
+        return out
+
+    def backward(self, dy):
+        return (dy.reshape(self._orig),)
+
+
+class Cat(Operator):
+    def __init__(self, axis):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, *arrays):
+        self._sizes = [a.shape[self.axis] for a in arrays]
+        return jnp.concatenate(arrays, axis=self.axis)
+
+    def backward(self, dy):
+        splits = np.cumsum(self._sizes)[:-1].tolist()
+        return tuple(jnp.split(dy, splits, axis=self.axis))
+
+
+class Stack(Operator):
+    def __init__(self, axis):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, *arrays):
+        return jnp.stack(arrays, axis=self.axis)
+
+    def backward(self, dy):
+        parts = jnp.split(dy, dy.shape[self.axis], axis=self.axis)
+        return tuple(jnp.squeeze(p, self.axis) for p in parts)
+
+
+class Split(Operator):
+    def __init__(self, parts, axis):
+        super().__init__()
+        self.parts, self.axis = parts, axis
+
+    def forward(self, a):
+        if isinstance(self.parts, int):
+            return tuple(jnp.split(a, self.parts, axis=self.axis))
+        splits = np.cumsum(self.parts)[:-1].tolist()
+        return tuple(jnp.split(a, splits, axis=self.axis))
+
+    def backward(self, dys):
+        return (jnp.concatenate(list(dys), axis=self.axis),)
+
+
+class Index(Operator):
+    def __init__(self, idx):
+        super().__init__()
+        self.idx = idx
+
+    def forward(self, a):
+        self._shape, self._dtype = a.shape, a.dtype
+        return a[self.idx]
+
+    def backward(self, dy):
+        z = jnp.zeros(self._shape, self._dtype)
+        return (z.at[self.idx].add(dy),)
+
+
+class Gather(Operator):
+    def __init__(self, axis, indices):
+        super().__init__()
+        self.axis = axis
+        self.indices = jnp.asarray(indices)
+
+    def forward(self, a):
+        self._shape, self._dtype = a.shape, a.dtype
+        return jnp.take(a, self.indices, axis=self.axis)
+
+    def backward(self, dy):
+        z = jnp.zeros(self._shape, self._dtype)
+        idx = [slice(None)] * len(self._shape)
+        idx[self.axis] = self.indices
+        return (z.at[tuple(idx)].add(dy),)
+
+
+class Embedding(Operator):
+    """Row lookup: out[i] = table[ids[i]]. ids are int, non-differentiable."""
+
+    def forward(self, table, ids):
+        self._n, self._d = table.shape
+        self._ids = ids
+        self._dtype = table.dtype
+        return jnp.take(table, ids, axis=0)
+
+    def backward(self, dy):
+        z = jnp.zeros((self._n, self._d), self._dtype)
+        return (z.at[self._ids].add(dy), None)
+
+
+class Pad(Operator):
+    def __init__(self, pad_width, value=0.0):
+        super().__init__()
+        self.pad_width = pad_width
+        self.value = value
+
+    def forward(self, a):
+        self._orig = a.shape
+        return jnp.pad(a, self.pad_width, constant_values=self.value)
+
+    def backward(self, dy):
+        slices = tuple(slice(p[0], p[0] + s)
+                       for p, s in zip(self.pad_width, self._orig))
+        return (dy[slices],)
+
+
+def reshape(a, shape):
+    return Reshape(shape)(a)
+
+
+def transpose(a, axes=None):
+    return Transpose(axes)(a)
+
+
+def flatten(a, start_axis=0):
+    return Flatten(start_axis)(a)
+
+
+def squeeze(a, axis=None):
+    return Squeeze(axis)(a)
+
+
+def unsqueeze(a, axis):
+    return Unsqueeze(axis)(a)
+
+
+def cat(ts, axis=0):
+    return Cat(axis)(*ts)
+
+
+def stack(ts, axis=0):
+    return Stack(axis)(*ts)
+
+
+def split(a, parts, axis=0):
+    return Split(parts, axis)(a)
+
+
+def index(a, idx):
+    return Index(idx)(a)
+
+
+def gather(a, axis, indices):
+    return Gather(axis, indices)(a)
+
+
+def embedding(table, ids):
+    if not isinstance(ids, Tensor):
+        ids = Tensor(data=jnp.asarray(ids), device=table.device, requires_grad=False)
+    return Embedding()(table, ids)
+
+
+def pad(a, pad_width, value=0.0):
+    return Pad(pad_width, value)(a)
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+class ReLU(Operator):
+    def forward(self, a):
+        self._mask = a > 0
+        return jnp.where(self._mask, a, 0)
+
+    def backward(self, dy):
+        return (jnp.where(self._mask, dy, 0),)
+
+
+class Sigmoid(Operator):
+    def forward(self, a):
+        self._y = jax.nn.sigmoid(a)
+        return self._y
+
+    def backward(self, dy):
+        return (dy * self._y * (1 - self._y),)
+
+
+class Tanh(Operator):
+    def forward(self, a):
+        self._y = jnp.tanh(a)
+        return self._y
+
+    def backward(self, dy):
+        return (dy * (1 - self._y * self._y),)
+
+
+class Gelu(Operator):
+    def fwd(self, a):
+        return jax.nn.gelu(a, approximate=True)
+
+
+class SiLU(Operator):
+    def fwd(self, a):
+        return jax.nn.silu(a)
+
+
+class Softplus(Operator):
+    def fwd(self, a):
+        return jax.nn.softplus(a)
+
+
+class LeakyReLU(Operator):
+    def __init__(self, slope=0.01):
+        super().__init__()
+        self.slope = slope
+
+    def forward(self, a):
+        self._mask = a > 0
+        return jnp.where(self._mask, a, self.slope * a)
+
+    def backward(self, dy):
+        return (jnp.where(self._mask, dy, self.slope * dy),)
+
+
+class Elu(Operator):
+    def __init__(self, alpha=1.0):
+        super().__init__()
+        self.alpha = alpha
+
+    def fwd(self, a):
+        return jax.nn.elu(a, self.alpha)
+
+
+class Softmax(Operator):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, a):
+        self._y = jax.nn.softmax(a, axis=self.axis)
+        return self._y
+
+    def backward(self, dy):
+        y = self._y
+        inner = jnp.sum(dy * y, axis=self.axis, keepdims=True)
+        return (y * (dy - inner),)
+
+
+class LogSoftmax(Operator):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, a):
+        self._y = jax.nn.log_softmax(a, axis=self.axis)
+        return self._y
+
+    def backward(self, dy):
+        soft = jnp.exp(self._y)
+        return (dy - soft * jnp.sum(dy, axis=self.axis, keepdims=True),)
+
+
+class Dropout(Operator):
+    def __init__(self, p, key):
+        super().__init__()
+        self.p = p
+        self.key = key
+
+    def forward(self, a):
+        if not training or self.p <= 0.0:
+            self._mask = None
+            return a
+        keep = 1.0 - self.p
+        self._mask = jax.random.bernoulli(self.key, keep, a.shape)
+        self._scale = 1.0 / keep
+        return jnp.where(self._mask, a * self._scale, 0)
+
+    def backward(self, dy):
+        if self._mask is None:
+            return (dy,)
+        return (jnp.where(self._mask, dy * self._scale, 0),)
+
+
+class Where(Operator):
+    def __init__(self, cond):
+        super().__init__()
+        self.cond = cond
+
+    def forward(self, a, b):
+        self._sa, self._sb = a.shape, b.shape
+        return jnp.where(self.cond, a, b)
+
+    def backward(self, dy):
+        return (_unbroadcast(jnp.where(self.cond, dy, 0), self._sa),
+                _unbroadcast(jnp.where(self.cond, 0, dy), self._sb))
+
+
+def relu(a):
+    return ReLU()(a)
+
+
+def sigmoid(a):
+    return Sigmoid()(a)
+
+
+def tanh(a):
+    return Tanh()(a)
+
+
+def gelu(a):
+    return Gelu()(a)
+
+
+def silu(a):
+    return SiLU()(a)
+
+
+def softplus(a):
+    return Softplus()(a)
+
+
+def leakyrelu(a, slope=0.01):
+    return LeakyReLU(slope)(a)
+
+
+def elu(a, alpha=1.0):
+    return Elu(alpha)(a)
+
+
+def softmax(a, axis=-1):
+    return Softmax(axis)(a)
+
+
+def log_softmax(a, axis=-1):
+    return LogSoftmax(axis)(a)
+
+
+def dropout(a, p=0.5, key=None):
+    if key is None:
+        key = tensor_mod._next_key()
+    return Dropout(p, key)(a)
+
+
+def where(cond, a, b):
+    cv = cond.data if isinstance(cond, Tensor) else cond
+    return Where(cv)(a, _as_t(b, a))
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+class ReduceSum(Operator):
+    def __init__(self, axis, keepdims):
+        super().__init__()
+        self.axis, self.keepdims = axis, keepdims
+
+    def forward(self, a):
+        self._shape = a.shape
+        return jnp.sum(a, axis=self.axis, keepdims=self.keepdims)
+
+    def backward(self, dy):
+        return (_bcast_reduce_grad(dy, self._shape, self.axis, self.keepdims),)
+
+
+class ReduceMean(Operator):
+    def __init__(self, axis, keepdims):
+        super().__init__()
+        self.axis, self.keepdims = axis, keepdims
+
+    def forward(self, a):
+        self._shape = a.shape
+        n = np.prod(a.shape) if self.axis is None else np.prod(
+            [a.shape[i] for i in _norm_axes(self.axis, a.ndim)])
+        self._n = float(n)
+        return jnp.mean(a, axis=self.axis, keepdims=self.keepdims)
+
+    def backward(self, dy):
+        return (_bcast_reduce_grad(dy, self._shape, self.axis, self.keepdims) / self._n,)
+
+
+class ReduceMax(Operator):
+    def __init__(self, axis, keepdims):
+        super().__init__()
+        self.axis, self.keepdims = axis, keepdims
+
+    def fwd(self, a):
+        return jnp.max(a, axis=self.axis, keepdims=self.keepdims)
+
+
+class ReduceMin(Operator):
+    def __init__(self, axis, keepdims):
+        super().__init__()
+        self.axis, self.keepdims = axis, keepdims
+
+    def fwd(self, a):
+        return jnp.min(a, axis=self.axis, keepdims=self.keepdims)
+
+
+def _norm_axes(axis, ndim):
+    if isinstance(axis, int):
+        axis = (axis,)
+    return tuple(a % ndim for a in axis)
+
+
+def _bcast_reduce_grad(dy, shape, axis, keepdims):
+    if axis is None:
+        return jnp.broadcast_to(dy, shape)
+    if not keepdims:
+        for a in sorted(_norm_axes(axis, len(shape))):
+            dy = jnp.expand_dims(dy, a)
+    return jnp.broadcast_to(dy, shape)
+
+
+def reduce_sum(a, axis=None, keepdims=False):
+    return ReduceSum(axis, keepdims)(a)
+
+
+def reduce_mean(a, axis=None, keepdims=False):
+    return ReduceMean(axis, keepdims)(a)
+
+
+def reduce_max(a, axis=None, keepdims=False):
+    return ReduceMax(axis, keepdims)(a)
+
+
+def reduce_min(a, axis=None, keepdims=False):
+    return ReduceMin(axis, keepdims)(a)
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+class SoftmaxCrossEntropy(Operator):
+    """Fused logits->loss with the classic (p - t)/N backward.
+
+    Targets: int class ids (any leading batch dims) or one-hot/probs.
+    """
+
+    def forward(self, logits, target):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        if jnp.issubdtype(target.dtype, jnp.integer):
+            onehot = jax.nn.one_hot(target, logits.shape[-1], dtype=logits.dtype)
+        else:
+            onehot = target
+        self._p = jnp.exp(logp)
+        self._t = onehot
+        self._n = float(np.prod(logits.shape[:-1]))
+        return -jnp.sum(onehot * logp) / self._n
+
+    def backward(self, dy):
+        return (dy * (self._p - self._t) / self._n, None)
+
+
+class MSELoss(Operator):
+    def forward(self, x, t):
+        self._d = x - t
+        self._n = float(np.prod(x.shape))
+        return jnp.sum(self._d * self._d) / self._n
+
+    def backward(self, dy):
+        g = dy * 2.0 * self._d / self._n
+        return (g, -g)
+
+
+class BinaryCrossEntropy(Operator):
+    def fwd(self, p, t):
+        eps = 1e-7
+        p = jnp.clip(p, eps, 1 - eps)
+        return -jnp.mean(t * jnp.log(p) + (1 - t) * jnp.log(1 - p))
+
+
+class NLLLoss(Operator):
+    """Negative log-likelihood over log-probabilities + int targets."""
+
+    def forward(self, logp, target):
+        n = float(np.prod(target.shape))
+        onehot = jax.nn.one_hot(target, logp.shape[-1], dtype=logp.dtype)
+        self._t, self._n = onehot, n
+        return -jnp.sum(onehot * logp) / n
+
+    def backward(self, dy):
+        return (-dy * self._t / self._n, None)
+
+
+def softmax_cross_entropy(logits, target):
+    target = _as_int_or_t(target, logits)
+    return SoftmaxCrossEntropy()(logits, target)
+
+
+# the reference exposes this op pair under both names
+cross_entropy = softmax_cross_entropy
+
+
+def mse_loss(x, t):
+    return MSELoss()(x, _as_t(t, x))
+
+
+def binary_cross_entropy(p, t):
+    return BinaryCrossEntropy()(p, _as_t(t, p))
+
+
+def nll_loss(logp, target):
+    return NLLLoss()(logp, _as_int_or_t(target, logp))
+
+
+def _as_int_or_t(x, like):
+    if isinstance(x, Tensor):
+        return x
+    arr = jnp.asarray(x)
+    return Tensor(data=arr, device=like.device, requires_grad=False)
+
+
+class CosSim(Operator):
+    def fwd(self, a, b):
+        num = jnp.sum(a * b, axis=-1)
+        den = jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1)
+        return num / (den + 1e-8)
+
+
+def cossim(a, b):
+    return CosSim()(a, b)
+
+
+# ---------------------------------------------------------------------------
+# conv / pool / norm — NHWC layout (TPU-native; reference lineage is NCHW,
+# we accept NCHW at the layer level and transpose once at the edge)
+# ---------------------------------------------------------------------------
+
+class Conv2d(Operator):
+    """2-D convolution via lax.conv_general_dilated in NHWC/HWIO — the
+    layout XLA:TPU maps straight onto the MXU."""
+
+    def __init__(self, stride, padding, groups=1, dilation=1):
+        super().__init__()
+        self.stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+        self.dilation = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+        if isinstance(padding, str):
+            self.padding = padding.upper()
+        elif isinstance(padding, int):
+            self.padding = [(padding, padding), (padding, padding)]
+        else:
+            self.padding = [tuple(p) if isinstance(p, (tuple, list)) else (p, p)
+                            for p in padding]
+        self.groups = groups
+
+    def fwd(self, x, w, *b):
+        y = jax.lax.conv_general_dilated(
+            x, w, window_strides=self.stride, padding=self.padding,
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.groups,
+            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+        )
+        if b:
+            y = y + b[0]
+        return y.astype(x.dtype)
+
+
+class MaxPool2d(Operator):
+    def __init__(self, kernel, stride, padding=0):
+        super().__init__()
+        self.kernel = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
+        self.stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+        self.padding = padding
+
+    def fwd(self, x):  # NHWC
+        pads = ((0, 0), (self.padding, self.padding),
+                (self.padding, self.padding), (0, 0))
+        return jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max,
+            (1,) + self.kernel + (1,), (1,) + self.stride + (1,), pads)
+
+
+class AvgPool2d(Operator):
+    def __init__(self, kernel, stride, padding=0, count_include_pad=True):
+        super().__init__()
+        self.kernel = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
+        self.stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+        self.padding = padding
+
+    def fwd(self, x):
+        pads = ((0, 0), (self.padding, self.padding),
+                (self.padding, self.padding), (0, 0))
+        s = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add,
+            (1,) + self.kernel + (1,), (1,) + self.stride + (1,), pads)
+        return s / float(self.kernel[0] * self.kernel[1])
+
+
+class BatchNorm(Operator):
+    """Training-mode batchnorm over NHWC (reduce N,H,W). Running stats are
+    updated OUTSIDE the op (layer owns them as state) so the op stays pure.
+    """
+
+    def __init__(self, eps):
+        super().__init__()
+        self.eps = eps
+
+    def fwd(self, x, gamma, beta, mean, var):
+        inv = jax.lax.rsqrt(var + self.eps)
+        return (x - mean) * inv * gamma + beta
+
+
+class LayerNorm(Operator):
+    def __init__(self, eps=1e-5):
+        super().__init__()
+        self.eps = eps
+
+    def fwd(self, x, gamma, beta):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + self.eps) * gamma + beta
+
+
+class RMSNorm(Operator):
+    def __init__(self, eps=1e-6):
+        super().__init__()
+        self.eps = eps
+
+    def fwd(self, x, gamma):
+        # norm in f32 for stability, output in input dtype (llama-style)
+        xf = x.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        return (xf * jax.lax.rsqrt(ms + self.eps)).astype(x.dtype) * gamma
+
+
+def conv2d(x, w, b=None, stride=1, padding=0, groups=1, dilation=1):
+    op = Conv2d(stride, padding, groups, dilation)
+    if b is None:
+        return op(x, w)
+    return op(x, w, b)
+
+
+def max_pool2d(x, kernel, stride=None, padding=0):
+    return MaxPool2d(kernel, stride or kernel, padding)(x)
+
+
+def avg_pool2d(x, kernel, stride=None, padding=0):
+    return AvgPool2d(kernel, stride or kernel, padding)(x)
+
+
+def batchnorm(x, gamma, beta, mean, var, eps=1e-5):
+    return BatchNorm(eps)(x, gamma, beta, mean, var)
+
+
+def layernorm(x, gamma, beta, eps=1e-5):
+    return LayerNorm(eps)(x, gamma, beta)
+
+
+def rmsnorm(x, gamma, eps=1e-6):
+    return RMSNorm(eps)(x, gamma)

@@ -1,0 +1,119 @@
+"""Graph capture bookkeeping — the Python face of the Graph/Scheduler
+(capability parity: BASELINE.json:5 "the Graph/Scheduler that buffers
+singa.autograd ops compiles the captured computational graph into a
+single XLA HLO module").
+
+In this framework the *capture* is a jax trace of the user's imperative
+``train_one_batch`` and the *schedule* is XLA's — but we keep a real
+graph object: the closed jaxpr (op list, topological order) plus the
+lowered/compiled artifacts, so users can inspect what was captured, dump
+HLO, and get cost analysis (FLOPs → MFU accounting, BASELINE.json:5
+"≥45% MFU" target).  The native C++ scheduler (csrc/scheduler.cc) is fed
+from this same captured graph for host-side execution planning.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+__all__ = ["CapturedGraph", "reset_graph"]
+
+
+class CapturedGraph:
+    """A captured training/eval step: jaxpr + lowered + compiled handles."""
+
+    def __init__(self, name: str, jaxpr=None, lowered=None, compiled=None):
+        self.name = name
+        self.jaxpr = jaxpr
+        self.lowered = lowered
+        self.compiled = compiled
+
+    # -- introspection --------------------------------------------------------
+    @property
+    def num_ops(self) -> int:
+        if self.jaxpr is None:
+            return 0
+        return _count_eqns(self.jaxpr.jaxpr)
+
+    def op_types(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        if self.jaxpr is not None:
+            _collect_ops(self.jaxpr.jaxpr, out)
+        return out
+
+    def hlo_text(self) -> str:
+        if self.lowered is None:
+            return ""
+        return self.lowered.as_text()
+
+    def compiled_hlo(self) -> str:
+        if self.compiled is None:
+            return ""
+        try:
+            return self.compiled.as_text()
+        except Exception:
+            return ""
+
+    def cost_analysis(self) -> Dict[str, Any]:
+        """XLA cost analysis of the compiled module (flops, bytes)."""
+        if self.compiled is None:
+            return {}
+        try:
+            ca = self.compiled.cost_analysis()
+            if isinstance(ca, list):
+                ca = ca[0] if ca else {}
+            return dict(ca)
+        except Exception:
+            return {}
+
+    def flops(self) -> float:
+        return float(self.cost_analysis().get("flops", 0.0))
+
+    def memory_analysis(self) -> Dict[str, Any]:
+        if self.compiled is None:
+            return {}
+        try:
+            ma = self.compiled.memory_analysis()
+            return {k: getattr(ma, k) for k in dir(ma) if not k.startswith("_")}
+        except Exception:
+            return {}
+
+    def save_hlo(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.hlo_text())
+
+    def __repr__(self):
+        return f"<CapturedGraph {self.name}: {self.num_ops} ops>"
+
+
+def _count_eqns(jaxpr) -> int:
+    n = len(jaxpr.eqns)
+    for eq in jaxpr.eqns:
+        for sub in _sub_jaxprs(eq):
+            n += _count_eqns(sub)
+    return n
+
+
+def _collect_ops(jaxpr, out: Dict[str, int]) -> None:
+    for eq in jaxpr.eqns:
+        out[eq.primitive.name] = out.get(eq.primitive.name, 0) + 1
+        for sub in _sub_jaxprs(eq):
+            _collect_ops(sub, out)
+
+
+def _sub_jaxprs(eqn):
+    for v in eqn.params.values():
+        if hasattr(v, "jaxpr"):
+            yield v.jaxpr
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                if hasattr(x, "jaxpr"):
+                    yield x.jaxpr
+
+
+def reset_graph(device=None) -> None:
+    """Drop captured graphs so the next step re-captures (reference
+    Device.ResetGraph). Models track their own executors; this clears the
+    process-wide registry."""
+    from . import model as model_mod
+    model_mod._invalidate_all_graphs()

@@ -1,0 +1,624 @@
+"""singa_tpu.layer — the Layer zoo (capability parity: reference
+``singa.layer``; BASELINE.json:5 names the singa.model API stack whose
+layers these are).
+
+Semantics kept from the reference surface:
+  * layers initialize parameters lazily on first call (shape inference
+    from the input), so user code never spells input dims twice;
+  * ``get_params()/set_params()`` expose trainable tensors,
+    ``get_states()/set_states()`` additionally expose non-trainable
+    buffers (e.g. BatchNorm running stats);
+  * layers discover sublayers by attribute traversal, in creation order.
+
+TPU-first notes: conv/pool/norm default to NHWC (the layout XLA:TPU maps
+onto the MXU); the NCHW entry point is kept for ONNX/reference-style
+models and transposes once at the edge.  Parameters are created in f32
+and cast per-step for bf16 compute (master weights stay f32 — standard
+TPU mixed-precision recipe).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd
+from . import tensor as tensor_mod
+from .tensor import Tensor
+from .device import Device
+
+__all__ = [
+    "Layer", "Linear", "Conv2d", "SeparableConv2d", "BatchNorm2d",
+    "MaxPool2d", "AvgPool2d", "GlobalAvgPool2d", "Flatten", "ReLU",
+    "Sigmoid", "Tanh", "Gelu", "SiLU", "LeakyReLU", "Softmax", "Dropout",
+    "Embedding", "LayerNorm", "RMSNorm", "RNN", "LSTM",
+    "MultiHeadAttention", "Sequential", "CrossEntropyLoss", "MSELoss",
+]
+
+_name_counter: Dict[str, int] = {}
+
+
+def _auto_name(prefix: str) -> str:
+    n = _name_counter.get(prefix, 0)
+    _name_counter[prefix] = n + 1
+    return f"{prefix}_{n}" if n else prefix
+
+
+class Layer:
+    """Base layer: lazy init + param/state introspection."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or _auto_name(type(self).__name__.lower())
+        self._initialized = False
+        self._sublayers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._params: "OrderedDict[str, Tensor]" = OrderedDict()
+        self._states: "OrderedDict[str, Tensor]" = OrderedDict()  # non-trainable
+
+    # attribute hooks register sublayers / params in declaration order
+    def __setattr__(self, key, value):
+        if isinstance(value, Layer) and key not in ("_sublayers",):
+            self.__dict__.setdefault("_sublayers", OrderedDict())[key] = value
+        elif isinstance(value, (list, tuple)) and value and all(
+                isinstance(v, Layer) for v in value):
+            subs = self.__dict__.setdefault("_sublayers", OrderedDict())
+            for i, v in enumerate(value):
+                subs[f"{key}.{i}"] = v
+        object.__setattr__(self, key, value)
+
+    # -- to implement --------------------------------------------------------
+    def initialize(self, *xs):
+        """Create parameters from input shapes. Called once lazily."""
+
+    def forward(self, *xs):
+        raise NotImplementedError
+
+    def __call__(self, *xs):
+        if not self._initialized:
+            self.initialize(*xs)
+            self._initialized = True
+        return self.forward(*xs)
+
+    # -- param/state plumbing -------------------------------------------------
+    def register_param(self, name: str, t: Tensor) -> Tensor:
+        t.requires_grad = True
+        t.stores_grad = True
+        t.name = f"{self.name}.{name}"
+        self._params[name] = t
+        return t
+
+    def register_state(self, name: str, t: Tensor) -> Tensor:
+        t.requires_grad = False
+        t.stores_grad = False
+        t.name = f"{self.name}.{name}"
+        self._states[name] = t
+        return t
+
+    def get_params(self, prefix: str = "") -> Dict[str, Tensor]:
+        """Trainable tensors keyed by *attribute path* (e.g. "fc1.W") —
+        stable across instances/processes, so checkpoints round-trip."""
+        out = dict()
+        for n, p in self._params.items():
+            p.name = prefix + n
+            out[p.name] = p
+        for key, sub in self._sublayers.items():
+            out.update(sub.get_params(f"{prefix}{key}."))
+        return out
+
+    def set_params(self, params: Dict[str, Tensor], prefix: str = "") -> None:
+        for n, p in self._params.items():
+            full = prefix + n
+            if full in params:
+                src = params[full]
+                p.copy_from(src if isinstance(src, Tensor) else np.asarray(src))
+        for key, sub in self._sublayers.items():
+            sub.set_params(params, f"{prefix}{key}.")
+
+    def get_states(self, prefix: str = "") -> Dict[str, Tensor]:
+        out = dict(self.get_params(prefix))
+        out.update(self._get_buffers(prefix))
+        return out
+
+    def _get_buffers(self, prefix: str = "") -> Dict[str, Tensor]:
+        out = dict()
+        for n, s in self._states.items():
+            s.name = prefix + n
+            out[s.name] = s
+        for key, sub in self._sublayers.items():
+            out.update(sub._get_buffers(f"{prefix}{key}."))
+        return out
+
+    def set_states(self, states: Dict[str, Tensor], prefix: str = "") -> None:
+        self.set_params(states, prefix)
+        for n, s in self._states.items():
+            full = prefix + n
+            if full in states:
+                src = states[full]
+                s.copy_from(src if isinstance(src, Tensor) else np.asarray(src))
+        for key, sub in self._sublayers.items():
+            sub.set_states(states, f"{prefix}{key}.")
+
+    def to_device(self, dev: Device) -> "Layer":
+        for p in self._params.values():
+            p.to_device(dev)
+        for s in self._states.values():
+            s.to_device(dev)
+        for sub in self._sublayers.values():
+            sub.to_device(dev)
+        if hasattr(self, "device"):
+            self.device = dev
+        return self
+
+    def sublayers(self) -> List["Layer"]:
+        return list(self._sublayers.values())
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name}>"
+
+
+# ---------------------------------------------------------------------------
+# initializers (He / Xavier, f32 master weights)
+# ---------------------------------------------------------------------------
+
+def _he_normal(shape, fan_in, dev) -> Tensor:
+    std = math.sqrt(2.0 / max(1, fan_in))
+    t = Tensor(shape, dev, np.float32)
+    return t.gaussian(0.0, std)
+
+
+def _xavier_uniform(shape, fan_in, fan_out, dev) -> Tensor:
+    a = math.sqrt(6.0 / max(1, fan_in + fan_out))
+    t = Tensor(shape, dev, np.float32)
+    return t.uniform(-a, a)
+
+
+# ---------------------------------------------------------------------------
+# core layers
+# ---------------------------------------------------------------------------
+
+class Linear(Layer):
+    def __init__(self, out_features: int, in_features: Optional[int] = None,
+                 bias: bool = True, name=None):
+        super().__init__(name)
+        # reference also allows Linear(in, out) positional style
+        if in_features is not None and in_features > 0 and out_features > 0 \
+                and isinstance(in_features, int):
+            pass
+        self.out_features = out_features
+        self.in_features = in_features
+        self.bias = bias
+
+    def initialize(self, x: Tensor):
+        in_f = self.in_features or x.shape[-1]
+        self.in_features = in_f
+        dev = x.device
+        self.W = self.register_param(
+            "W", _xavier_uniform((in_f, self.out_features), in_f,
+                                 self.out_features, dev))
+        if self.bias:
+            self.b = self.register_param(
+                "b", Tensor((self.out_features,), dev, np.float32))
+
+    def forward(self, x: Tensor) -> Tensor:
+        w = _maybe_cast(self.W, x)
+        if self.bias:
+            return autograd.linear(x, w, _maybe_cast(self.b, x))
+        return autograd.linear(x, w)
+
+
+def _maybe_cast(p: Tensor, x: Tensor) -> Tensor:
+    """Cast f32 master param to the compute dtype of x (bf16 on TPU)."""
+    if p.dtype == x.dtype:
+        return p
+    return autograd.cast(p, x.dtype)
+
+
+class Conv2d(Layer):
+    """Conv layer; data_format 'NHWC' (TPU-native) or 'NCHW' (reference/ONNX)."""
+
+    def __init__(self, out_channels: int, kernel_size, in_channels=None,
+                 stride=1, padding=0, bias=True, groups=1, dilation=1,
+                 data_format="NHWC", name=None):
+        super().__init__(name)
+        self.out_channels = out_channels
+        self.in_channels = in_channels
+        self.kernel_size = (kernel_size, kernel_size) if isinstance(
+            kernel_size, int) else tuple(kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.use_bias = bias
+        self.groups = groups
+        self.dilation = dilation
+        self.data_format = data_format
+
+    def initialize(self, x: Tensor):
+        c_axis = -1 if self.data_format == "NHWC" else 1
+        in_c = self.in_channels or x.shape[c_axis]
+        self.in_channels = in_c
+        kh, kw = self.kernel_size
+        fan_in = in_c * kh * kw // self.groups
+        dev = x.device
+        # HWIO kernel layout (XLA native)
+        self.W = self.register_param(
+            "W", _he_normal((kh, kw, in_c // self.groups, self.out_channels),
+                            fan_in, dev))
+        if self.use_bias:
+            self.b = self.register_param(
+                "b", Tensor((self.out_channels,), dev, np.float32))
+
+    def forward(self, x: Tensor) -> Tensor:
+        if self.data_format == "NCHW":
+            x = autograd.transpose(x, (0, 2, 3, 1))
+        w = _maybe_cast(self.W, x)
+        b = _maybe_cast(self.b, x) if self.use_bias else None
+        y = autograd.conv2d(x, w, b, self.stride, self.padding,
+                            self.groups, self.dilation)
+        if self.data_format == "NCHW":
+            y = autograd.transpose(y, (0, 3, 1, 2))
+        return y
+
+
+class SeparableConv2d(Layer):
+    def __init__(self, out_channels, kernel_size, in_channels=None, stride=1,
+                 padding=0, bias=False, data_format="NHWC", name=None):
+        super().__init__(name)
+        self.depthwise = Conv2d(0, kernel_size, stride=stride, padding=padding,
+                                bias=bias, data_format=data_format)
+        self.pointwise = Conv2d(out_channels, 1, bias=bias,
+                                data_format=data_format)
+        self.data_format = data_format
+
+    def initialize(self, x: Tensor):
+        c_axis = -1 if self.data_format == "NHWC" else 1
+        in_c = x.shape[c_axis]
+        self.depthwise.out_channels = in_c
+        self.depthwise.groups = in_c
+
+    def forward(self, x: Tensor) -> Tensor:
+        return self.pointwise(self.depthwise(x))
+
+
+class BatchNorm2d(Layer):
+    """BatchNorm with running stats kept as layer *states* so the compiled
+    training step threads them functionally (SURVEY.md §7.3 item 2)."""
+
+    def __init__(self, num_features=None, momentum=0.9, eps=1e-5,
+                 data_format="NHWC", name=None):
+        super().__init__(name)
+        self.num_features = num_features
+        self.momentum = momentum
+        self.eps = eps
+        self.data_format = data_format
+
+    def initialize(self, x: Tensor):
+        c_axis = -1 if self.data_format == "NHWC" else 1
+        c = self.num_features or x.shape[c_axis]
+        self.num_features = c
+        dev = x.device
+        self.gamma = self.register_param("gamma", Tensor((c,), dev, np.float32).set_value(1.0))
+        self.beta = self.register_param("beta", Tensor((c,), dev, np.float32))
+        self.running_mean = self.register_state("running_mean", Tensor((c,), dev, np.float32))
+        self.running_var = self.register_state("running_var", Tensor((c,), dev, np.float32).set_value(1.0))
+
+    def forward(self, x: Tensor) -> Tensor:
+        nchw = self.data_format == "NCHW"
+        if nchw:
+            x = autograd.transpose(x, (0, 2, 3, 1))
+        axes = (0, 1, 2) if x.ndim == 4 else (0,)
+        if autograd.is_training():
+            xf = autograd.cast(x, np.float32) if x.dtype != np.float32 else x
+            mean = autograd.reduce_mean(xf, axes)
+            var = autograd.reduce_mean(autograd.mul(xf, xf), axes) - autograd.mul(mean, mean)
+            # running-stat update: functional rebinding, threaded out of jit
+            m = self.momentum
+            self.running_mean.data = (m * self.running_mean.data
+                                      + (1 - m) * jax.lax.stop_gradient(mean.data))
+            self.running_var.data = (m * self.running_var.data
+                                     + (1 - m) * jax.lax.stop_gradient(var.data))
+        else:
+            mean, var = self.running_mean, self.running_var
+        y = autograd.batchnorm(x, _maybe_cast(self.gamma, x),
+                               _maybe_cast(self.beta, x),
+                               _maybe_cast(mean, x), _maybe_cast(var, x),
+                               self.eps)
+        if nchw:
+            y = autograd.transpose(y, (0, 3, 1, 2))
+        return y
+
+
+class MaxPool2d(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NHWC", name=None):
+        super().__init__(name)
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        if self.data_format == "NCHW":
+            x = autograd.transpose(x, (0, 2, 3, 1))
+        y = autograd.max_pool2d(x, self.kernel_size, self.stride, self.padding)
+        if self.data_format == "NCHW":
+            y = autograd.transpose(y, (0, 3, 1, 2))
+        return y
+
+
+class AvgPool2d(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NHWC", name=None):
+        super().__init__(name)
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        if self.data_format == "NCHW":
+            x = autograd.transpose(x, (0, 2, 3, 1))
+        y = autograd.avg_pool2d(x, self.kernel_size, self.stride, self.padding)
+        if self.data_format == "NCHW":
+            y = autograd.transpose(y, (0, 3, 1, 2))
+        return y
+
+
+class GlobalAvgPool2d(Layer):
+    def __init__(self, data_format="NHWC", name=None):
+        super().__init__(name)
+        self.data_format = data_format
+
+    def forward(self, x):
+        axes = (1, 2) if self.data_format == "NHWC" else (2, 3)
+        return autograd.reduce_mean(x, axes)
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, name=None):
+        super().__init__(name)
+        self.start_axis = start_axis
+
+    def forward(self, x):
+        return autograd.flatten(x, self.start_axis)
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return autograd.relu(x)
+
+
+class Sigmoid(Layer):
+    def forward(self, x):
+        return autograd.sigmoid(x)
+
+
+class Tanh(Layer):
+    def forward(self, x):
+        return autograd.tanh(x)
+
+
+class Gelu(Layer):
+    def forward(self, x):
+        return autograd.gelu(x)
+
+
+class SiLU(Layer):
+    def forward(self, x):
+        return autograd.silu(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, slope=0.01, name=None):
+        super().__init__(name)
+        self.slope = slope
+
+    def forward(self, x):
+        return autograd.leakyrelu(x, self.slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def forward(self, x):
+        return autograd.softmax(x, self.axis)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__(name)
+        self.p = p
+
+    def forward(self, x):
+        return autograd.dropout(x, self.p)
+
+
+class Embedding(Layer):
+    def __init__(self, vocab_size, embed_dim, name=None):
+        super().__init__(name)
+        self.vocab_size, self.embed_dim = vocab_size, embed_dim
+
+    def initialize(self, ids: Tensor):
+        dev = ids.device
+        self.table = self.register_param(
+            "table", Tensor((self.vocab_size, self.embed_dim), dev,
+                            np.float32).gaussian(0.0, 0.02))
+
+    def forward(self, ids: Tensor) -> Tensor:
+        return autograd.embedding(self.table, ids)
+
+
+class LayerNorm(Layer):
+    def __init__(self, dim=None, eps=1e-5, name=None):
+        super().__init__(name)
+        self.dim, self.eps = dim, eps
+
+    def initialize(self, x: Tensor):
+        d = self.dim or x.shape[-1]
+        self.dim = d
+        dev = x.device
+        self.gamma = self.register_param("gamma", Tensor((d,), dev, np.float32).set_value(1.0))
+        self.beta = self.register_param("beta", Tensor((d,), dev, np.float32))
+
+    def forward(self, x):
+        return autograd.layernorm(x, _maybe_cast(self.gamma, x),
+                                  _maybe_cast(self.beta, x), self.eps)
+
+
+class RMSNorm(Layer):
+    def __init__(self, dim=None, eps=1e-6, name=None):
+        super().__init__(name)
+        self.dim, self.eps = dim, eps
+
+    def initialize(self, x: Tensor):
+        d = self.dim or x.shape[-1]
+        self.dim = d
+        self.gamma = self.register_param(
+            "gamma", Tensor((d,), x.device, np.float32).set_value(1.0))
+
+    def forward(self, x):
+        return autograd.rmsnorm(x, _maybe_cast(self.gamma, x), self.eps)
+
+
+# ---------------------------------------------------------------------------
+# recurrent layers — lax.scan over time (XLA-friendly control flow; no
+# Python loops in the hot path)
+# ---------------------------------------------------------------------------
+
+class _ScanRNNOp(autograd.Operator):
+    """Generic scanned RNN cell op; the cell body is a pure function so the
+    whole unrolled-in-time computation lowers to one lax.scan."""
+
+    def __init__(self, cell_fn, h0_fn):
+        super().__init__()
+        self.cell_fn = cell_fn
+        self.h0_fn = h0_fn
+
+    def fwd(self, x, *weights):
+        # x: (B, T, D) -> scan over T
+        carry0 = self.h0_fn(x)
+
+        def step(carry, xt):
+            new_carry, out = self.cell_fn(carry, xt, weights)
+            return new_carry, out
+
+        xs = jnp.swapaxes(x, 0, 1)  # (T, B, D)
+        _, ys = jax.lax.scan(step, carry0, xs)
+        return jnp.swapaxes(ys, 0, 1)  # (B, T, H)
+
+
+class RNN(Layer):
+    """Vanilla tanh RNN (reference singa.autograd RNN parity)."""
+
+    def __init__(self, hidden_size, name=None):
+        super().__init__(name)
+        self.hidden_size = hidden_size
+
+    def initialize(self, x: Tensor):
+        d, h = x.shape[-1], self.hidden_size
+        dev = x.device
+        self.Wx = self.register_param("Wx", _xavier_uniform((d, h), d, h, dev))
+        self.Wh = self.register_param("Wh", _xavier_uniform((h, h), h, h, dev))
+        self.b = self.register_param("b", Tensor((h,), dev, np.float32))
+
+    def forward(self, x: Tensor) -> Tensor:
+        h = self.hidden_size
+
+        def cell(carry, xt, weights):
+            wx, wh, b = weights
+            nh = jnp.tanh(xt @ wx + carry @ wh + b)
+            return nh, nh
+
+        def h0(xa):
+            return jnp.zeros((xa.shape[0], h), xa.dtype)
+
+        return _ScanRNNOp(cell, h0)(x, _maybe_cast(self.Wx, x),
+                                    _maybe_cast(self.Wh, x),
+                                    _maybe_cast(self.b, x))
+
+
+class LSTM(Layer):
+    def __init__(self, hidden_size, name=None):
+        super().__init__(name)
+        self.hidden_size = hidden_size
+
+    def initialize(self, x: Tensor):
+        d, h = x.shape[-1], self.hidden_size
+        dev = x.device
+        self.Wx = self.register_param("Wx", _xavier_uniform((d, 4 * h), d, 4 * h, dev))
+        self.Wh = self.register_param("Wh", _xavier_uniform((h, 4 * h), h, 4 * h, dev))
+        self.b = self.register_param("b", Tensor((4 * h,), dev, np.float32))
+
+    def forward(self, x: Tensor) -> Tensor:
+        h = self.hidden_size
+
+        def cell(carry, xt, weights):
+            wx, wh, b = weights
+            hp, cp = carry
+            z = xt @ wx + hp @ wh + b
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c = f * cp + i * g
+            nh = o * jnp.tanh(c)
+            return (nh, c), nh
+
+        def h0(xa):
+            z = jnp.zeros((xa.shape[0], h), xa.dtype)
+            return (z, z)
+
+        return _ScanRNNOp(cell, h0)(x, _maybe_cast(self.Wx, x),
+                                    _maybe_cast(self.Wh, x),
+                                    _maybe_cast(self.b, x))
+
+
+class MultiHeadAttention(Layer):
+    """Standard MHA; uses the fused attention op from singa_tpu.ops (pallas
+    flash attention on TPU, reference jnp path elsewhere)."""
+
+    def __init__(self, num_heads, embed_dim=None, causal=False, name=None):
+        super().__init__(name)
+        self.num_heads = num_heads
+        self.embed_dim = embed_dim
+        self.causal = causal
+
+    def initialize(self, x: Tensor, *rest):
+        d = self.embed_dim or x.shape[-1]
+        self.embed_dim = d
+        self.q_proj = Linear(d, d, bias=True)
+        self.k_proj = Linear(d, d, bias=True)
+        self.v_proj = Linear(d, d, bias=True)
+        self.out_proj = Linear(d, d, bias=True)
+
+    def forward(self, x: Tensor, mask: Optional[Tensor] = None) -> Tensor:
+        from .ops import attention as attn_ops
+        B, T, D = x.shape
+        H = self.num_heads
+        hd = D // H
+        q = self.q_proj(x).reshape((B, T, H, hd))
+        k = self.k_proj(x).reshape((B, T, H, hd))
+        v = self.v_proj(x).reshape((B, T, H, hd))
+        o = attn_ops.attention(q, k, v, causal=self.causal, mask=mask)
+        return self.out_proj(o.reshape((B, T, D)))
+
+
+class Sequential(Layer):
+    def __init__(self, *layers, name=None):
+        super().__init__(name)
+        self.layers = list(layers)
+
+    def forward(self, x):
+        for l in self.layers:
+            x = l(x)
+        return x
+
+
+# loss layers (reference exposes these as layers as well as autograd fns)
+class CrossEntropyLoss(Layer):
+    def forward(self, logits, target):
+        return autograd.softmax_cross_entropy(logits, target)
+
+
+class MSELoss(Layer):
+    def forward(self, x, t):
+        return autograd.mse_loss(x, t)

@@ -1,0 +1,367 @@
+"""singa_tpu.model — the Model API + graph executor.
+
+Capability parity: ``singa.model`` (BASELINE.json:5,8 — "singa.model
+Graph mode").  The user writes an *imperative* subclass:
+
+    class MLP(model.Model):
+        def __init__(self): ...
+        def forward(self, x): ...
+        def train_one_batch(self, x, y):
+            out = self.forward(x)
+            loss = autograd.softmax_cross_entropy(out, y)
+            self.optimizer(loss)
+            return out, loss
+
+and ``compile(..., use_graph=True)`` makes ``train_one_batch`` execute as
+ONE compiled XLA module: the executor traces the user's Python —
+forward, tape backward, optimizer update, and (with DistOpt) the
+gradient all-reduce — into a single jitted function with donated
+buffers.  That is exactly the north-star execution model
+(BASELINE.json:5: "compiles the captured computational graph into a
+single XLA HLO module", allreduce "swapped for XLA collectives over
+ICI").
+
+Functionalization: parameters/buffers are held in mutable Tensor objects
+whose ``.data`` is rebound during the trace; the executor threads them
+in and out of the jitted step (SURVEY.md §7.3 items 1–2).  Graph
+invalidation: keyed on input shapes/dtypes + train flag; shape change →
+re-capture (ibid.).
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd
+from . import tensor as tensor_mod
+from .graph import CapturedGraph
+from .layer import Layer
+from .opt import DistOpt, Optimizer
+from .tensor import Tensor
+
+__all__ = ["Model", "Module"]
+
+_live_models = weakref.WeakSet()
+
+
+def _invalidate_all_graphs():
+    for m in list(_live_models):
+        m._executors.clear()
+
+
+class Model(Layer):
+    """Base model (reference surface: forward / train_one_batch / loss /
+    optimizer / compile / save_states / load_states)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.optimizer: Optional[Optimizer] = None
+        self.loss_fn: Optional[Callable] = None
+        self.graph_mode = False
+        self.sequential = False
+        self._training = False
+        self._executors: Dict[Any, "_StepExecutor"] = {}
+        self._compiled_init = False
+        self._base_key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        self._step_count = 0
+        _live_models.add(self)
+
+    # -- reference API --------------------------------------------------------
+    def set_optimizer(self, opt: Optimizer) -> None:
+        self.optimizer = opt
+
+    def set_loss(self, fn) -> None:
+        self.loss_fn = fn
+
+    def loss(self, out, ty):
+        if self.loss_fn is not None:
+            return self.loss_fn(out, ty)
+        return autograd.softmax_cross_entropy(out, ty)
+
+    def train(self, mode: bool = True) -> "Model":
+        self._training = mode
+        autograd.set_training(mode)
+        return self
+
+    def eval(self) -> "Model":
+        return self.train(False)
+
+    def compile(self, inputs: List[Tensor], is_train: bool = True,
+                use_graph: bool = True, sequential: bool = False) -> None:
+        """Initialize parameters from example inputs and arm graph mode.
+
+        `sequential` is accepted for reference compatibility (op ordering
+        is XLA's concern here)."""
+        self.graph_mode = use_graph
+        self.sequential = sequential
+        self.train(is_train)
+        # dry-run forward eagerly to lazily materialize parameters
+        prev = autograd.is_training()
+        autograd.set_training(False)
+        try:
+            self.forward(*inputs)
+        finally:
+            autograd.set_training(prev)
+        self._compiled_init = True
+        self._executors.clear()
+
+    def train_one_batch(self, x, y, *args):
+        """Default train step; override for custom behavior (reference
+        requires the override — we provide the canonical body)."""
+        out = self.forward(x)
+        ls = self.loss(out, y)
+        if isinstance(self.optimizer, DistOpt):
+            self.optimizer.backward_and_update(ls)
+        else:
+            self.optimizer(ls)
+        return out, ls
+
+    # -- execution entry points ----------------------------------------------
+    def __call__(self, *xs):
+        if self.graph_mode and self._compiled_init and not autograd.is_training():
+            return self._run_graph("eval", self._eval_body, xs)
+        return super().__call__(*xs)
+
+    def train_step(self, *batch):
+        """Run train_one_batch — compiled when graph mode is on."""
+        self.train(True)
+        if self.graph_mode:
+            return self._run_graph("train", self._train_body, batch)
+        return self.train_one_batch(*batch)
+
+    def _train_body(self, batch_tensors):
+        return self.train_one_batch(*batch_tensors)
+
+    def _eval_body(self, batch_tensors):
+        return self.forward(*batch_tensors)
+
+    # -- the graph executor ---------------------------------------------------
+    def _run_graph(self, tag: str, body, batch):
+        arrays = tuple(b.data if isinstance(b, Tensor) else jnp.asarray(b)
+                       for b in batch)
+        key = tuple((a.shape, str(a.dtype)) for a in arrays) + (tag,)
+        ex = self._executors.get(key)
+        if ex is None:
+            ex = _StepExecutor(self, tag, body, arrays)
+            self._executors[key] = ex
+        return ex(arrays)
+
+    @property
+    def graph(self) -> Optional[CapturedGraph]:
+        """Most recently captured step graph."""
+        for ex in self._executors.values():
+            if ex.captured is not None:
+                return ex.captured
+        return None
+
+    # -- state I/O ------------------------------------------------------------
+    def save_states(self, fpath: str, aux_states: Optional[Dict] = None) -> None:
+        from .utils import checkpoint
+        checkpoint.save_states(self, fpath, aux_states)
+
+    def load_states(self, fpath: str) -> Dict:
+        from .utils import checkpoint
+        return checkpoint.load_states(self, fpath)
+
+
+# reference exposes the same class as Module in places
+Module = Model
+
+
+class _StepExecutor:
+    """Traces the model's imperative step into one jitted XLA module.
+
+    Input/output plumbing (all dict-of-arrays pytrees):
+      params   — trainable tensors      (donated, returned updated)
+      buffers  — non-trainable states   (donated, returned updated)
+      slots    — optimizer state        (donated, returned updated)
+      step     — optimizer step counter (i32 scalar)
+      rng      — PRNG key for dropout etc.
+      batch    — the input arrays
+    With a mesh + DistOpt, the step runs under shard_map over the mesh:
+    batch sharded on axis 0 over 'data', params replicated, gradients
+    pmean'ed in-graph by DistOpt.reduce_gradients.
+    """
+
+    def __init__(self, model: Model, tag: str, body, example_arrays):
+        self.model = model
+        self.tag = tag
+        self.body = body
+        self.captured: Optional[CapturedGraph] = None
+        self.is_train = (tag == "train")
+
+        # stable param/buffer ordering
+        params = model.get_params()
+        buffers = model._get_buffers()
+        self.param_tensors: Dict[str, Tensor] = dict(params)
+        self.buffer_tensors: Dict[str, Tensor] = dict(buffers)
+
+        opt = model.optimizer if self.is_train else None
+        self.opt = opt
+        if opt is not None:
+            p_arrays = {n: t.data for n, t in self.param_tensors.items()}
+            self.slots = opt.init(p_arrays)
+        else:
+            self.slots = {}
+
+        self._out_treedef = None
+        self._build(example_arrays)
+
+    # .....................................................................
+    def _traced_step(self, params, buffers, slots, step, rng, batch):
+        model, opt = self.model, self.opt
+        # bind state into the live tensor objects
+        saved_key = tensor_mod._rng_key
+        tensor_mod._rng_key = rng
+        saved_training = autograd.is_training()
+        autograd.set_training(self.is_train)
+        saved_opt_state = None
+        try:
+            for n, t in self.param_tensors.items():
+                t.data = params[n]
+            for n, t in self.buffer_tensors.items():
+                t.data = buffers[n]
+            if opt is not None:
+                saved_opt_state = (getattr(opt, "_eager_state", None),
+                                   opt.step_counter)
+                opt._eager_state = dict(slots)
+                opt.step_counter = step
+                if isinstance(opt, DistOpt):
+                    opt.opt._eager_state = opt._eager_state
+                    opt.opt.step_counter = step
+
+            batch_t = tuple(
+                Tensor(data=a, device=model_device(model), requires_grad=False)
+                for a in batch)
+            outs = self.body(batch_t)
+
+            from .parallel import communicator as comm
+            dist = isinstance(opt, DistOpt)
+            new_params = {n: t.data for n, t in self.param_tensors.items()}
+            new_buffers = {}
+            for n, t in self.buffer_tensors.items():
+                v = t.data
+                if dist:
+                    v = comm.allreduce(v, opt.data_axis, "mean")
+                new_buffers[n] = v
+            if opt is not None:
+                src = opt.opt._eager_state if isinstance(opt, DistOpt) else opt._eager_state
+                new_slots = {n: src.get(n, self.slots.get(n)) for n in self.slots}
+            else:
+                new_slots = {}
+
+            out_arrays, treedef = _flatten_outs(outs)
+            if dist:
+                # replicate scalar outputs (loss) for a consistent view
+                out_arrays = [comm.allreduce(a, opt.data_axis, "mean")
+                              if a.ndim == 0 else a for a in out_arrays]
+            self._out_treedef = treedef
+            return tuple(out_arrays), new_params, new_buffers, new_slots
+        finally:
+            tensor_mod._rng_key = saved_key
+            autograd.set_training(saved_training)
+            if opt is not None and saved_opt_state is not None:
+                opt._eager_state, opt.step_counter = saved_opt_state
+
+    # .....................................................................
+    def _build(self, example_arrays):
+        from .parallel import mesh as mesh_mod
+
+        mesh = mesh_mod.current_mesh()
+        dist = (isinstance(self.opt, DistOpt) and mesh is not None
+                and self.opt.data_axis in mesh.shape)
+        self.dist = dist
+
+        def fn(params, buffers, slots, step, rng, *batch):
+            return self._traced_step(params, buffers, slots, step, rng, batch)
+
+        if dist:
+            P = mesh_mod.P
+            axis = self.opt.data_axis
+            # discover output structure once (abstract eval, no device work)
+            shapes = jax.eval_shape(
+                fn, {n: t.data for n, t in self.param_tensors.items()},
+                {n: t.data for n, t in self.buffer_tensors.items()},
+                self.slots, jnp.zeros((), jnp.int32), self.model._base_key,
+                *[jax.ShapeDtypeStruct(_shard_shape(a.shape, mesh, axis), a.dtype)
+                  for a in example_arrays])
+            out_specs_leaves = jax.tree.map(
+                lambda s: P() if len(s.shape) == 0 else P(axis), shapes[0])
+            out_specs = (out_specs_leaves, P(), P(), P())
+            in_specs = (P(), P(), P(), P(), P()) + tuple(
+                P(axis) for _ in example_arrays)
+            wrapped = jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                    out_specs=out_specs, check_vma=False)
+        else:
+            wrapped = fn
+
+        self._jitted = jax.jit(wrapped, donate_argnums=(0, 1, 2))
+        # capture graph artifacts on first lowering
+        self._lowered = None
+
+    def __call__(self, batch_arrays):
+        m = self.model
+        params = {n: t.data for n, t in self.param_tensors.items()}
+        buffers = {n: t.data for n, t in self.buffer_tensors.items()}
+        step = jnp.asarray(
+            self.opt.step_counter if self.opt is not None else m._step_count,
+            jnp.int32)
+        rng = jax.random.fold_in(m._base_key, m._step_count)
+        if self.captured is None:
+            lowered = self._jitted.lower(params, buffers, self.slots, step,
+                                         rng, *batch_arrays)
+            compiled = lowered.compile()
+            self.captured = CapturedGraph(f"{m.name}.{self.tag}",
+                                          lowered=lowered, compiled=compiled)
+        outs, new_params, new_buffers, new_slots = self._jitted(
+            params, buffers, self.slots, step, rng, *batch_arrays)
+        # rebind updated state into the live tensors
+        for n, t in self.param_tensors.items():
+            t.data = new_params[n]
+        for n, t in self.buffer_tensors.items():
+            t.data = new_buffers[n]
+        self.slots = new_slots
+        m._step_count += 1
+        if self.opt is not None:
+            self.opt.step_counter = int(step) + 1
+            if isinstance(self.opt, DistOpt):
+                self.opt.opt.step_counter = self.opt.step_counter
+        return _unflatten_outs(outs, self._out_treedef, m)
+
+
+def model_device(model: Model):
+    for t in model.get_params().values():
+        return t.device
+    from . import device as device_mod
+    return device_mod.get_default_device()
+
+
+def _shard_shape(shape, mesh, axis):
+    if not shape:
+        return shape
+    n = mesh.shape[axis]
+    s = list(shape)
+    s[0] = max(1, s[0] // n)
+    return tuple(s)
+
+
+def _flatten_outs(outs):
+    """Tensor-pytree -> list of arrays + treedef."""
+    leaves, treedef = jax.tree.flatten(
+        outs, is_leaf=lambda x: isinstance(x, Tensor))
+    arrays = [l.data if isinstance(l, Tensor) else jnp.asarray(l)
+              for l in leaves]
+    return arrays, treedef
+
+
+def _unflatten_outs(arrays, treedef, model):
+    from . import device as device_mod
+    dev = model_device(model)
+    tensors = [Tensor(data=a, device=dev, requires_grad=False)
+               for a in arrays]
+    return jax.tree.unflatten(treedef, tensors)

@@ -1,0 +1,12 @@
+"""singa_tpu.ops — fused / hand-tuned ops for the TPU hot path.
+
+Where XLA fusion suffices we use plain jnp (it usually does); Pallas
+kernels live here for the ops where it doesn't (attention — SURVEY.md
+§7.2 step 7).
+"""
+
+from . import attention
+from .attention import attention as fused_attention
+from .rope import apply_rope, rope_frequencies
+
+__all__ = ["attention", "fused_attention", "apply_rope", "rope_frequencies"]

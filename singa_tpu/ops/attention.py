@@ -1,0 +1,79 @@
+"""Scaled-dot-product attention as an autograd Operator.
+
+Two lowerings behind one API:
+  * `_sdpa_reference` — plain jnp einsum/softmax; XLA fuses this well for
+    short sequences, and it is the correctness oracle on CPU.
+  * the Pallas flash-attention kernel (singa_tpu.ops.flash_attention) —
+    blockwise O(T) memory for long sequences on TPU.
+Selection is by sequence length + platform; both are jit-traceable so the
+choice is static at capture time.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from ..tensor import Tensor
+
+__all__ = ["attention", "sdpa"]
+
+# sequences at least this long route to the flash kernel on TPU
+_FLASH_MIN_LEN = 512
+
+
+def _sdpa_reference(q, k, v, causal: bool, mask, scale: float):
+    # q,k,v: (B, T, H, D) — keep head dim last for MXU-friendly einsums
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        Tq, Tk = q.shape[1], k.shape[1]
+        cm = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)
+        logits = jnp.where(cm[None, None], logits, jnp.finfo(logits.dtype).min)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _use_flash(q) -> bool:
+    if q.shape[1] < _FLASH_MIN_LEN:
+        return False
+    platform = jax.devices()[0].platform
+    return platform in ("tpu", "axon")
+
+
+class SDPA(autograd.Operator):
+    def __init__(self, causal: bool, mask, scale: Optional[float]):
+        super().__init__()
+        self.causal = causal
+        self.mask = mask
+        self.scale = scale
+
+    def fwd(self, q, k, v):
+        scale = self.scale or (1.0 / math.sqrt(q.shape[-1]))
+        if self.mask is None and _use_flash(q):
+            from .flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=self.causal, scale=scale)
+        return _sdpa_reference(q, k, v, self.causal, self.mask, scale)
+
+
+def attention(q: Tensor, k: Tensor, v: Tensor, causal: bool = False,
+              mask: Optional[Tensor] = None,
+              scale: Optional[float] = None) -> Tensor:
+    """(B, T, H, D) attention with optional causal/explicit mask."""
+    m = mask.data if isinstance(mask, Tensor) else mask
+    return SDPA(causal, m, scale)(q, k, v)
+
+
+def sdpa(q, k, v, causal=False, mask=None, scale=None):
+    """Raw-array entry point used by models bypassing the tape."""
+    scale = scale or (1.0 / math.sqrt(q.shape[-1]))
+    if mask is None and _use_flash(q):
+        from .flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    return _sdpa_reference(q, k, v, causal, mask, scale)

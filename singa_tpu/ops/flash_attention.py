@@ -1,0 +1,21 @@
+"""Flash attention — blockwise attention kernel (Pallas TPU).
+
+Milestone note: the Pallas kernel lands with the transformer-model
+milestone; until then this module provides the same signature backed by
+the XLA-fused reference computation so callers never break.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flash_attention"]
+
+
+def flash_attention(q, k, v, causal: bool = False, scale: float = None):
+    from .attention import _sdpa_reference
+    scale = scale or (1.0 / math.sqrt(q.shape[-1]))
+    return _sdpa_reference(q, k, v, causal, None, scale)

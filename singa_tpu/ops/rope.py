@@ -1,0 +1,44 @@
+"""Rotary position embeddings (RoPE) — needed for GPT-NeoX/Llama families
+(BASELINE.json:11 stretch config)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import autograd
+from ..tensor import Tensor
+
+__all__ = ["rope_frequencies", "apply_rope"]
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0):
+    """Precompute (cos, sin) tables of shape (max_len, head_dim//2)."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def _rope_fn(x, cos, sin):
+    # x: (B, T, H, D); tables sliced to T
+    T = x.shape[1]
+    c = cos[:T][None, :, None, :]
+    s = sin[:T][None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return out.astype(x.dtype)
+
+
+class Rope(autograd.Operator):
+    def __init__(self, cos, sin):
+        super().__init__()
+        self.cos, self.sin = cos, sin
+
+    def fwd(self, x):
+        return _rope_fn(x, self.cos, self.sin)
+
+
+def apply_rope(x, cos, sin):
+    if isinstance(x, Tensor):
+        return Rope(cos, sin)(x)
+    return _rope_fn(x, cos, sin)

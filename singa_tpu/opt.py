@@ -1,0 +1,360 @@
+"""singa_tpu.opt — optimizers + DistOpt (capability parity:
+``singa.opt`` SGD/momentum and the NCCL-backed DistOpt of
+BASELINE.json:5, whose allreduce we replace with XLA collectives over
+ICI emitted *inside* the compiled step module).
+
+Design: every optimizer has a pure functional core
+    init(params)                    -> state  (dict name -> arrays)
+    apply(step, name, p, g, state)  -> (new_p, new_state_slot)
+used by the graph executor so the whole update compiles into the single
+step HLO module.  The eager SINGA surface (``opt.update(p, g)``,
+``opt(loss)``) drives the same core immediately.
+
+DistOpt: marks gradients for mean-allreduce over the 'data' mesh axis.
+Under the compiled step the executor runs inside shard_map over the
+global mesh, so ``jax.lax.pmean`` lowers to one fused XLA all-reduce over
+ICI — the fused-bucket behavior of the reference comes for free because
+XLA's allreduce combiner merges small reduces.  fp16/bf16-compressed
+allreduce mirrors the reference's `backward_and_update_half`
+(BASELINE.json:5 "fused/sparsified grads").
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd
+from .tensor import Tensor
+
+__all__ = [
+    "Optimizer", "SGD", "Adam", "AdamW", "RMSProp", "AdaGrad",
+    "DistOpt", "Constant", "ExponentialDecay", "CosineDecay",
+    "WarmupCosine", "MultiStepLR",
+]
+
+
+# ---------------------------------------------------------------------------
+# learning-rate schedules (scalar step -> lr; jit-safe, pure jnp)
+# ---------------------------------------------------------------------------
+
+class Schedule:
+    def __call__(self, step):
+        raise NotImplementedError
+
+
+class Constant(Schedule):
+    def __init__(self, lr: float):
+        self.lr = lr
+
+    def __call__(self, step):
+        return self.lr
+
+
+class ExponentialDecay(Schedule):
+    def __init__(self, lr: float, decay_steps: int, decay_rate: float,
+                 staircase: bool = False):
+        self.lr, self.decay_steps = lr, decay_steps
+        self.decay_rate, self.staircase = decay_rate, staircase
+
+    def __call__(self, step):
+        p = step / self.decay_steps
+        if self.staircase:
+            p = jnp.floor(p)
+        return self.lr * jnp.power(self.decay_rate, p)
+
+
+class CosineDecay(Schedule):
+    def __init__(self, lr: float, total_steps: int, alpha: float = 0.0):
+        self.lr, self.total_steps, self.alpha = lr, total_steps, alpha
+
+    def __call__(self, step):
+        frac = jnp.clip(step / self.total_steps, 0.0, 1.0)
+        cos = 0.5 * (1 + jnp.cos(jnp.pi * frac))
+        return self.lr * ((1 - self.alpha) * cos + self.alpha)
+
+
+class WarmupCosine(Schedule):
+    def __init__(self, lr: float, warmup_steps: int, total_steps: int,
+                 min_lr: float = 0.0):
+        self.lr, self.warmup, self.total, self.min_lr = lr, warmup_steps, total_steps, min_lr
+
+    def __call__(self, step):
+        warm = self.lr * step / max(1, self.warmup)
+        frac = jnp.clip((step - self.warmup) / max(1, self.total - self.warmup), 0.0, 1.0)
+        cos = self.min_lr + (self.lr - self.min_lr) * 0.5 * (1 + jnp.cos(jnp.pi * frac))
+        return jnp.where(step < self.warmup, warm, cos)
+
+
+class MultiStepLR(Schedule):
+    def __init__(self, lr: float, milestones: List[int], gamma: float = 0.1):
+        self.lr, self.milestones, self.gamma = lr, sorted(milestones), gamma
+
+    def __call__(self, step):
+        n = sum(jnp.where(step >= m, 1, 0) for m in self.milestones)
+        return self.lr * jnp.power(self.gamma, n)
+
+
+def _as_schedule(lr) -> Schedule:
+    if isinstance(lr, Schedule):
+        return lr
+    return Constant(float(lr))
+
+
+# ---------------------------------------------------------------------------
+# optimizers
+# ---------------------------------------------------------------------------
+
+class Optimizer:
+    def __init__(self, lr):
+        self.sched = _as_schedule(lr)
+        self.step_counter = 0
+
+    # -- functional core ------------------------------------------------------
+    def init(self, params: Dict[str, jnp.ndarray]) -> Dict:
+        return {}
+
+    def apply(self, step, name: str, p, g, slot):
+        raise NotImplementedError
+
+    def apply_all(self, step, params: Dict[str, jnp.ndarray],
+                  grads: Dict[str, jnp.ndarray], state: Dict):
+        """Update every param; used by the graph executor inside jit."""
+        new_p, new_s = {}, {}
+        for name, p in params.items():
+            g = grads.get(name)
+            if g is None:
+                new_p[name] = p
+                new_s[name] = state.get(name)
+                continue
+            np_, ns_ = self.apply(step, name, p, g.astype(p.dtype),
+                                  state.get(name))
+            new_p[name] = np_
+            new_s[name] = ns_
+        return new_p, new_s
+
+    # -- eager SINGA surface --------------------------------------------------
+    def update(self, param: Tensor, grad: Tensor) -> None:
+        name = param.name or str(id(param))
+        if not hasattr(self, "_eager_state"):
+            self._eager_state = {}
+        slot = self._eager_state.get(name)
+        if slot is None:
+            slot = self._init_slot(param.data)
+        new_p, new_slot = self.apply(self.step_counter, name, param.data,
+                                     grad.data.astype(param.dtype), slot)
+        param.data = new_p
+        self._eager_state[name] = new_slot
+
+    def _init_slot(self, p):
+        return None
+
+    def __call__(self, loss: Tensor) -> None:
+        """backward + update (reference `opt(loss)` convenience)."""
+        for p, g in autograd.backward(loss):
+            self.update(p, g)
+        self.step()
+
+    def step(self) -> None:
+        self.step_counter += 1
+
+    def get_states(self) -> Dict:
+        return {"step": self.step_counter}
+
+    def set_states(self, s: Dict) -> None:
+        self.step_counter = int(s.get("step", 0))
+
+
+class SGD(Optimizer):
+    """SGD with momentum / nesterov / L2 weight decay (reference parity)."""
+
+    def __init__(self, lr=0.1, momentum=0.0, weight_decay=0.0,
+                 nesterov=False, dampening=0.0):
+        super().__init__(lr)
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+        self.dampening = dampening
+
+    def init(self, params):
+        if self.momentum == 0.0:
+            return {n: None for n in params}
+        return {n: jnp.zeros_like(p) for n, p in params.items()}
+
+    def _init_slot(self, p):
+        return None if self.momentum == 0.0 else jnp.zeros_like(p)
+
+    def apply(self, step, name, p, g, slot):
+        lr = self.sched(step)
+        if self.weight_decay:
+            g = g + self.weight_decay * p
+        if self.momentum:
+            buf = self.momentum * slot + (1 - self.dampening) * g
+            g_eff = g + self.momentum * buf if self.nesterov else buf
+            return (p - lr * g_eff).astype(p.dtype), buf
+        return (p - lr * g).astype(p.dtype), None
+
+
+class Adam(Optimizer):
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0):
+        super().__init__(lr)
+        self.b1, self.b2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.decoupled = False
+
+    def init(self, params):
+        return {n: (jnp.zeros_like(p), jnp.zeros_like(p))
+                for n, p in params.items()}
+
+    def _init_slot(self, p):
+        return (jnp.zeros_like(p), jnp.zeros_like(p))
+
+    def apply(self, step, name, p, g, slot):
+        lr = self.sched(step)
+        m, v = slot
+        if self.weight_decay and not self.decoupled:
+            g = g + self.weight_decay * p
+        t = step + 1
+        m = self.b1 * m + (1 - self.b1) * g
+        v = self.b2 * v + (1 - self.b2) * (g * g)
+        mhat = m / (1 - self.b1 ** t)
+        vhat = v / (1 - self.b2 ** t)
+        upd = mhat / (jnp.sqrt(vhat) + self.eps)
+        if self.weight_decay and self.decoupled:
+            upd = upd + self.weight_decay * p
+        return (p - lr * upd).astype(p.dtype), (m, v)
+
+
+class AdamW(Adam):
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.01):
+        super().__init__(lr, betas, eps, weight_decay)
+        self.decoupled = True
+
+
+class RMSProp(Optimizer):
+    def __init__(self, lr=1e-2, rho=0.9, eps=1e-8, weight_decay=0.0):
+        super().__init__(lr)
+        self.rho, self.eps, self.weight_decay = rho, eps, weight_decay
+
+    def init(self, params):
+        return {n: jnp.zeros_like(p) for n, p in params.items()}
+
+    def _init_slot(self, p):
+        return jnp.zeros_like(p)
+
+    def apply(self, step, name, p, g, slot):
+        lr = self.sched(step)
+        if self.weight_decay:
+            g = g + self.weight_decay * p
+        v = self.rho * slot + (1 - self.rho) * (g * g)
+        return (p - lr * g / (jnp.sqrt(v) + self.eps)).astype(p.dtype), v
+
+
+class AdaGrad(Optimizer):
+    def __init__(self, lr=1e-2, eps=1e-8, weight_decay=0.0):
+        super().__init__(lr)
+        self.eps, self.weight_decay = eps, weight_decay
+
+    def init(self, params):
+        return {n: jnp.zeros_like(p) for n, p in params.items()}
+
+    def _init_slot(self, p):
+        return jnp.zeros_like(p)
+
+    def apply(self, step, name, p, g, slot):
+        lr = self.sched(step)
+        if self.weight_decay:
+            g = g + self.weight_decay * p
+        acc = slot + g * g
+        return (p - lr * g / (jnp.sqrt(acc) + self.eps)).astype(p.dtype), acc
+
+
+# ---------------------------------------------------------------------------
+# DistOpt — data-parallel wrapper; allreduce becomes an in-graph XLA
+# collective over the 'data' mesh axis (BASELINE.json:5)
+# ---------------------------------------------------------------------------
+
+class DistOpt(Optimizer):
+    """Wraps a base optimizer with gradient synchronization.
+
+    Graph mode (the production path): the model's compiled step runs under
+    shard_map over the global mesh; ``reduce_gradients`` emits
+    ``lax.pmean`` which XLA lowers to a single fused all-reduce over ICI.
+    Variants mirroring the reference Communicator:
+      * fp16/bf16-compressed allreduce  (`backward_and_update_half`)
+      * top-K sparsified allreduce      (`backward_and_update_partial`,
+        fixed-K all-gather formulation — XLA-friendly; SURVEY.md §7.3.4)
+    """
+
+    def __init__(self, opt: Optimizer, nccl_id=None, local_rank: int = 0,
+                 world_size: Optional[int] = None, data_axis: str = "data",
+                 compress_dtype=None, topk_ratio: float = 0.0):
+        super().__init__(opt.sched)
+        self.opt = opt
+        self.data_axis = data_axis
+        self.compress_dtype = compress_dtype
+        self.topk_ratio = topk_ratio
+        self.local_rank = local_rank
+        self._world_size = world_size
+        del nccl_id  # reference-API compat; bootstrap is PJRT-side
+
+    @property
+    def world_size(self) -> int:
+        if self._world_size is not None:
+            return self._world_size
+        from .parallel import mesh as mesh_mod
+        m = mesh_mod.current_mesh()
+        if m is not None and self.data_axis in m.shape:
+            return m.shape[self.data_axis]
+        return 1
+
+    # functional core delegates to the wrapped optimizer
+    def init(self, params):
+        return self.opt.init(params)
+
+    def _init_slot(self, p):
+        return self.opt._init_slot(p)
+
+    def apply(self, step, name, p, g, slot):
+        return self.opt.apply(step, name, p, g, slot)
+
+    def reduce_gradients(self, grads: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+        """Mean-allreduce gradients over the data axis (in-graph).
+
+        Called by the graph executor *inside* shard_map; if no mesh axis is
+        bound (single-process eager), this is the identity."""
+        from .parallel import communicator as comm
+        return comm.allreduce_grads(grads, axis=self.data_axis,
+                                    compress_dtype=self.compress_dtype,
+                                    topk_ratio=self.topk_ratio)
+
+    # -- reference API surface ------------------------------------------------
+    def backward_and_update(self, loss: Tensor) -> None:
+        pg = autograd.backward(loss)
+        grads = {(p.name or str(id(p))): g.data for p, g in pg}
+        grads = self.reduce_gradients(grads)
+        for p, _ in pg:
+            g = grads[(p.name or str(id(p)))]
+            self.opt.update(p, Tensor(data=g, device=p.device, requires_grad=False))
+        self.opt.step()
+        self.step_counter = self.opt.step_counter
+
+    def backward_and_update_half(self, loss: Tensor) -> None:
+        self.compress_dtype = jnp.bfloat16
+        self.backward_and_update(loss)
+
+    def backward_and_partial_update(self, loss: Tensor, topk_ratio: float = 0.01) -> None:
+        self.topk_ratio = topk_ratio
+        self.backward_and_update(loss)
+
+    def update(self, param: Tensor, grad: Tensor) -> None:
+        self.opt.update(param, grad)
+
+    def step(self) -> None:
+        self.opt.step()
+        self.step_counter = self.opt.step_counter

@@ -1,0 +1,11 @@
+"""singa_tpu.parallel — device meshes, collectives, and parallelism
+strategies (DP today; TP/FSDP/SP via mesh-axis changes — SURVEY.md §2.3).
+"""
+
+from . import mesh
+from . import communicator
+from .mesh import (make_mesh, set_mesh, current_mesh, data_parallel_mesh,
+                   mesh_shape)
+
+__all__ = ["mesh", "communicator", "make_mesh", "set_mesh", "current_mesh",
+           "data_parallel_mesh", "mesh_shape"]

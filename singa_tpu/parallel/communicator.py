@@ -1,0 +1,133 @@
+"""Communicator — XLA collectives over ICI/DCN (capability parity with
+the reference's NCCL Communicator: allreduce / fused / fp16 / sparsified
+gradient reduction, BASELINE.json:5).
+
+All collectives here are *in-graph*: they are jnp/lax ops that only take
+effect inside shard_map/pmap traces, where they lower to XLA
+all-reduce / all-gather HLO executed by libtpu over ICI.  Fusion parity:
+XLA's all-reduce combiner merges the per-tensor reduces into large
+buckets, which is the reference's hand-written fused-bucket path done by
+the compiler.  Compressed allreduce (bf16) mirrors
+`backward_and_update_half`; fixed-K sparsified allreduce mirrors the
+top-K path (SURVEY.md §7.3 item 4: fixed-K all-gather formulation,
+because shape-dynamic top-K is hostile to XLA).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["axis_bound", "allreduce", "allreduce_grads", "allgather",
+           "reduce_scatter", "ppermute", "broadcast", "axis_index",
+           "axis_size", "barrier"]
+
+
+def axis_bound(axis: str) -> bool:
+    """True when `axis` is a live mapped axis (inside shard_map/pmap)."""
+    try:
+        jax.lax.axis_index(axis)
+        return True
+    except NameError:
+        return False
+
+
+def axis_index(axis: str):
+    return jax.lax.axis_index(axis)
+
+
+def axis_size(axis: str) -> int:
+    return jax.lax.axis_size(axis)
+
+
+def allreduce(x, axis: str = "data", op: str = "mean"):
+    if not axis_bound(axis):
+        return x
+    if op == "mean":
+        return jax.lax.pmean(x, axis)
+    if op == "sum":
+        return jax.lax.psum(x, axis)
+    if op == "max":
+        return jax.lax.pmax(x, axis)
+    if op == "min":
+        return jax.lax.pmin(x, axis)
+    raise ValueError(f"unknown reduce op {op}")
+
+
+def allgather(x, axis: str = "data", tiled: bool = False):
+    if not axis_bound(axis):
+        return x
+    return jax.lax.all_gather(x, axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str = "data", scatter_dimension: int = 0):
+    if not axis_bound(axis):
+        return x
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_dimension,
+                                tiled=True)
+
+
+def ppermute(x, axis: str, perm):
+    if not axis_bound(axis):
+        return x
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def broadcast(x, axis: str = "data", src: int = 0):
+    """Replicate rank-src's value: implemented as select + psum."""
+    if not axis_bound(axis):
+        return x
+    idx = jax.lax.axis_index(axis)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return jax.lax.psum(masked, axis)
+
+
+def barrier(axis: str = "data"):
+    if axis_bound(axis):
+        jax.lax.psum(jnp.ones(()), axis)
+
+
+# ---------------------------------------------------------------------------
+# gradient allreduce with the reference Communicator's variants
+# ---------------------------------------------------------------------------
+
+def allreduce_grads(grads: Dict[str, jnp.ndarray], axis: str = "data",
+                    compress_dtype=None,
+                    topk_ratio: float = 0.0) -> Dict[str, jnp.ndarray]:
+    """Mean-allreduce a dict of gradients over `axis`.
+
+    compress_dtype: cast to (e.g.) bf16 pre-reduce — halves ICI bytes
+    (reference: fp16 allreduce).  topk_ratio>0: fixed-K sparsified
+    exchange (reference: sparsified allreduce)."""
+    if not axis_bound(axis):
+        return grads
+    out = {}
+    for name, g in grads.items():
+        if g is None:
+            out[name] = None
+            continue
+        if topk_ratio and topk_ratio > 0.0 and g.size > 1024:
+            out[name] = _topk_allreduce(g, axis, topk_ratio)
+        elif compress_dtype is not None and g.dtype != compress_dtype:
+            out[name] = jax.lax.pmean(g.astype(compress_dtype), axis).astype(g.dtype)
+        else:
+            out[name] = jax.lax.pmean(g, axis)
+    return out
+
+
+def _topk_allreduce(g, axis: str, ratio: float):
+    """Fixed-K sparsified allreduce: each replica contributes its top-K
+    magnitude entries; exchanged via all-gather; scatter-add to dense.
+    K is static (trace-time) so shapes stay XLA-friendly."""
+    flat = g.reshape(-1)
+    k = max(1, int(flat.size * ratio))
+    _, idx = jax.lax.top_k(jnp.abs(flat), k)
+    vals = jnp.take(flat, idx)
+    w = jax.lax.axis_size(axis)
+    all_vals = jax.lax.all_gather(vals, axis)   # (W, k)
+    all_idx = jax.lax.all_gather(idx, axis)     # (W, k)
+    dense = jnp.zeros_like(flat).at[all_idx.reshape(-1)].add(
+        all_vals.reshape(-1) / w)
+    return dense.reshape(g.shape)

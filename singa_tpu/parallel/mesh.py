@@ -1,0 +1,75 @@
+"""Device-mesh management (the ICI/DCN topology handle).
+
+The reference's Communicator bootstraps ranks via MPI (BASELINE.json:5);
+our equivalent is a ``jax.sharding.Mesh`` over PJRT devices — intra-slice
+axes ride ICI, the inter-slice axis rides DCN.  All parallelism in
+singa_tpu is expressed as mesh axes:
+
+    'data'  — data parallel (the reference's only strategy)
+    'model' — tensor parallel (stretch: Llama-3-8B, BASELINE.json:11)
+    'seq'   — sequence/context parallel (ring attention)
+    'pipe'  — pipeline stages
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "set_mesh", "current_mesh", "data_parallel_mesh",
+           "mesh_shape", "P", "NamedSharding", "named_sharding",
+           "process_index", "process_count", "local_devices"]
+
+_current_mesh: Optional[Mesh] = None
+
+
+def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Build a named mesh, e.g. make_mesh({'data': 4, 'model': 2})."""
+    devices = list(devices) if devices is not None else jax.devices()
+    n = int(np.prod(list(axes.values())))
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(tuple(axes.values()))
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def data_parallel_mesh(n: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    n = n or len(devs)
+    return make_mesh({"data": n}, devs)
+
+
+def set_mesh(mesh: Optional[Mesh]) -> None:
+    global _current_mesh
+    _current_mesh = mesh
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _current_mesh
+
+
+def mesh_shape() -> Dict[str, int]:
+    m = current_mesh()
+    return dict(m.shape) if m is not None else {}
+
+
+def named_sharding(*spec) -> Optional[NamedSharding]:
+    m = current_mesh()
+    if m is None:
+        return None
+    return NamedSharding(m, P(*spec))
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def local_devices() -> List:
+    return jax.local_devices()

@@ -1,0 +1,6 @@
+"""singa_tpu.utils — checkpointing, metrics, data pipeline."""
+
+from . import checkpoint
+from . import metrics
+
+__all__ = ["checkpoint", "metrics"]

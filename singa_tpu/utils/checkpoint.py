@@ -1,0 +1,61 @@
+"""Checkpoint / resume (SURVEY.md §5: reference lineage
+save_states/load_states writing a zip of tensors; we keep the same API
+with atomic writes — host-side .npz plus a json manifest)."""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["save_states", "load_states", "save_arrays", "load_arrays"]
+
+_AUX_KEY = "__aux__"
+
+
+def save_arrays(arrays: Dict[str, np.ndarray], fpath: str,
+                aux: Optional[Dict] = None) -> None:
+    """Atomic write: temp file in the same dir, then rename."""
+    d = os.path.dirname(os.path.abspath(fpath)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            meta = {_AUX_KEY: json.dumps(aux or {})}
+            np.savez(f, __meta__=json.dumps(meta), **arrays)
+        os.replace(tmp, fpath)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_arrays(fpath: str):
+    with np.load(fpath, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+    aux = json.loads(meta.get(_AUX_KEY, "{}"))
+    return arrays, aux
+
+
+def save_states(model, fpath: str, aux_states: Optional[Dict] = None) -> None:
+    """Reference API: model.save_states(fpath, aux_states)."""
+    states = model.get_states()
+    arrays = {}
+    for name, t in states.items():
+        arrays[name] = np.asarray(t.data, dtype=np.asarray(t.data).dtype)
+    aux = dict(aux_states or {})
+    if getattr(model, "optimizer", None) is not None:
+        aux["optimizer"] = model.optimizer.get_states()
+    save_arrays(arrays, fpath, aux)
+
+
+def load_states(model, fpath: str) -> Dict:
+    arrays, aux = load_arrays(fpath)
+    model.set_states(arrays)
+    if "optimizer" in aux and getattr(model, "optimizer", None) is not None:
+        model.optimizer.set_states(aux["optimizer"])
+    return aux

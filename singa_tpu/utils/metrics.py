@@ -1,0 +1,129 @@
+"""Metrics/observability (SURVEY.md §5): loss, accuracy, throughput, MFU
+accounting, with an optional JSONL sink. No external deps."""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["Accuracy", "MeanMeter", "Throughput", "MetricsLogger",
+           "accuracy", "peak_flops", "mfu"]
+
+
+def accuracy(logits: np.ndarray, labels: np.ndarray) -> float:
+    pred = np.argmax(np.asarray(logits), axis=-1)
+    return float((pred == np.asarray(labels)).mean())
+
+
+class Accuracy:
+    def __init__(self):
+        self.correct = 0
+        self.total = 0
+
+    def update(self, logits, labels) -> None:
+        pred = np.argmax(np.asarray(logits), axis=-1)
+        labels = np.asarray(labels)
+        self.correct += int((pred == labels).sum())
+        self.total += labels.size
+
+    @property
+    def value(self) -> float:
+        return self.correct / max(1, self.total)
+
+
+class MeanMeter:
+    def __init__(self):
+        self.sum = 0.0
+        self.n = 0
+
+    def update(self, v, n: int = 1) -> None:
+        self.sum += float(v) * n
+        self.n += n
+
+    @property
+    def value(self) -> float:
+        return self.sum / max(1, self.n)
+
+
+class Throughput:
+    """items/sec over a sliding window."""
+
+    def __init__(self):
+        self.t0 = None
+        self.items = 0
+
+    def start(self):
+        self.t0 = time.perf_counter()
+        self.items = 0
+
+    def update(self, n: int):
+        if self.t0 is None:
+            self.start()
+        self.items += n
+
+    @property
+    def value(self) -> float:
+        if self.t0 is None:
+            return 0.0
+        dt = time.perf_counter() - self.t0
+        return self.items / max(1e-9, dt)
+
+
+# peak dense bf16 FLOPs per chip (for MFU accounting, BASELINE.json:5)
+_PEAK_FLOPS = {
+    "tpu v4": 275e12,
+    "tpu v5 lite": 197e12,   # v5e bf16
+    "tpu v5e": 197e12,
+    "tpu v5p": 459e12,
+    "tpu v6e": 918e12,
+    "cpu": 1e12,
+}
+
+
+def peak_flops(device_kind: Optional[str] = None) -> float:
+    import jax
+    kind = (device_kind or getattr(jax.devices()[0], "device_kind", "cpu")).lower()
+    for k, v in _PEAK_FLOPS.items():
+        if k in kind:
+            return v
+    return _PEAK_FLOPS["cpu"]
+
+
+def mfu(model_flops_per_step: float, step_time_s: float,
+        n_chips: int = 1, device_kind: Optional[str] = None) -> float:
+    """Achieved model-FLOPs utilization. model_flops must be the *model's*
+    FLOPs (e.g. 6*N*T for transformers), not the compiled module's."""
+    return model_flops_per_step / (step_time_s * peak_flops(device_kind) * n_chips)
+
+
+class MetricsLogger:
+    """JSONL sink: one dict per line."""
+
+    def __init__(self, path: Optional[str] = None, echo: bool = True):
+        self.path = path
+        self.echo = echo
+        self._f = open(path, "a") if path else None
+
+    def log(self, **kv) -> None:
+        kv.setdefault("t", time.time())
+        line = json.dumps({k: _jsonable(v) for k, v in kv.items()})
+        if self._f:
+            self._f.write(line + "\n")
+            self._f.flush()
+        if self.echo:
+            print(line)
+
+    def close(self):
+        if self._f:
+            self._f.close()
+
+
+def _jsonable(v):
+    if isinstance(v, (np.floating, np.integer)):
+        return v.item()
+    if hasattr(v, "item") and getattr(v, "ndim", 1) == 0:
+        return float(v)
+    return v

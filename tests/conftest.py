@@ -1,0 +1,44 @@
+"""Test harness config: force an 8-device virtual CPU mesh so every
+sharding/collective path is exercised without TPU hardware (SURVEY.md §4
+item 3).
+
+Note: this image's sitecustomize force-registers the `axon` TPU plugin
+and overrides JAX_PLATFORMS programmatically, so plain env vars are not
+enough — we must set XLA_FLAGS before the CPU client exists AND override
+jax_platforms via jax.config."""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# exact f32 matmuls for numeric checks (TPU runs keep the fast default)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_singa_state():
+    """Each test starts with eager mode, no mesh, fresh default device."""
+    import singa_tpu as st
+    st.tensor.set_seed(0)
+    st.autograd.set_training(False)
+    st.parallel.set_mesh(None)
+    dev = st.device.create_cpu_device()
+    st.device.set_default_device(dev)
+    np.random.seed(0)
+    yield
+    st.parallel.set_mesh(None)
+    st.autograd.set_training(False)
+
+
+@pytest.fixture
+def cpu_dev():
+    import singa_tpu as st
+    return st.device.get_default_device()

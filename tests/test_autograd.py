@@ -1,0 +1,201 @@
+"""Autograd operator tests: forward vs numpy, backward vs finite
+differences (SURVEY.md §4 item 1 — the reference lineage's test pattern)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import autograd, tensor
+
+
+def fd_grad(fn, x, eps=1e-3):
+    """Central finite differences of scalar fn wrt numpy array x."""
+    g = np.zeros_like(x, dtype=np.float64)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp = x.copy(); xp[i] += eps
+        xm = x.copy(); xm[i] -= eps
+        g[i] = (fn(xp) - fn(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def analytic_grad(op_fn, x):
+    """Gradient of sum(op(x)) via the tape."""
+    autograd.set_training(True)
+    t = tensor.Tensor(data=x.astype(np.float32), requires_grad=True,
+                      stores_grad=True)
+    out = op_fn(t)
+    loss = autograd.reduce_sum(out)
+    grads = autograd.backward(loss)
+    autograd.set_training(False)
+    for p, g in grads:
+        if p is t:
+            return g.to_numpy()
+    raise AssertionError("no grad for input")
+
+
+UNARY_CASES = [
+    ("relu", lambda t: autograd.relu(t)),
+    ("sigmoid", lambda t: autograd.sigmoid(t)),
+    ("tanh", lambda t: autograd.tanh(t)),
+    ("gelu", lambda t: autograd.gelu(t)),
+    ("silu", lambda t: autograd.silu(t)),
+    ("softplus", lambda t: autograd.softplus(t)),
+    ("leakyrelu", lambda t: autograd.leakyrelu(t, 0.1)),
+    ("elu", lambda t: autograd.elu(t)),
+    ("exp", lambda t: autograd.exp(t)),
+    ("softmax", lambda t: autograd.softmax(t)),
+    ("log_softmax", lambda t: autograd.log_softmax(t)),
+    ("neg", lambda t: autograd.neg(t)),
+    ("abs", lambda t: autograd.abs(t)),
+    ("pow3", lambda t: autograd.pow(t, 3.0)),
+    ("square", lambda t: autograd.mul(t, t)),
+    ("reshape", lambda t: autograd.reshape(t, (4, 2))),
+    ("transpose", lambda t: autograd.transpose(t)),
+    ("mean", lambda t: autograd.reduce_mean(t, 1)),
+]
+
+
+@pytest.mark.parametrize("name,fn", UNARY_CASES, ids=[c[0] for c in UNARY_CASES])
+def test_unary_backward_fd(name, fn):
+    np.random.seed(1)
+    x = (np.random.randn(2, 4) * 0.8 + 0.3).astype(np.float32)
+
+    def scalar(xn):
+        autograd.set_training(False)
+        t = tensor.Tensor(data=xn.astype(np.float32), requires_grad=False)
+        return float(autograd.reduce_sum(fn(t)).to_numpy())
+
+    g_an = analytic_grad(fn, x)
+    g_fd = fd_grad(scalar, x.astype(np.float64))
+    np.testing.assert_allclose(g_an, g_fd, rtol=2e-2, atol=2e-3)
+
+
+def test_binary_backward_broadcast():
+    autograd.set_training(True)
+    a = tensor.Tensor(data=np.random.randn(3, 4).astype(np.float32),
+                      requires_grad=True, stores_grad=True)
+    b = tensor.Tensor(data=np.random.randn(4).astype(np.float32),
+                      requires_grad=True, stores_grad=True)
+    loss = autograd.reduce_sum(autograd.mul(autograd.add(a, b), b))
+    grads = dict((id(p), g) for p, g in autograd.backward(loss))
+    an, bn = a.to_numpy(), b.to_numpy()
+    np.testing.assert_allclose(grads[id(a)].to_numpy(),
+                               np.broadcast_to(bn, (3, 4)), rtol=1e-5)
+    np.testing.assert_allclose(grads[id(b)].to_numpy(),
+                               (an + 2 * bn).sum(0), rtol=1e-4)
+
+
+def test_matmul_backward():
+    autograd.set_training(True)
+    A = np.random.randn(3, 4).astype(np.float32)
+    B = np.random.randn(4, 5).astype(np.float32)
+    ta = tensor.Tensor(data=A, requires_grad=True, stores_grad=True)
+    tb = tensor.Tensor(data=B, requires_grad=True, stores_grad=True)
+    loss = autograd.reduce_sum(autograd.matmul(ta, tb))
+    grads = dict((id(p), g) for p, g in autograd.backward(loss))
+    ones = np.ones((3, 5), np.float32)
+    np.testing.assert_allclose(grads[id(ta)].to_numpy(), ones @ B.T, rtol=1e-5)
+    np.testing.assert_allclose(grads[id(tb)].to_numpy(), A.T @ ones, rtol=1e-5)
+
+
+def test_softmax_cross_entropy_backward():
+    autograd.set_training(True)
+    logits_np = np.random.randn(6, 10).astype(np.float32)
+    labels_np = np.random.randint(0, 10, 6)
+    logits = tensor.Tensor(data=logits_np, requires_grad=True, stores_grad=True)
+    labels = tensor.Tensor(data=labels_np, requires_grad=False)
+    loss = autograd.softmax_cross_entropy(logits, labels)
+    grads = autograd.backward(loss)
+    # analytic: (softmax - onehot)/N
+    e = np.exp(logits_np - logits_np.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    onehot = np.eye(10)[labels_np]
+    np.testing.assert_allclose(grads[0][1].to_numpy(),
+                               (p - onehot) / 6, rtol=1e-4, atol=1e-6)
+    # loss value
+    expect = -np.mean(np.log(p[np.arange(6), labels_np]))
+    np.testing.assert_allclose(float(loss.to_numpy()), expect, rtol=1e-5)
+
+
+def test_mse_backward():
+    autograd.set_training(True)
+    x = tensor.Tensor(data=np.random.randn(4, 3).astype(np.float32),
+                      requires_grad=True, stores_grad=True)
+    t = tensor.from_numpy(np.random.randn(4, 3).astype(np.float32))
+    loss = autograd.mse_loss(x, t)
+    grads = autograd.backward(loss)
+    np.testing.assert_allclose(grads[0][1].to_numpy(),
+                               2 * (x.to_numpy() - t.to_numpy()) / 12, rtol=1e-5)
+
+
+def test_conv2d_backward_fd():
+    np.random.seed(2)
+    x = np.random.randn(1, 5, 5, 2).astype(np.float32)  # NHWC
+    w = np.random.randn(3, 3, 2, 4).astype(np.float32)  # HWIO
+
+    def scalar_w(wn):
+        autograd.set_training(False)
+        tx = tensor.Tensor(data=x, requires_grad=False)
+        tw = tensor.Tensor(data=wn.astype(np.float32), requires_grad=False)
+        y = autograd.conv2d(tx, tw, stride=1, padding=1)
+        return float(autograd.reduce_sum(y).to_numpy())
+
+    autograd.set_training(True)
+    tx = tensor.Tensor(data=x, requires_grad=True, stores_grad=True)
+    tw = tensor.Tensor(data=w, requires_grad=True, stores_grad=True)
+    y = autograd.conv2d(tx, tw, stride=1, padding=1)
+    grads = dict((id(p), g) for p, g in
+                 autograd.backward(autograd.reduce_sum(y)))
+    # fd-check a slice of W (full fd too slow)
+    g_an = grads[id(tw)].to_numpy()
+    idx = (1, 1, 0, 2)
+    eps = 1e-2
+    wp, wm = w.copy(), w.copy()
+    wp[idx] += eps
+    wm[idx] -= eps
+    fd = (scalar_w(wp) - scalar_w(wm)) / (2 * eps)
+    np.testing.assert_allclose(g_an[idx], fd, rtol=5e-2, atol=1e-2)
+
+
+def test_embedding_backward():
+    autograd.set_training(True)
+    table = tensor.Tensor(data=np.random.randn(10, 4).astype(np.float32),
+                          requires_grad=True, stores_grad=True)
+    ids = tensor.Tensor(data=np.array([1, 3, 1]), requires_grad=False)
+    out = autograd.embedding(table, ids)
+    grads = autograd.backward(autograd.reduce_sum(out))
+    g = grads[0][1].to_numpy()
+    assert g[1].sum() == pytest.approx(8.0)  # row 1 hit twice * 4 dims
+    assert g[3].sum() == pytest.approx(4.0)
+    assert g[0].sum() == 0.0
+
+
+def test_grad_accumulation_diamond():
+    """x used twice -> grads must sum."""
+    autograd.set_training(True)
+    x = tensor.Tensor(data=np.array([2.0], np.float32),
+                      requires_grad=True, stores_grad=True)
+    y = autograd.add(autograd.mul(x, x), x)  # x^2 + x -> dy/dx = 2x+1 = 5
+    grads = autograd.backward(autograd.reduce_sum(y))
+    np.testing.assert_allclose(grads[0][1].to_numpy(), [5.0], rtol=1e-6)
+
+
+def test_no_tape_outside_training():
+    autograd.set_training(False)
+    x = tensor.Tensor(data=np.ones((2, 2), np.float32), requires_grad=True)
+    y = autograd.relu(x)
+    assert y.creator is None
+
+
+def test_split_multi_output_backward():
+    autograd.set_training(True)
+    x = tensor.Tensor(data=np.arange(8, dtype=np.float32).reshape(2, 4),
+                      requires_grad=True, stores_grad=True)
+    a, b = autograd.split(x, 2, axis=1)
+    loss = autograd.reduce_sum(autograd.mul(a, 2.0))
+    grads = autograd.backward(loss)
+    g = grads[0][1].to_numpy()
+    np.testing.assert_allclose(g[:, :2], 2.0)
+    np.testing.assert_allclose(g[:, 2:], 0.0)

@@ -1,0 +1,154 @@
+"""Model API tests: MLP trains eagerly (the reference smoke config,
+BASELINE.json:7), graph mode compiles to one module and matches eager
+step-for-step (SURVEY.md §4 item 2)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import autograd, device, layer, model, opt, tensor
+
+
+def make_blobs(n=256, d=10, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, d) * 3
+    y = rng.randint(0, classes, n)
+    x = centers[y] + rng.randn(n, d)
+    return x.astype(np.float32), y.astype(np.int32)
+
+
+class MLP(model.Model):
+    def __init__(self, hidden=32, classes=4):
+        super().__init__()
+        self.fc1 = layer.Linear(hidden)
+        self.act = layer.ReLU()
+        self.fc2 = layer.Linear(classes)
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = autograd.softmax_cross_entropy(out, y)
+        self.optimizer(loss)
+        return out, loss
+
+
+def _train(use_graph, steps=30, seed=123):
+    tensor.set_seed(seed)
+    np.random.seed(seed)
+    x, y = make_blobs()
+    m = MLP()
+    m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+    tx = tensor.from_numpy(x)
+    ty = tensor.from_numpy(y)
+    m.compile([tx], is_train=True, use_graph=use_graph)
+    losses = []
+    for i in range(steps):
+        out, loss = m.train_step(tx, ty)
+        losses.append(float(loss.to_numpy()))
+    return m, losses
+
+
+def test_mlp_trains_eager():
+    m, losses = _train(use_graph=False)
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_mlp_trains_graph():
+    m, losses = _train(use_graph=True)
+    assert losses[-1] < losses[0] * 0.5, losses
+    g = m.graph
+    assert g is not None and g.num_ops >= 0
+    assert "hlo" in g.hlo_text().lower() or len(g.hlo_text()) > 0
+
+
+def test_graph_matches_eager():
+    _, l_eager = _train(use_graph=False, steps=10, seed=7)
+    _, l_graph = _train(use_graph=True, steps=10, seed=7)
+    np.testing.assert_allclose(l_eager, l_graph, rtol=1e-4, atol=1e-5)
+
+
+def test_graph_recompiles_on_shape_change():
+    m, _ = _train(use_graph=True, steps=2)
+    x2 = np.random.randn(64, 10).astype(np.float32)
+    y2 = np.random.randint(0, 4, 64).astype(np.int32)
+    out, loss = m.train_step(tensor.from_numpy(x2), tensor.from_numpy(y2))
+    assert out.shape == (64, 4)
+    assert len(m._executors) == 2  # two captured graphs
+
+
+def test_eval_graph_mode():
+    m, _ = _train(use_graph=True, steps=5)
+    m.eval()
+    x, _ = make_blobs(32)
+    out = m(tensor.from_numpy(x))
+    assert out.shape == (32, 4)
+
+
+def test_save_load_states(tmp_path):
+    m, _ = _train(use_graph=True, steps=5, seed=3)
+    path = str(tmp_path / "ckpt.npz")
+    m.save_states(path, aux_states={"epoch": 2})
+
+    m2 = MLP()
+    m2.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+    x, y = make_blobs()
+    m2.compile([tensor.from_numpy(x)], is_train=True, use_graph=False)
+    aux = m2.load_states(path)
+    assert aux["epoch"] == 2
+    for (n1, p1), (n2, p2) in zip(sorted(m.get_params().items()),
+                                  sorted(m2.get_params().items())):
+        np.testing.assert_allclose(p1.to_numpy(), p2.to_numpy(), rtol=1e-6)
+
+
+def test_param_collection_names_unique():
+    m = MLP()
+    x, _ = make_blobs(8)
+    m.compile([tensor.from_numpy(x)], is_train=False, use_graph=False)
+    names = list(m.get_params().keys())
+    assert len(names) == len(set(names))
+    assert len(names) == 4  # 2 layers x (W, b)
+
+
+def test_adam_and_schedules():
+    tensor.set_seed(0)
+    x, y = make_blobs(128)
+    m = MLP()
+    m.set_optimizer(opt.Adam(lr=opt.CosineDecay(1e-2, 100)))
+    tx, ty = tensor.from_numpy(x), tensor.from_numpy(y)
+    m.compile([tx], is_train=True, use_graph=True)
+    first = None
+    for i in range(20):
+        _, loss = m.train_step(tx, ty)
+        if first is None:
+            first = float(loss.to_numpy())
+    assert float(loss.to_numpy()) < first
+
+
+def test_batchnorm_model_graph_state_threading():
+    class CNNish(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc = layer.Linear(8)
+            self.bn = layer.BatchNorm2d()
+
+        def forward(self, x):
+            return self.bn(self.fc(x))
+
+        def train_one_batch(self, x, y):
+            out = self.forward(x)
+            loss = autograd.mse_loss(out, y)
+            self.optimizer(loss)
+            return out, loss
+
+    tensor.set_seed(1)
+    m = CNNish()
+    m.set_optimizer(opt.SGD(lr=0.01))
+    x = tensor.from_numpy(np.random.randn(16, 4).astype(np.float32))
+    y = tensor.from_numpy(np.random.randn(16, 8).astype(np.float32))
+    m.compile([x], is_train=True, use_graph=True)
+    rm0 = m.bn.running_mean.to_numpy().copy()
+    for _ in range(3):
+        m.train_step(x, y)
+    rm1 = m.bn.running_mean.to_numpy()
+    assert not np.allclose(rm0, rm1), "running stats must update through the graph"

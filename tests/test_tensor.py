@@ -1,0 +1,99 @@
+"""Tensor API tests (SURVEY.md §4: op-level on CppCPU)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import tensor, device
+
+
+def test_construction_and_numpy_roundtrip():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    t = tensor.from_numpy(a)
+    assert t.shape == (3, 4)
+    assert t.dtype == np.float32
+    np.testing.assert_array_equal(t.to_numpy(), a)
+
+
+def test_zeros_ones_full():
+    assert tensor.zeros((2, 3)).to_numpy().sum() == 0
+    assert tensor.ones((2, 3)).to_numpy().sum() == 6
+    np.testing.assert_allclose(tensor.full((2, 2), 3.5).to_numpy(), 3.5)
+
+
+def test_arithmetic_matches_numpy():
+    a = np.random.randn(4, 5).astype(np.float32)
+    b = np.random.randn(4, 5).astype(np.float32)
+    ta, tb = tensor.from_numpy(a), tensor.from_numpy(b)
+    np.testing.assert_allclose((ta + tb).to_numpy(), a + b, rtol=1e-6)
+    np.testing.assert_allclose((ta - tb).to_numpy(), a - b, rtol=1e-6)
+    np.testing.assert_allclose((ta * tb).to_numpy(), a * b, rtol=1e-6)
+    np.testing.assert_allclose((ta / tb).to_numpy(), a / b, rtol=1e-5)
+    np.testing.assert_allclose((ta + 2.0).to_numpy(), a + 2.0, rtol=1e-6)
+    np.testing.assert_allclose((3.0 * ta).to_numpy(), 3.0 * a, rtol=1e-6)
+    np.testing.assert_allclose((-ta).to_numpy(), -a, rtol=1e-6)
+
+
+def test_matmul_and_T():
+    a = np.random.randn(3, 4).astype(np.float32)
+    b = np.random.randn(4, 5).astype(np.float32)
+    out = tensor.from_numpy(a) @ tensor.from_numpy(b)
+    np.testing.assert_allclose(out.to_numpy(), a @ b, rtol=1e-5)
+    np.testing.assert_allclose(tensor.from_numpy(a).T.to_numpy(), a.T)
+
+
+def test_shape_ops():
+    a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    t = tensor.from_numpy(a)
+    assert t.reshape((6, 4)).shape == (6, 4)
+    assert tensor.transpose(t, (2, 0, 1)).shape == (4, 2, 3)
+    assert tensor.flatten(t, 1).shape == (2, 12)
+    assert tensor.unsqueeze(t, 0).shape == (1, 2, 3, 4)
+    assert tensor.concatenate([t, t], axis=0).shape == (4, 3, 4)
+    assert tensor.stack([t, t], axis=0).shape == (2, 2, 3, 4)
+    parts = tensor.split(t, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1, 4)
+
+
+def test_reductions():
+    a = np.random.randn(3, 4).astype(np.float32)
+    t = tensor.from_numpy(a)
+    np.testing.assert_allclose(tensor.sum(t).to_numpy(), a.sum(), rtol=1e-5)
+    np.testing.assert_allclose(tensor.mean(t, 0).to_numpy(), a.mean(0), rtol=1e-5)
+    np.testing.assert_allclose(tensor.max(t, 1).to_numpy(), a.max(1), rtol=1e-6)
+    np.testing.assert_allclose(tensor.argmax(t, 1).to_numpy(), a.argmax(1))
+
+
+def test_random_fills_and_seed():
+    tensor.set_seed(42)
+    t1 = tensor.gaussian((100,), 0.0, 1.0)
+    tensor.set_seed(42)
+    t2 = tensor.gaussian((100,), 0.0, 1.0)
+    np.testing.assert_array_equal(t1.to_numpy(), t2.to_numpy())
+    u = tensor.uniform((1000,), -2.0, 2.0).to_numpy()
+    assert u.min() >= -2.0 and u.max() <= 2.0
+
+
+def test_inplace_and_copy():
+    t = tensor.ones((2, 2))
+    t += 1
+    np.testing.assert_allclose(t.to_numpy(), 2.0)
+    s = tensor.zeros((2, 2))
+    s.copy_from(t)
+    np.testing.assert_allclose(s.to_numpy(), 2.0)
+
+
+def test_comparisons_and_where():
+    a = tensor.from_numpy(np.array([-1.0, 0.5, 2.0], np.float32))
+    np.testing.assert_array_equal((a > 0).to_numpy(), [0, 1, 1])
+    np.testing.assert_array_equal((a <= 0.5).to_numpy(), [1, 1, 0])
+
+
+def test_astype():
+    t = tensor.ones((2, 2))
+    assert t.as_type(np.int32).dtype == np.int32
+
+
+def test_device_roundtrip(cpu_dev):
+    t = tensor.ones((2, 2), dev=cpu_dev)
+    t.to_device(cpu_dev)
+    assert t.device is cpu_dev
