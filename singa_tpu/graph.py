@@ -31,9 +31,11 @@ class CapturedGraph:
     # -- introspection --------------------------------------------------------
     @property
     def num_ops(self) -> int:
-        if self.jaxpr is None:
-            return 0
-        return _count_eqns(self.jaxpr.jaxpr)
+        if self.jaxpr is not None:
+            return _count_eqns(self.jaxpr.jaxpr)
+        # fall back to counting HLO instructions in the lowered module
+        txt = self.hlo_text()
+        return sum(1 for line in txt.splitlines() if " = " in line)
 
     def op_types(self) -> Dict[str, int]:
         out: Dict[str, int] = {}

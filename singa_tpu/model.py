@@ -113,12 +113,12 @@ class Model(Layer):
     def train_one_batch(self, x, y, *args):
         """Default train step; override for custom behavior (reference
         requires the override — we provide the canonical body)."""
+        if self.optimizer is None:
+            raise RuntimeError(
+                "no optimizer: call model.set_optimizer(...) before training")
         out = self.forward(x)
         ls = self.loss(out, y)
-        if isinstance(self.optimizer, DistOpt):
-            self.optimizer.backward_and_update(ls)
-        else:
-            self.optimizer(ls)
+        self.optimizer.backward_and_update(ls)
         return out, ls
 
     # -- execution entry points ----------------------------------------------
@@ -221,6 +221,8 @@ class _StepExecutor:
         saved_training = autograd.is_training()
         autograd.set_training(self.is_train)
         saved_opt_state = None
+        saved_param_data = {n: t.data for n, t in self.param_tensors.items()}
+        saved_buffer_data = {n: t.data for n, t in self.buffer_tensors.items()}
         try:
             for n, t in self.param_tensors.items():
                 t.data = params[n]
@@ -232,6 +234,8 @@ class _StepExecutor:
                 opt._eager_state = dict(slots)
                 opt.step_counter = step
                 if isinstance(opt, DistOpt):
+                    saved_inner_state = (getattr(opt.opt, "_eager_state", None),
+                                         opt.opt.step_counter)
                     opt.opt._eager_state = opt._eager_state
                     opt.opt.step_counter = step
 
@@ -263,10 +267,18 @@ class _StepExecutor:
             self._out_treedef = treedef
             return tuple(out_arrays), new_params, new_buffers, new_slots
         finally:
+            # restore concrete bindings — traces (jit/eval_shape) must not
+            # leave tracers in the live tensors/optimizer
             tensor_mod._rng_key = saved_key
             autograd.set_training(saved_training)
+            for n, t in self.param_tensors.items():
+                t.data = saved_param_data[n]
+            for n, t in self.buffer_tensors.items():
+                t.data = saved_buffer_data[n]
             if opt is not None and saved_opt_state is not None:
                 opt._eager_state, opt.step_counter = saved_opt_state
+                if isinstance(opt, DistOpt):
+                    opt.opt._eager_state, opt.opt.step_counter = saved_inner_state
 
     # .....................................................................
     def _build(self, example_arrays):
@@ -276,6 +288,7 @@ class _StepExecutor:
         dist = (isinstance(self.opt, DistOpt) and mesh is not None
                 and self.opt.data_axis in mesh.shape)
         self.dist = dist
+        self.mesh = mesh if dist else None
 
         def fn(params, buffers, slots, step, rng, *batch):
             return self._traced_step(params, buffers, slots, step, rng, batch)
@@ -312,6 +325,21 @@ class _StepExecutor:
             self.opt.step_counter if self.opt is not None else m._step_count,
             jnp.int32)
         rng = jax.random.fold_in(m._base_key, m._step_count)
+        if self.dist:
+            # place state replicated / batch data-sharded over the mesh the
+            # step was compiled against; no-op after the first step
+            # (outputs already carry shardings)
+            from .parallel import mesh as mesh_mod
+            rep = mesh_mod.NamedSharding(self.mesh, mesh_mod.P())
+            shard = mesh_mod.NamedSharding(self.mesh, mesh_mod.P(self.opt.data_axis))
+            place = lambda a, s: a if (hasattr(a, "sharding") and a.sharding == s) \
+                else jax.device_put(a, s)
+            params = {n: place(a, rep) for n, a in params.items()}
+            buffers = {n: place(a, rep) for n, a in buffers.items()}
+            self.slots = jax.tree.map(lambda a: place(a, rep), self.slots)
+            step = place(step, rep)
+            rng = place(rng, rep)
+            batch_arrays = tuple(place(a, shard) for a in batch_arrays)
         if self.captured is None:
             lowered = self._jitted.lower(params, buffers, self.slots, step,
                                          rng, *batch_arrays)

@@ -140,7 +140,7 @@ class Optimizer:
     # -- eager SINGA surface --------------------------------------------------
     def update(self, param: Tensor, grad: Tensor) -> None:
         name = param.name or str(id(param))
-        if not hasattr(self, "_eager_state"):
+        if getattr(self, "_eager_state", None) is None:
             self._eager_state = {}
         slot = self._eager_state.get(name)
         if slot is None:
@@ -158,6 +158,11 @@ class Optimizer:
         for p, g in autograd.backward(loss):
             self.update(p, g)
         self.step()
+
+    def backward_and_update(self, loss: Tensor) -> None:
+        """Reference surface: same as __call__ for non-distributed opts,
+        so user code written against DistOpt runs unchanged."""
+        self(loss)
 
     def step(self) -> None:
         self.step_counter += 1
