@@ -1,0 +1,314 @@
+"""ctypes binding to the native runtime (csrc/ → libsinga_core.so).
+
+Parity role: the reference's generated binding layer between the Python
+surface and the C++ core (SURVEY.md §2.2 row 5; pybind11 unavailable in
+this image, so the binding is ctypes over a C API).  Builds the library
+on demand with the csrc/Makefile if it's missing.
+"""
+
+from __future__ import annotations
+
+import ctypes as C
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libsinga_core.so")
+_CSRC = os.path.abspath(os.path.join(_HERE, "..", "..", "csrc"))
+
+_lib: Optional[C.CDLL] = None
+_load_error: Optional[str] = None
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _CSRC], check=True,
+                       capture_output=True, timeout=300)
+        return os.path.exists(_SO)
+    except Exception:
+        return False
+
+
+def lib() -> Optional[C.CDLL]:
+    """The loaded native library, or None if unavailable (callers must
+    degrade to the pure-JAX path)."""
+    global _lib, _load_error
+    if _lib is not None:
+        return _lib
+    if _load_error is not None:
+        return None
+    if not os.path.exists(_SO) and not _build():
+        _load_error = "build failed"
+        return None
+    try:
+        l = C.CDLL(_SO)
+        _declare(l)
+        _lib = l
+        return _lib
+    except OSError as e:
+        _load_error = str(e)
+        return None
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+i64 = C.c_int64
+f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+
+
+def _declare(l: C.CDLL) -> None:
+    l.sg_version.restype = C.c_char_p
+    l.sg_gemm.argtypes = [f32p, f32p, f32p, i64, i64, i64,
+                          C.c_int, C.c_int, C.c_float, C.c_float]
+    for name in ("sg_add", "sg_sub", "sg_mul", "sg_div"):
+        getattr(l, name).argtypes = [f32p, f32p, f32p, i64]
+    for name in ("sg_relu", "sg_sigmoid", "sg_tanh", "sg_exp"):
+        getattr(l, name).argtypes = [f32p, f32p, i64]
+    l.sg_relu_grad.argtypes = [f32p, f32p, f32p, i64]
+    l.sg_softmax.argtypes = [f32p, f32p, i64, i64]
+    l.sg_sum.argtypes = [f32p, f32p, i64]
+    l.sg_axpy.argtypes = [C.c_float, f32p, f32p, i64]
+    l.sg_scale.argtypes = [C.c_float, f32p, i64]
+    l.sg_conv2d_nhwc.argtypes = [f32p, f32p, f32p] + [i64] * 11
+    l.sg_sgd_update.argtypes = [f32p, f32p, C.c_void_p,
+                                C.c_float, C.c_float, C.c_float, i64]
+    l.sg_graph_new.restype = i64
+    l.sg_graph_free.argtypes = [i64]
+    l.sg_graph_add_node.restype = i64
+    l.sg_graph_add_node.argtypes = [i64, C.c_char_p, i64p, i64, i64p, i64,
+                                    i64p, i64]
+    l.sg_graph_toposort.restype = i64
+    l.sg_graph_toposort.argtypes = [i64, i64p, i64]
+    l.sg_graph_plan_memory.restype = i64
+    l.sg_graph_plan_memory.argtypes = [i64, i64p, i64]
+    l.sg_graph_num_nodes.restype = i64
+    l.sg_graph_num_nodes.argtypes = [i64]
+    l.sg_graph_total_flops.restype = i64
+    l.sg_graph_total_flops.argtypes = [i64]
+    l.sg_loader_new.restype = i64
+    l.sg_loader_new.argtypes = [f32p, C.c_void_p, i64, i64, i64,
+                                C.c_int, C.c_uint64, C.c_int, C.c_int, C.c_int]
+    l.sg_loader_next.restype = i64
+    l.sg_loader_next.argtypes = [i64, f32p, C.c_void_p]
+    l.sg_loader_free.argtypes = [i64]
+    l.sg_loader_batches_per_epoch.restype = i64
+    l.sg_loader_batches_per_epoch.argtypes = [i64]
+    l.sg_pool_alloc.restype = C.c_void_p
+    l.sg_pool_alloc.argtypes = [C.c_size_t]
+    l.sg_pool_free.argtypes = [C.c_void_p]
+    l.sg_pool_bytes_in_use.restype = C.c_size_t
+    l.sg_pool_bytes_reserved.restype = C.c_size_t
+
+
+def version() -> str:
+    l = lib()
+    return l.sg_version().decode() if l else "unavailable"
+
+
+# ---------------------------------------------------------------------------
+# numpy-level wrappers (tensor_math_cpp dispatch surface)
+# ---------------------------------------------------------------------------
+
+def _c(a):
+    return np.ascontiguousarray(a, dtype=np.float32)
+
+
+def gemm(a: np.ndarray, b: np.ndarray, transa=False, transb=False,
+         alpha=1.0) -> np.ndarray:
+    l = lib()
+    a, b = _c(a), _c(b)
+    m = a.shape[1] if transa else a.shape[0]
+    k = a.shape[0] if transa else a.shape[1]
+    n = b.shape[0] if transb else b.shape[1]
+    out = np.zeros((m, n), np.float32)
+    l.sg_gemm(a, b, out, m, k, n, int(transa), int(transb), alpha, 0.0)
+    return out
+
+
+def _binary(name):
+    def fn(a, b):
+        l = lib()
+        a, b = _c(a), _c(b)
+        out = np.empty_like(a)
+        getattr(l, name)(a, b, out, a.size)
+        return out
+    return fn
+
+
+add = _binary("sg_add")
+sub = _binary("sg_sub")
+mul = _binary("sg_mul")
+div = _binary("sg_div")
+
+
+def _unary(name):
+    def fn(a):
+        l = lib()
+        a = _c(a)
+        out = np.empty_like(a)
+        getattr(l, name)(a, out, a.size)
+        return out
+    return fn
+
+
+relu = _unary("sg_relu")
+sigmoid = _unary("sg_sigmoid")
+tanh = _unary("sg_tanh")
+exp = _unary("sg_exp")
+
+
+def relu_grad(a, dy):
+    l = lib()
+    a, dy = _c(a), _c(dy)
+    out = np.empty_like(a)
+    l.sg_relu_grad(a, dy, out, a.size)
+    return out
+
+
+def softmax(a):
+    l = lib()
+    a = _c(a)
+    rows = int(np.prod(a.shape[:-1])) if a.ndim > 1 else 1
+    out = np.empty_like(a)
+    l.sg_softmax(a.reshape(rows, -1), out.reshape(rows, -1), rows, a.shape[-1])
+    return out
+
+
+def array_sum(a) -> float:
+    l = lib()
+    a = _c(a)
+    out = np.zeros(1, np.float32)
+    l.sg_sum(a.reshape(-1), out, a.size)
+    return float(out[0])
+
+
+def conv2d_nhwc(x, w, stride=(1, 1), padding=(0, 0)):
+    l = lib()
+    x, w = _c(x), _c(w)
+    N, H, W_, Cin = x.shape
+    KH, KW, _, OC = w.shape
+    sh, sw = stride
+    ph, pw = padding
+    OH = (H + 2 * ph - KH) // sh + 1
+    OW = (W_ + 2 * pw - KW) // sw + 1
+    y = np.zeros((N, OH, OW, OC), np.float32)
+    l.sg_conv2d_nhwc(x, w, y, N, H, W_, Cin, KH, KW, OC, sh, sw, ph, pw)
+    return y
+
+
+def sgd_update(param: np.ndarray, grad: np.ndarray,
+               mom: Optional[np.ndarray], lr, momentum=0.0, weight_decay=0.0):
+    l = lib()
+    assert param.dtype == np.float32 and param.flags["C_CONTIGUOUS"]
+    mom_p = mom.ctypes.data_as(C.c_void_p) if mom is not None else None
+    l.sg_sgd_update(param, _c(grad), mom_p, lr, momentum, weight_decay,
+                    param.size)
+
+
+# ---------------------------------------------------------------------------
+# scheduler wrapper
+# ---------------------------------------------------------------------------
+
+class NativeGraph:
+    """Handle on a native scheduler graph (topo sort + memory planning)."""
+
+    def __init__(self):
+        l = lib()
+        if l is None:
+            raise RuntimeError("native core unavailable")
+        self._l = l
+        self.h = l.sg_graph_new()
+        self._nbufs = 0
+
+    def add_node(self, name: str, in_bufs, out_bufs, out_sizes, flops=0) -> int:
+        ib = np.asarray(in_bufs, np.int64)
+        ob = np.asarray(out_bufs, np.int64)
+        sz = np.asarray(out_sizes, np.int64)
+        self._nbufs = max([self._nbufs] + [int(b) + 1 for b in list(ib) + list(ob)])
+        return int(self._l.sg_graph_add_node(
+            self.h, name.encode(), ib, len(ib), ob, len(ob), sz, int(flops)))
+
+    def toposort(self):
+        n = int(self._l.sg_graph_num_nodes(self.h))
+        out = np.zeros(n, np.int64)
+        r = int(self._l.sg_graph_toposort(self.h, out, n))
+        if r < 0:
+            raise ValueError("cycle in graph")
+        return out.tolist()
+
+    def plan_memory(self):
+        """Returns (arena_bytes, {buf_id: offset})."""
+        offsets = np.full(self._nbufs, -1, np.int64)
+        arena = int(self._l.sg_graph_plan_memory(self.h, offsets, self._nbufs))
+        return arena, {i: int(o) for i, o in enumerate(offsets) if o >= 0}
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self._l.sg_graph_num_nodes(self.h))
+
+    @property
+    def total_flops(self) -> int:
+        return int(self._l.sg_graph_total_flops(self.h))
+
+    def __del__(self):
+        try:
+            self._l.sg_graph_free(self.h)
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# data loader wrapper
+# ---------------------------------------------------------------------------
+
+class NativeLoader:
+    def __init__(self, x: np.ndarray, y: Optional[np.ndarray], batch: int,
+                 shuffle=True, seed=0, drop_last=False, workers=2, prefetch=4):
+        l = lib()
+        if l is None:
+            raise RuntimeError("native core unavailable")
+        self._l = l
+        self.x = np.ascontiguousarray(x.reshape(len(x), -1), np.float32)
+        self.y = (np.ascontiguousarray(y, np.int32) if y is not None else None)
+        self.sample_shape = x.shape[1:]
+        self.batch = batch
+        self.stride = self.x.shape[1]
+        yp = self.y.ctypes.data_as(C.c_void_p) if self.y is not None else None
+        self.h = l.sg_loader_new(self.x, yp, len(x), self.stride, batch,
+                                 int(shuffle), seed, int(drop_last),
+                                 workers, prefetch)
+        if self.h < 0:
+            raise ValueError("bad loader args")
+        self._xbuf = np.empty((batch, self.stride), np.float32)
+        self._ybuf = np.empty(batch, np.int32)
+
+    def next(self):
+        yb = self._ybuf.ctypes.data_as(C.c_void_p) if self.y is not None else None
+        n = int(self._l.sg_loader_next(self.h, self._xbuf, yb))
+        if n <= 0:
+            raise StopIteration
+        x = self._xbuf[:n].reshape((n,) + self.sample_shape).copy()
+        y = self._ybuf[:n].copy() if self.y is not None else None
+        return x, y
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return int(self._l.sg_loader_batches_per_epoch(self.h))
+
+    def close(self):
+        if self.h is not None:
+            self._l.sg_loader_free(self.h)
+            self.h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

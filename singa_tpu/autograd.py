@@ -108,6 +108,29 @@ class Operator:
     def backward(self, dy):
         return self._vjp(dy)
 
+    # -- native CPU dispatch (tensor_math_cpp parity) ------------------------
+    # Ops that define `native_fwd` run through csrc/tensor_math_cpp.cc when
+    # the input device is CppCPU(use_native=True) and inputs are concrete
+    # f32 host arrays.  Ops relying on the default jax.vjp backward only
+    # dispatch natively when no gradient is required (the vjp pairing needs
+    # the jnp forward); hand-written-backward ops dispatch in training too.
+    def _native_candidate(self, inputs, arrays) -> bool:
+        if not inputs or not hasattr(self, "native_fwd"):
+            return False
+        dev = inputs[0].device
+        if not getattr(dev, "use_native", False):
+            return False
+        from . import _core
+        if not _core.available():
+            return False
+        import jax as _jax
+        for a in arrays:
+            if isinstance(a, _jax.core.Tracer) or a.dtype != np.float32:
+                return False
+        if type(self).backward is Operator.backward and self.requires_grad:
+            return False  # default-vjp backward needs the jnp forward
+        return True
+
     # -- tape machinery ------------------------------------------------------
     def __call__(self, *inputs: Tensor):
         arrays = []
@@ -116,7 +139,13 @@ class Operator:
                 raise TypeError(f"{type(self).__name__} got non-Tensor input {type(x)}")
             arrays.append(x.data)
         self.requires_grad = training and any(x.requires_grad for x in inputs)
-        out = self.forward(*arrays)
+        out = None
+        if self._native_candidate(inputs, arrays):
+            out = self.native_fwd(*[np.asarray(a) for a in arrays])
+            if out is not None:
+                out = jnp.asarray(out)
+        if out is None:
+            out = self.forward(*arrays)
         if self.requires_grad:
             self.src = [(x, x.requires_grad) for x in inputs]
         dev = inputs[0].device if inputs else None
@@ -267,6 +296,13 @@ class Add(Operator):
         self._sa, self._sb = a.shape, b.shape
         return jnp.add(a, b)
 
+    def native_fwd(self, a, b):
+        if a.shape != b.shape:
+            return None  # broadcast handled by the jnp path
+        self._sa = self._sb = a.shape
+        from . import _core
+        return _core.add(a, b)
+
     def backward(self, dy):
         return _unbroadcast(dy, self._sa), _unbroadcast(dy, self._sb)
 
@@ -284,6 +320,13 @@ class Mul(Operator):
     def forward(self, a, b):
         self._a, self._b = a, b
         return jnp.multiply(a, b)
+
+    def native_fwd(self, a, b):
+        if a.shape != b.shape:
+            return None
+        self._a, self._b = a, b
+        from . import _core
+        return _core.mul(a, b)
 
     def backward(self, dy):
         return (_unbroadcast(dy * self._b, self._a.shape),
@@ -466,6 +509,13 @@ class Matmul(Operator):
         self._a, self._b = a, b
         return jnp.matmul(a, b)
 
+    def native_fwd(self, a, b):
+        if a.ndim != 2 or b.ndim != 2:
+            return None
+        self._a, self._b = a, b
+        from . import _core
+        return _core.gemm(a, b)
+
     def backward(self, dy):
         a, b = self._a, self._b
         ga = jnp.matmul(dy, jnp.swapaxes(b, -1, -2))
@@ -494,6 +544,16 @@ class Linear(Operator):
         y = jnp.matmul(x, w)
         if self.has_bias:
             y = y + b[0]
+        return y
+
+    def native_fwd(self, x, w, *b):
+        if x.ndim != 2:
+            return None
+        self._x, self._w = x, w
+        from . import _core
+        y = _core.gemm(x, w)
+        if self.has_bias:
+            y += b[0]
         return y
 
     def backward(self, dy):
@@ -784,6 +844,11 @@ class ReLU(Operator):
         self._mask = a > 0
         return jnp.where(self._mask, a, 0)
 
+    def native_fwd(self, a):
+        self._mask = a > 0
+        from . import _core
+        return _core.relu(a)
+
     def backward(self, dy):
         return (jnp.where(self._mask, dy, 0),)
 
@@ -793,6 +858,11 @@ class Sigmoid(Operator):
         self._y = jax.nn.sigmoid(a)
         return self._y
 
+    def native_fwd(self, a):
+        from . import _core
+        self._y = _core.sigmoid(a)
+        return self._y
+
     def backward(self, dy):
         return (dy * self._y * (1 - self._y),)
 
@@ -800,6 +870,11 @@ class Sigmoid(Operator):
 class Tanh(Operator):
     def forward(self, a):
         self._y = jnp.tanh(a)
+        return self._y
+
+    def native_fwd(self, a):
+        from . import _core
+        self._y = _core.tanh(a)
         return self._y
 
     def backward(self, dy):
@@ -847,6 +922,13 @@ class Softmax(Operator):
     def __init__(self, axis=-1):
         super().__init__()
         self.axis = axis
+
+    def native_fwd(self, a):
+        if self.axis not in (-1, a.ndim - 1):
+            return None
+        from . import _core
+        self._y = _core.softmax(a)
+        return self._y
 
     def forward(self, a):
         self._y = jax.nn.softmax(a, axis=self.axis)
@@ -1156,6 +1238,21 @@ class Conv2d(Operator):
             self.padding = [tuple(p) if isinstance(p, (tuple, list)) else (p, p)
                             for p in padding]
         self.groups = groups
+
+    def native_fwd(self, x, w, *b):
+        # inference-only native conv (training uses the jnp/vjp path)
+        if self.groups != 1 or self.dilation != (1, 1):
+            return None
+        if isinstance(self.padding, str):
+            return None
+        (pt, pb), (pl, pr) = self.padding
+        if pt != pb or pl != pr:
+            return None
+        from . import _core
+        y = _core.conv2d_nhwc(x, w, self.stride, (pt, pl))
+        if b:
+            y = y + b[0]
+        return y
 
     def fwd(self, x, w, *b):
         y = jax.lax.conv_general_dilated(

@@ -16,17 +16,28 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
-__all__ = ["CapturedGraph", "reset_graph"]
+import numpy as np
+
+__all__ = ["CapturedGraph", "Schedule", "reset_graph"]
 
 
 class CapturedGraph:
     """A captured training/eval step: jaxpr + lowered + compiled handles."""
 
-    def __init__(self, name: str, jaxpr=None, lowered=None, compiled=None):
+    def __init__(self, name: str, jaxpr=None, lowered=None, compiled=None,
+                 jaxpr_thunk=None):
         self.name = name
-        self.jaxpr = jaxpr
+        self._jaxpr = jaxpr
+        self._jaxpr_thunk = jaxpr_thunk
         self.lowered = lowered
         self.compiled = compiled
+
+    @property
+    def jaxpr(self):
+        if self._jaxpr is None and self._jaxpr_thunk is not None:
+            self._jaxpr = self._jaxpr_thunk()
+            self._jaxpr_thunk = None
+        return self._jaxpr
 
     # -- introspection --------------------------------------------------------
     @property
@@ -84,8 +95,57 @@ class CapturedGraph:
         with open(path, "w") as f:
             f.write(self.hlo_text())
 
+    # -- native scheduler bridge ---------------------------------------------
+    def schedule(self):
+        """Feed the captured op graph to the native C++ scheduler
+        (csrc/scheduler.cc): deterministic topological order + first-fit
+        arena plan for a serial host replay.  Returns a Schedule with
+        .order, .arena_bytes, .num_nodes — the reference Graph/Scheduler's
+        introspection surface, TPU-side scheduling stays XLA's."""
+        from . import _core
+        if not _core.available():
+            raise RuntimeError("native core unavailable")
+        cj = self.jaxpr
+        if cj is None:
+            raise RuntimeError("no jaxpr captured for this graph")
+        jaxpr = cj.jaxpr
+        ng = _core.NativeGraph()
+        buf_ids = {}
+
+        def bid(v):
+            key = id(v)
+            if key not in buf_ids:
+                buf_ids[key] = len(buf_ids)
+            return buf_ids[key]
+
+        for v in jaxpr.invars:
+            bid(v)
+        for eqn in jaxpr.eqns:
+            # Literals carry .val; Vars don't — version-stable check
+            ins = [bid(v) for v in eqn.invars if not hasattr(v, "val")]
+            outs = [bid(v) for v in eqn.outvars]
+            sizes = [int(np.prod(v.aval.shape)) * v.aval.dtype.itemsize
+                     for v in eqn.outvars]
+            ng.add_node(eqn.primitive.name, ins, outs, sizes)
+        order = ng.toposort()
+        arena, offsets = ng.plan_memory()
+        return Schedule(order=order, arena_bytes=arena,
+                        num_nodes=ng.num_nodes, buffer_offsets=offsets)
+
     def __repr__(self):
         return f"<CapturedGraph {self.name}: {self.num_ops} ops>"
+
+
+class Schedule:
+    def __init__(self, order, arena_bytes, num_nodes, buffer_offsets):
+        self.order = order
+        self.arena_bytes = arena_bytes
+        self.num_nodes = num_nodes
+        self.buffer_offsets = buffer_offsets
+
+    def __repr__(self):
+        return (f"<Schedule nodes={self.num_nodes} "
+                f"arena={self.arena_bytes}B>")
 
 
 def _count_eqns(jaxpr) -> int:

@@ -344,8 +344,20 @@ class _StepExecutor:
             lowered = self._jitted.lower(params, buffers, self.slots, step,
                                          rng, *batch_arrays)
             compiled = lowered.compile()
+            # lazy jaxpr capture (shapes only — safe w.r.t. donation)
+            absargs = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                (params, buffers, self.slots, step, rng, tuple(batch_arrays)))
+
+            def jaxpr_thunk(absargs=absargs):
+                p, b, s, st, rk, batch = absargs
+                return jax.make_jaxpr(
+                    lambda *a: self._jitted.__wrapped__(*a[:-1], *a[-1]))(
+                        p, b, s, st, rk, batch)
+
             self.captured = CapturedGraph(f"{m.name}.{self.tag}",
-                                          lowered=lowered, compiled=compiled)
+                                          lowered=lowered, compiled=compiled,
+                                          jaxpr_thunk=jaxpr_thunk)
         outs, new_params, new_buffers, new_slots = self._jitted(
             params, buffers, self.slots, step, rng, *batch_arrays)
         # rebind updated state into the live tensors

@@ -1,0 +1,95 @@
+"""Data pipeline: shuffled, prefetched batch loading.
+
+The hot path is the native threaded loader (csrc/dataloader.cc) so batch
+assembly overlaps device compute; a pure-python fallback keeps the API
+alive if the native library can't build.  Mirrors the reference's native
+data path (SURVEY.md §2.2 native checklist)."""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from .. import _core
+
+__all__ = ["DataLoader", "synthetic_dataset"]
+
+
+class DataLoader:
+    """Iterate (x, y) minibatches from in-memory arrays.
+
+    One iteration = one epoch. Reshuffles every epoch (native path uses
+    seed+epoch so runs are reproducible)."""
+
+    def __init__(self, x: np.ndarray, y: Optional[np.ndarray] = None,
+                 batch_size: int = 32, shuffle: bool = True, seed: int = 0,
+                 drop_last: bool = False, workers: int = 2,
+                 prefetch: int = 4, use_native: Optional[bool] = None):
+        self.x = np.asarray(x, np.float32)
+        self.y = np.asarray(y, np.int32) if y is not None else None
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self._epoch = 0
+        if use_native is None:
+            use_native = _core.available()
+        self._native: Optional[_core.NativeLoader] = None
+        if use_native and _core.available():
+            self._native = _core.NativeLoader(
+                self.x, self.y, batch_size, shuffle=shuffle, seed=seed,
+                drop_last=drop_last, workers=workers, prefetch=prefetch)
+
+    def __len__(self) -> int:
+        n = len(self.x)
+        return n // self.batch_size if self.drop_last else \
+            (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray]]]:
+        if self._native is not None:
+            for _ in range(len(self)):
+                try:
+                    yield self._native.next()
+                except StopIteration:
+                    # under-delivery (e.g. concurrent close) ends the epoch
+                    # cleanly instead of PEP-479 RuntimeError
+                    return
+            return
+        n = len(self.x)
+        idx = np.arange(n)
+        if self.shuffle:
+            np.random.RandomState(self.seed + self._epoch).shuffle(idx)
+        self._epoch += 1
+        for s in range(0, len(self) * self.batch_size, self.batch_size):
+            sel = idx[s:s + self.batch_size]
+            if len(sel) == 0:
+                break
+            yield (self.x[sel],
+                   self.y[sel] if self.y is not None else None)
+
+    def close(self):
+        if self._native is not None:
+            self._native.close()
+            self._native = None
+
+
+def synthetic_dataset(kind: str = "blobs", n: int = 1024, classes: int = 10,
+                      shape=(32, 32, 3), seed: int = 0):
+    """Deterministic synthetic datasets for the example/benchmark scripts
+    (the image has no dataset downloads; zero egress)."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, classes, n).astype(np.int32)
+    if kind == "blobs":
+        d = int(np.prod(shape))
+        centers = rng.randn(classes, d).astype(np.float32) * 2.0
+        x = centers[y] + rng.randn(n, d).astype(np.float32)
+        return x.reshape((n,) + tuple(shape)), y
+    if kind == "images":
+        x = rng.randn(n, *shape).astype(np.float32)
+        # plant a class-dependent low-frequency pattern so models can learn
+        for c in range(classes):
+            mask = y == c
+            x[mask, c % shape[0], :, :] += 2.0
+        return x, y
+    raise ValueError(kind)

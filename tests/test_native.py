@@ -1,0 +1,200 @@
+"""Native runtime tests: tensor_math_cpp kernels vs numpy, scheduler
+topo-sort/memory planning, threaded data loader, staging pool."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import _core
+
+pytestmark = pytest.mark.skipif(not _core.available(),
+                                reason="native core unavailable")
+
+
+def test_version():
+    assert "singa_core" in _core.version()
+
+
+def test_gemm_matches_numpy():
+    rng = np.random.RandomState(0)
+    a = rng.randn(37, 53).astype(np.float32)
+    b = rng.randn(53, 29).astype(np.float32)
+    np.testing.assert_allclose(_core.gemm(a, b), a @ b, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(_core.gemm(a, a, transb=True), a @ a.T,
+                               rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(_core.gemm(a, a, transa=True), a.T @ a,
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_elementwise_and_activations():
+    rng = np.random.RandomState(1)
+    a = rng.randn(1000).astype(np.float32)
+    b = rng.randn(1000).astype(np.float32)
+    np.testing.assert_allclose(_core.add(a, b), a + b, rtol=1e-6)
+    np.testing.assert_allclose(_core.mul(a, b), a * b, rtol=1e-6)
+    np.testing.assert_allclose(_core.relu(a), np.maximum(a, 0), rtol=1e-6)
+    np.testing.assert_allclose(_core.sigmoid(a), 1 / (1 + np.exp(-a)), rtol=1e-5)
+    np.testing.assert_allclose(_core.tanh(a), np.tanh(a), rtol=1e-5)
+    s = _core.softmax(a.reshape(10, 100))
+    e = np.exp(a.reshape(10, 100) - a.reshape(10, 100).max(1, keepdims=True))
+    np.testing.assert_allclose(s, e / e.sum(1, keepdims=True), rtol=1e-5)
+    assert _core.array_sum(a) == pytest.approx(a.sum(), rel=1e-4)
+
+
+def test_conv2d_matches_jax():
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 8, 8, 3).astype(np.float32)
+    w = rng.randn(3, 3, 3, 5).astype(np.float32)
+    got = _core.conv2d_nhwc(x, w, (2, 2), (1, 1))
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (2, 2), [(1, 1), (1, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_sgd_update_inplace():
+    p = np.ones(10, np.float32)
+    g = np.full(10, 0.5, np.float32)
+    m = np.zeros(10, np.float32)
+    _core.sgd_update(p, g, m, lr=0.1, momentum=0.9)
+    np.testing.assert_allclose(p, 1.0 - 0.05, rtol=1e-6)
+    np.testing.assert_allclose(m, 0.5, rtol=1e-6)
+
+
+def test_scheduler_toposort_and_memory():
+    g = _core.NativeGraph()
+    # diamond: a -> b, c -> d ; buffers 0..4
+    g.add_node("a", [0], [1], [256])
+    g.add_node("b", [1], [2], [256])
+    g.add_node("c", [1], [3], [256])
+    g.add_node("d", [2, 3], [4], [256])
+    order = g.toposort()
+    assert order.index(0) < order.index(1) < order.index(3)
+    assert order.index(0) < order.index(2) < order.index(3)
+    arena, offsets = g.plan_memory()
+    assert arena > 0
+    # buffer 4 can reuse the arena slot of a dead buffer: arena must be
+    # smaller than sum of all buffers (5*256 aligned)
+    assert arena < 5 * 256
+    assert set(offsets) >= {1, 2, 3, 4}
+
+
+def test_scheduler_cycle_detection():
+    g = _core.NativeGraph()
+    g.add_node("a", [1], [0], [64])   # consumes b's output
+    g.add_node("b", [0], [1], [64])   # consumes a's output -> cycle
+    with pytest.raises(ValueError):
+        g.toposort()
+
+
+def test_native_loader_epoch():
+    rng = np.random.RandomState(3)
+    x = rng.randn(100, 4).astype(np.float32)
+    y = np.arange(100, dtype=np.int32)
+    ld = _core.NativeLoader(x, y, batch=32, shuffle=True, seed=7)
+    assert ld.batches_per_epoch == 4
+    seen = []
+    for _ in range(4):
+        bx, by = ld.next()
+        assert bx.shape[1:] == (4,)
+        seen.extend(by.tolist())
+    assert sorted(seen) == list(range(100))  # full epoch, no dup/loss
+    # samples must match their labels after shuffling
+    for i, lab in enumerate(by):
+        np.testing.assert_array_equal(bx[i], x[lab])
+    ld.close()
+
+
+def test_native_loader_multiworker_stress():
+    """Regression: lost-wakeup deadlock with workers>ring and multi-epoch
+    consistency under concurrent assembly (review finding)."""
+    rng = np.random.RandomState(4)
+    x = rng.randn(1000, 1).astype(np.float32)
+    y = np.arange(1000, dtype=np.int32)
+    ld = _core.NativeLoader(x, y, batch=32, shuffle=True, seed=0,
+                            workers=4, prefetch=4)
+    for epoch in range(3):
+        seen = []
+        for _ in range(ld.batches_per_epoch):
+            bx, by = ld.next()
+            seen.extend(by.tolist())
+            np.testing.assert_array_equal(bx[:, 0], x[by, 0])
+        assert sorted(seen) == list(range(1000)), f"epoch {epoch} incomplete"
+    ld.close()
+
+
+def test_dataloader_api_native_and_fallback():
+    from singa_tpu.utils.data import DataLoader
+    x = np.random.randn(50, 3).astype(np.float32)
+    y = np.arange(50, dtype=np.int32)
+    for use_native in (True, False):
+        dl = DataLoader(x, y, batch_size=16, seed=1, use_native=use_native)
+        got = []
+        for bx, by in dl:
+            got.extend(by.tolist())
+        assert sorted(got) == list(range(50))
+        dl.close()
+
+
+def test_pool_allocator():
+    l = _core.lib()
+    p = l.sg_pool_alloc(1000)
+    assert p
+    used0 = l.sg_pool_bytes_in_use()
+    l.sg_pool_free(p)
+    assert l.sg_pool_bytes_in_use() < used0
+    # reuse same bucket
+    p2 = l.sg_pool_alloc(1000)
+    assert p2 == p
+    l.sg_pool_free(p2)
+
+
+def test_native_dispatch_in_autograd():
+    """CppCPU(use_native=True) routes hot ops through tensor_math_cpp and
+    still produces correct gradients."""
+    from singa_tpu import autograd, device, tensor
+    dev = device.create_cpu_device(use_native=True)
+    device.set_default_device(dev)
+    autograd.set_training(True)
+    rng = np.random.RandomState(0)
+    A = rng.randn(8, 8).astype(np.float32)
+    W = tensor.Tensor(data=rng.randn(8, 4).astype(np.float32), device=dev,
+                      requires_grad=True, stores_grad=True)
+    x = tensor.from_numpy(A, dev)
+    y = autograd.relu(autograd.matmul(x, W))
+    loss = autograd.reduce_sum(y)
+    grads = autograd.backward(loss)
+    # reference gradient via numpy
+    pre = A @ W.to_numpy()
+    gw = A.T @ (np.ones_like(pre) * (pre > 0))
+    np.testing.assert_allclose(grads[0][1].to_numpy(), gw, rtol=1e-4, atol=1e-4)
+
+
+def test_captured_graph_native_schedule():
+    from singa_tpu import autograd, device, layer, model, opt, tensor
+
+    class M(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc = layer.Linear(8)
+
+        def forward(self, x):
+            return self.fc(x)
+
+        def train_one_batch(self, x, y):
+            out = self.forward(x)
+            loss = autograd.mse_loss(out, y)
+            self.optimizer.backward_and_update(loss)
+            return out, loss
+
+    m = M()
+    m.set_optimizer(opt.SGD(lr=0.1))
+    x = tensor.from_numpy(np.random.randn(4, 6).astype(np.float32))
+    y = tensor.from_numpy(np.random.randn(4, 8).astype(np.float32))
+    m.compile([x], is_train=True, use_graph=True)
+    m.train_step(x, y)
+    sched = m.graph.schedule()
+    assert sched.num_nodes > 5
+    assert sched.arena_bytes > 0
+    assert len(sched.order) == sched.num_nodes
