@@ -30,10 +30,9 @@ __all__ = ["device", "tensor", "autograd", "layer", "model", "opt",
 
 def __getattr__(name):
     # lazy: sonnx pulls in the onnx proto machinery, models pulls model zoo
-    if name == "sonnx":
-        from . import sonnx
-        return sonnx
-    if name == "models":
-        from . import models
-        return models
+    if name in ("sonnx", "models"):
+        import importlib
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
     raise AttributeError(name)

@@ -1,0 +1,18 @@
+"""Shared model bases for the zoo."""
+
+from __future__ import annotations
+
+from .. import autograd, model
+
+__all__ = ["Classifier"]
+
+
+class Classifier(model.Model):
+    """Canonical classification step (reference examples/cnn model.py):
+    forward → softmax-cross-entropy → opt(loss)."""
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = autograd.softmax_cross_entropy(out, y)
+        self.optimizer(loss)
+        return out, loss

@@ -1,0 +1,104 @@
+"""CNN family — the reference examples/cnn small models (BASELINE.json:7-8).
+
+Data format is NHWC throughout (TPU-native; XLA tiles the channel-last
+conv directly onto the MXU).  Models accept NHWC input; pass
+``data_format="NCHW"`` for reference/ONNX-layout inputs.
+"""
+
+from __future__ import annotations
+
+from .. import layer
+from ._base import Classifier
+
+__all__ = ["CNN", "LeNet5", "AlexNet", "create_model"]
+
+
+class CNN(Classifier):
+    """The reference's simple MNIST CNN: two conv+pool blocks + two FC."""
+
+    def __init__(self, num_classes: int = 10, data_format: str = "NHWC"):
+        super().__init__()
+        df = data_format
+        self.conv1 = layer.Conv2d(32, 3, stride=1, padding=1, data_format=df)
+        self.relu1 = layer.ReLU()
+        self.pool1 = layer.MaxPool2d(2, 2, data_format=df)
+        self.conv2 = layer.Conv2d(64, 3, stride=1, padding=1, data_format=df)
+        self.relu2 = layer.ReLU()
+        self.pool2 = layer.MaxPool2d(2, 2, data_format=df)
+        self.flat = layer.Flatten()
+        self.fc1 = layer.Linear(128)
+        self.relu3 = layer.ReLU()
+        self.fc2 = layer.Linear(num_classes)
+
+    def forward(self, x):
+        x = self.pool1(self.relu1(self.conv1(x)))
+        x = self.pool2(self.relu2(self.conv2(x)))
+        x = self.relu3(self.fc1(self.flat(x)))
+        return self.fc2(x)
+
+
+class LeNet5(Classifier):
+    def __init__(self, num_classes: int = 10, data_format: str = "NHWC"):
+        super().__init__()
+        df = data_format
+        self.conv1 = layer.Conv2d(6, 5, padding=2, data_format=df)
+        self.pool1 = layer.AvgPool2d(2, 2, data_format=df)
+        self.conv2 = layer.Conv2d(16, 5, data_format=df)
+        self.pool2 = layer.AvgPool2d(2, 2, data_format=df)
+        self.act = layer.Tanh()
+        self.flat = layer.Flatten()
+        self.fc1 = layer.Linear(120)
+        self.fc2 = layer.Linear(84)
+        self.head = layer.Linear(num_classes)
+
+    def forward(self, x):
+        x = self.pool1(self.act(self.conv1(x)))
+        x = self.pool2(self.act(self.conv2(x)))
+        x = self.flat(x)
+        x = self.act(self.fc1(x))
+        x = self.act(self.fc2(x))
+        return self.head(x)
+
+
+class AlexNet(Classifier):
+    """AlexNet sized for 224x224 inputs (reference examples/cnn alexnet)."""
+
+    def __init__(self, num_classes: int = 1000, data_format: str = "NHWC",
+                 dropout: float = 0.5):
+        super().__init__()
+        df = data_format
+        self.features = layer.Sequential(
+            layer.Conv2d(64, 11, stride=4, padding=2, data_format=df),
+            layer.ReLU(),
+            layer.MaxPool2d(3, 2, data_format=df),
+            layer.Conv2d(192, 5, padding=2, data_format=df),
+            layer.ReLU(),
+            layer.MaxPool2d(3, 2, data_format=df),
+            layer.Conv2d(384, 3, padding=1, data_format=df),
+            layer.ReLU(),
+            layer.Conv2d(256, 3, padding=1, data_format=df),
+            layer.ReLU(),
+            layer.Conv2d(256, 3, padding=1, data_format=df),
+            layer.ReLU(),
+            layer.MaxPool2d(3, 2, data_format=df),
+        )
+        self.flat = layer.Flatten()
+        self.drop1 = layer.Dropout(dropout)
+        self.fc1 = layer.Linear(4096)
+        self.relu1 = layer.ReLU()
+        self.drop2 = layer.Dropout(dropout)
+        self.fc2 = layer.Linear(4096)
+        self.relu2 = layer.ReLU()
+        self.head = layer.Linear(num_classes)
+
+    def forward(self, x):
+        x = self.flat(self.features(x))
+        x = self.relu1(self.fc1(self.drop1(x)))
+        x = self.relu2(self.fc2(self.drop2(x)))
+        return self.head(x)
+
+
+def create_model(model_name: str = "cnn", **kwargs):
+    """Reference factory (examples/cnn/train_cnn.py model selection)."""
+    zoo = {"cnn": CNN, "lenet": LeNet5, "alexnet": AlexNet}
+    return zoo[model_name.lower()](**kwargs)

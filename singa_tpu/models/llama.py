@@ -1,0 +1,159 @@
+"""Llama-3 family — the flagship stretch workload (BASELINE.json:11:
+"stretch singa.autograd + Graph scheduler to a modern LLM").
+
+Architecture: pre-RMSNorm decoder blocks, rotary position embeddings,
+grouped-query attention (n_kv_heads < n_heads), SwiGLU FFN, untied LM
+head — all expressed through singa_tpu.autograd operators so the whole
+training step (fwd + bwd + optim + collectives) compiles into one XLA
+module.
+
+Scaling design (task directive: multi-chip via jax.sharding.Mesh):
+SHARD_RULES gives 2-D parallelism out of the box —
+  * 'data' axis: batch sharding (DP) via DistOpt/graph executor;
+  * 'model' axis: Megatron TP — qkv/gate/up column-parallel, o/down
+    row-parallel, embeddings + head vocab/hidden sharded;
+  * 'seq' axis: sequence sharding of activations for long context
+    (ring attention lives in singa_tpu.ops.ring_attention).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import autograd, layer, model
+from ..ops import rope as rope_ops
+from ..ops import attention as attn_ops
+from ..tensor import Tensor
+from .transformer import next_token_loss
+
+__all__ = ["LlamaConfig", "Llama", "LLAMA_SHARD_RULES"]
+
+LLAMA_SHARD_RULES = [
+    (r"(q_proj|k_proj|v_proj|gate|up)\.W$", (None, "model")),
+    (r"(o_proj|down)\.W$", ("model", None)),
+    (r"tok_emb\.table$", (None, "model")),
+    (r"lm_head\.W$", (None, "model")),
+]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    ffn_dim: int = 14336
+    max_position: int = 8192
+    rope_theta: float = 500000.0
+    eps: float = 1e-5
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny() -> "LlamaConfig":
+        return LlamaConfig(vocab_size=256, dim=64, num_layers=2,
+                           num_heads=4, num_kv_heads=2, ffn_dim=128,
+                           max_position=128, rope_theta=10000.0)
+
+    @staticmethod
+    def small() -> "LlamaConfig":
+        """~110M-param config for single-chip benchmarking."""
+        return LlamaConfig(vocab_size=32000, dim=768, num_layers=12,
+                           num_heads=12, num_kv_heads=4, ffn_dim=2048,
+                           max_position=2048)
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.num_heads
+
+
+class _LlamaAttention(layer.Layer):
+    def __init__(self, cfg: LlamaConfig, name=None):
+        super().__init__(name)
+        c = cfg
+        self.cfg = c
+        self.q_proj = layer.Linear(c.num_heads * c.head_dim, bias=False)
+        self.k_proj = layer.Linear(c.num_kv_heads * c.head_dim, bias=False)
+        self.v_proj = layer.Linear(c.num_kv_heads * c.head_dim, bias=False)
+        self.o_proj = layer.Linear(c.dim, bias=False)
+        self._rope = rope_ops.rope_frequencies(c.head_dim, c.max_position,
+                                               c.rope_theta)
+
+    def forward(self, x: Tensor) -> Tensor:
+        c = self.cfg
+        B, T, _ = x.shape
+        cos, sin = self._rope
+        q = self.q_proj(x).reshape((B, T, c.num_heads, c.head_dim))
+        k = self.k_proj(x).reshape((B, T, c.num_kv_heads, c.head_dim))
+        v = self.v_proj(x).reshape((B, T, c.num_kv_heads, c.head_dim))
+        q = rope_ops.apply_rope(q, cos, sin)
+        k = rope_ops.apply_rope(k, cos, sin)
+        o = attn_ops.attention(q, k, v, causal=True)
+        return self.o_proj(o.reshape((B, T, c.num_heads * c.head_dim)))
+
+
+class _SwiGLU(layer.Layer):
+    def __init__(self, cfg: LlamaConfig, name=None):
+        super().__init__(name)
+        self.gate = layer.Linear(cfg.ffn_dim, bias=False)
+        self.up = layer.Linear(cfg.ffn_dim, bias=False)
+        self.down = layer.Linear(cfg.dim, bias=False)
+
+    def forward(self, x):
+        return self.down(autograd.silu(self.gate(x)) * self.up(x))
+
+
+class _LlamaBlock(layer.Layer):
+    def __init__(self, cfg: LlamaConfig, name=None):
+        super().__init__(name)
+        self.attn_norm = layer.RMSNorm(cfg.dim, eps=cfg.eps)
+        self.attn = _LlamaAttention(cfg)
+        self.ffn_norm = layer.RMSNorm(cfg.dim, eps=cfg.eps)
+        self.ffn = _SwiGLU(cfg)
+
+    def forward(self, x):
+        x = x + self.attn(self.attn_norm(x))
+        x = x + self.ffn(self.ffn_norm(x))
+        return x
+
+
+class Llama(model.Model):
+    SHARD_RULES = LLAMA_SHARD_RULES
+
+    def __init__(self, cfg: Optional[LlamaConfig] = None, **kw):
+        super().__init__()
+        self.cfg = cfg or LlamaConfig(**kw)
+        c = self.cfg
+        self.tok_emb = layer.Embedding(c.vocab_size, c.dim)
+        self.blocks = [_LlamaBlock(c) for _ in range(c.num_layers)]
+        self.norm_f = layer.RMSNorm(c.dim, eps=c.eps)
+        self.lm_head = layer.Linear(c.vocab_size, bias=False)
+
+    def forward(self, ids: Tensor) -> Tensor:
+        x = self.tok_emb(ids)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.lm_head(self.norm_f(x))
+
+    def train_one_batch(self, ids: Tensor, labels: Optional[Tensor] = None):
+        logits = self.forward(ids)
+        loss = next_token_loss(logits, labels if labels is not None else ids)
+        self.optimizer(loss)
+        return logits, loss
+
+    def num_params(self) -> int:
+        return sum(p.size for p in self.get_params().values())
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Training FLOPs/token ≈ 6N + 12·L·dim·T (qk^T and probs·v matmuls
+        fwd+bwd at sequence length T) — honest MFU accounting,
+        SURVEY.md §7.3 item 6."""
+        n = self.num_params()
+        c = self.cfg
+        return 6 * n + 12 * c.num_layers * c.dim * seq_len

@@ -1,0 +1,46 @@
+"""MLP — the reference smoke-test workload (examples/mlp on CppCPU,
+BASELINE.json:7)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from .. import autograd, layer, model
+
+__all__ = ["MLP", "create_model"]
+
+
+class MLP(model.Model):
+    """Configurable fully-connected classifier.
+
+    Reference shape: examples/mlp/model.py — stacked Linear+ReLU with a
+    softmax-cross-entropy head and the canonical train_one_batch body.
+    """
+
+    def __init__(self, perceptron_size: Sequence[int] = (100,),
+                 num_classes: int = 10):
+        super().__init__()
+        if isinstance(perceptron_size, int):
+            perceptron_size = (perceptron_size,)
+        self.hidden = [layer.Linear(h) for h in perceptron_size]
+        self.acts = [layer.ReLU() for _ in perceptron_size]
+        self.head = layer.Linear(num_classes)
+        self.num_classes = num_classes
+
+    def forward(self, x):
+        if x.ndim > 2:
+            x = autograd.flatten(x, 1)
+        for fc, act in zip(self.hidden, self.acts):
+            x = act(fc(x))
+        return self.head(x)
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = autograd.softmax_cross_entropy(out, y)
+        self.optimizer(loss)
+        return out, loss
+
+
+def create_model(pretrained: bool = False, **kwargs) -> MLP:
+    """Reference factory signature (examples/mlp)."""
+    return MLP(**kwargs)

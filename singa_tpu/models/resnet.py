@@ -1,0 +1,138 @@
+"""ResNet — the reference's headline CNN workloads: CIFAR ResNet-18 and
+ImageNet ResNet-50 (BASELINE.json:8,10).
+
+NHWC + HWIO kernels so every conv lands on the MXU without layout
+transposes; BatchNorm running stats thread functionally through the
+compiled step (singa_tpu.layer.BatchNorm2d).
+"""
+
+from __future__ import annotations
+
+from typing import List, Type
+
+from .. import layer
+from ._base import Classifier
+
+__all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
+           "resnet152", "create_model"]
+
+
+class BasicBlock(layer.Layer):
+    expansion = 1
+
+    def __init__(self, planes: int, stride: int = 1, downsample=None,
+                 name=None):
+        super().__init__(name)
+        self.conv1 = layer.Conv2d(planes, 3, stride=stride, padding=1,
+                                  bias=False)
+        self.bn1 = layer.BatchNorm2d(planes)
+        self.conv2 = layer.Conv2d(planes, 3, stride=1, padding=1, bias=False)
+        self.bn2 = layer.BatchNorm2d(planes)
+        self.relu = layer.ReLU()
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x if self.downsample is None else self.downsample(x)
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        return self.relu(out + identity)
+
+
+class Bottleneck(layer.Layer):
+    expansion = 4
+
+    def __init__(self, planes: int, stride: int = 1, downsample=None,
+                 name=None):
+        super().__init__(name)
+        self.conv1 = layer.Conv2d(planes, 1, bias=False)
+        self.bn1 = layer.BatchNorm2d(planes)
+        self.conv2 = layer.Conv2d(planes, 3, stride=stride, padding=1,
+                                  bias=False)
+        self.bn2 = layer.BatchNorm2d(planes)
+        self.conv3 = layer.Conv2d(planes * 4, 1, bias=False)
+        self.bn3 = layer.BatchNorm2d(planes * 4)
+        self.relu = layer.ReLU()
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x if self.downsample is None else self.downsample(x)
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        return self.relu(out + identity)
+
+
+def _downsample(planes: int, stride: int) -> layer.Layer:
+    return layer.Sequential(
+        layer.Conv2d(planes, 1, stride=stride, bias=False),
+        layer.BatchNorm2d(planes))
+
+
+class ResNet(Classifier):
+    """ResNet with ImageNet (7x7 s2 + maxpool) or CIFAR (3x3 s1) stem."""
+
+    def __init__(self, block: Type, layers: List[int],
+                 num_classes: int = 1000, cifar_stem: bool = False):
+        super().__init__()
+        self.cifar_stem = cifar_stem
+        if cifar_stem:
+            self.conv1 = layer.Conv2d(64, 3, stride=1, padding=1, bias=False)
+        else:
+            self.conv1 = layer.Conv2d(64, 7, stride=2, padding=3, bias=False)
+            self.maxpool = layer.MaxPool2d(3, 2, padding=1)
+        self.bn1 = layer.BatchNorm2d(64)
+        self.relu = layer.ReLU()
+        self._in_planes = 64
+        self.layer1 = self._make_layer(block, 64, layers[0], 1)
+        self.layer2 = self._make_layer(block, 128, layers[1], 2)
+        self.layer3 = self._make_layer(block, 256, layers[2], 2)
+        self.layer4 = self._make_layer(block, 512, layers[3], 2)
+        self.avgpool = layer.GlobalAvgPool2d()
+        self.fc = layer.Linear(num_classes)
+
+    def _make_layer(self, block, planes, blocks, stride) -> layer.Layer:
+        out_c = planes * block.expansion
+        # projection shortcut only when the residual shape changes
+        # (canonical ResNet: layer1 of 18/34 keeps the identity)
+        ds = (_downsample(out_c, stride)
+              if stride != 1 or self._in_planes != out_c else None)
+        stages = [block(planes, stride, ds)]
+        for _ in range(1, blocks):
+            stages.append(block(planes, 1, None))
+        self._in_planes = out_c
+        return layer.Sequential(*stages)
+
+    def forward(self, x):
+        x = self.relu(self.bn1(self.conv1(x)))
+        if not self.cifar_stem:
+            x = self.maxpool(x)
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        return self.fc(self.avgpool(x))
+
+
+def resnet18(num_classes=10, cifar_stem=True) -> ResNet:
+    """CIFAR ResNet-18 by default (the BASELINE.json:8 config)."""
+    return ResNet(BasicBlock, [2, 2, 2, 2], num_classes, cifar_stem)
+
+
+def resnet34(num_classes=1000, cifar_stem=False) -> ResNet:
+    return ResNet(BasicBlock, [3, 4, 6, 3], num_classes, cifar_stem)
+
+
+def resnet50(num_classes=1000, cifar_stem=False) -> ResNet:
+    """ImageNet ResNet-50 (the BASELINE.json:10 DP workload)."""
+    return ResNet(Bottleneck, [3, 4, 6, 3], num_classes, cifar_stem)
+
+
+def resnet101(num_classes=1000, cifar_stem=False) -> ResNet:
+    return ResNet(Bottleneck, [3, 4, 23, 3], num_classes, cifar_stem)
+
+
+def resnet152(num_classes=1000, cifar_stem=False) -> ResNet:
+    return ResNet(Bottleneck, [3, 8, 36, 3], num_classes, cifar_stem)
+
+
+def create_model(model_name: str = "resnet18", **kwargs) -> ResNet:
+    zoo = {"resnet18": resnet18, "resnet34": resnet34, "resnet50": resnet50,
+           "resnet101": resnet101, "resnet152": resnet152}
+    return zoo[model_name.lower()](**kwargs)

@@ -1,0 +1,241 @@
+"""Transformer family — GPT-2 and BERT-base, the reference's ONNX-zoo
+workloads (BASELINE.json:9).
+
+TPU-first notes:
+  * attention routes through singa_tpu.ops.attention (Pallas flash path
+    for long sequences, fused-einsum path otherwise);
+  * weights are f32 masters cast to the input compute dtype (bf16 on
+    TPU) at use — the MXU path;
+  * each model exports SHARD_RULES: (regex over param path → partition
+    spec tuple) giving Megatron-style tensor parallelism over the
+    'model' mesh axis when a multi-axis mesh is installed.  Column
+    parallel for qkv/up projections, row parallel for out/down, so each
+    block needs exactly one all-reduce pair — inserted by GSPMD, ridden
+    over ICI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import autograd, layer, model
+from ..tensor import Tensor
+
+__all__ = ["GPT2Config", "GPT2", "BERTConfig", "BERT",
+           "TRANSFORMER_SHARD_RULES"]
+
+# Megatron-style TP layout over the 'model' axis; the executor matches
+# param paths against these regexes (first hit wins) and drops axes the
+# installed mesh doesn't have.
+TRANSFORMER_SHARD_RULES = [
+    (r"(q_proj|k_proj|v_proj|c_fc|fc_in|gate|up)\.W$", (None, "model")),
+    (r"(q_proj|k_proj|v_proj|c_fc|fc_in|gate|up)\.b$", ("model",)),
+    (r"(out_proj|c_proj|fc_out|down)\.W$", ("model", None)),
+    (r"(wte|wpe|wtype|emb\w*)\.table$", (None, "model")),
+    (r"lm_head\.W$", (None, "model")),
+]
+
+
+def _positions(ids: Tensor) -> Tensor:
+    T = ids.shape[-1]
+    pos = jnp.arange(T, dtype=jnp.int32)[None, :]
+    return Tensor(data=jnp.broadcast_to(pos, ids.shape), device=ids.device,
+                  requires_grad=False)
+
+
+def _padding_mask(attention_mask: Optional[Tensor]):
+    """(B, T) 1/0 mask → (B, 1, 1, T) bool broadcastable over heads/queries."""
+    if attention_mask is None:
+        return None
+    am = attention_mask.data if isinstance(attention_mask, Tensor) \
+        else jnp.asarray(attention_mask)
+    return (am > 0)[:, None, None, :]
+
+
+class _MLP(layer.Layer):
+    def __init__(self, hidden: int, act: str = "gelu", name=None):
+        super().__init__(name)
+        self.c_fc = layer.Linear(hidden)
+        self.act = layer.Gelu() if act == "gelu" else layer.ReLU()
+        self.c_proj: Optional[layer.Layer] = None
+        self._out: Optional[int] = None
+
+    def initialize(self, x):
+        self._out = x.shape[-1]
+        self.c_proj = layer.Linear(self._out)
+
+    def forward(self, x):
+        return self.c_proj(self.act(self.c_fc(x)))
+
+
+# ---------------------------------------------------------------------------
+# GPT-2
+# ---------------------------------------------------------------------------
+
+@dataclass
+class GPT2Config:
+    vocab_size: int = 50257
+    max_position: int = 1024
+    dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    dropout: float = 0.1
+
+    @staticmethod
+    def tiny() -> "GPT2Config":
+        return GPT2Config(vocab_size=256, max_position=64, dim=64,
+                          num_layers=2, num_heads=4, dropout=0.0)
+
+
+class _GPT2Block(layer.Layer):
+    def __init__(self, cfg: GPT2Config, name=None):
+        super().__init__(name)
+        self.ln_1 = layer.LayerNorm(cfg.dim)
+        self.attn = layer.MultiHeadAttention(cfg.num_heads, cfg.dim,
+                                             causal=True)
+        self.ln_2 = layer.LayerNorm(cfg.dim)
+        self.mlp = _MLP(4 * cfg.dim, "gelu")
+        self.drop = layer.Dropout(cfg.dropout)
+
+    def forward(self, x, mask=None):
+        x = x + self.drop(self.attn(self.ln_1(x), mask))
+        x = x + self.drop(self.mlp(self.ln_2(x)))
+        return x
+
+
+class GPT2(model.Model):
+    """GPT-2 causal LM with tied embeddings (reference ONNX GPT-2,
+    BASELINE.json:9)."""
+
+    SHARD_RULES = TRANSFORMER_SHARD_RULES
+
+    def __init__(self, cfg: Optional[GPT2Config] = None, **kw):
+        super().__init__()
+        self.cfg = cfg or GPT2Config(**kw)
+        c = self.cfg
+        self.wte = layer.Embedding(c.vocab_size, c.dim)
+        self.wpe = layer.Embedding(c.max_position, c.dim)
+        self.drop = layer.Dropout(c.dropout)
+        self.blocks = [_GPT2Block(c) for _ in range(c.num_layers)]
+        self.ln_f = layer.LayerNorm(c.dim)
+
+    def forward(self, ids: Tensor, attention_mask: Optional[Tensor] = None):
+        mask = _padding_mask(attention_mask)
+        if mask is not None:
+            mask = Tensor(data=mask, device=ids.device, requires_grad=False)
+        x = self.wte(ids) + self.wpe(_positions(ids))
+        x = self.drop(x)
+        for blk in self.blocks:
+            x = blk(x, mask)
+        x = self.ln_f(x)
+        # tied LM head: logits = x @ wte.T
+        return autograd.matmul(x, autograd.transpose(self.wte.table))
+
+    def train_one_batch(self, ids: Tensor, labels: Optional[Tensor] = None):
+        logits = self.forward(ids)
+        loss = next_token_loss(logits, labels if labels is not None else ids)
+        self.optimizer(loss)
+        return logits, loss
+
+
+def next_token_loss(logits: Tensor, ids: Tensor) -> Tensor:
+    """Causal-LM loss: predict ids[t+1] from logits[t]."""
+    B, T, V = logits.shape
+    lg = autograd.reshape(logits[:, :-1, :], (B * (T - 1), V))
+    tg = Tensor(data=ids.data[:, 1:].reshape(-1), device=ids.device,
+                requires_grad=False)
+    return autograd.softmax_cross_entropy(lg, tg)
+
+
+# ---------------------------------------------------------------------------
+# BERT
+# ---------------------------------------------------------------------------
+
+@dataclass
+class BERTConfig:
+    vocab_size: int = 30522
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    dropout: float = 0.1
+    num_labels: Optional[int] = None  # optional classification head
+
+    @staticmethod
+    def tiny(num_labels: Optional[int] = None) -> "BERTConfig":
+        return BERTConfig(vocab_size=256, max_position=64, type_vocab_size=2,
+                          dim=64, num_layers=2, num_heads=4, dropout=0.0,
+                          num_labels=num_labels)
+
+
+class _BERTBlock(layer.Layer):
+    """Post-LN encoder block (original BERT layout)."""
+
+    def __init__(self, cfg: BERTConfig, name=None):
+        super().__init__(name)
+        self.attn = layer.MultiHeadAttention(cfg.num_heads, cfg.dim,
+                                             causal=False)
+        self.ln_1 = layer.LayerNorm(cfg.dim)
+        self.mlp = _MLP(4 * cfg.dim, "gelu")
+        self.ln_2 = layer.LayerNorm(cfg.dim)
+        self.drop = layer.Dropout(cfg.dropout)
+
+    def forward(self, x, mask=None):
+        x = self.ln_1(x + self.drop(self.attn(x, mask)))
+        x = self.ln_2(x + self.drop(self.mlp(x)))
+        return x
+
+
+class BERT(model.Model):
+    """BERT-base encoder (+pooler, optional classifier) — reference ONNX
+    BERT-base (BASELINE.json:9)."""
+
+    SHARD_RULES = TRANSFORMER_SHARD_RULES
+
+    def __init__(self, cfg: Optional[BERTConfig] = None, **kw):
+        super().__init__()
+        self.cfg = cfg or BERTConfig(**kw)
+        c = self.cfg
+        self.wte = layer.Embedding(c.vocab_size, c.dim)
+        self.wpe = layer.Embedding(c.max_position, c.dim)
+        self.wtype = layer.Embedding(c.type_vocab_size, c.dim)
+        self.ln_emb = layer.LayerNorm(c.dim)
+        self.drop = layer.Dropout(c.dropout)
+        self.blocks = [_BERTBlock(c) for _ in range(c.num_layers)]
+        self.pooler = layer.Linear(c.dim)
+        self.pool_act = layer.Tanh()
+        self.classifier = (layer.Linear(c.num_labels)
+                           if c.num_labels else None)
+
+    def forward(self, ids: Tensor, token_type_ids: Optional[Tensor] = None,
+                attention_mask: Optional[Tensor] = None):
+        if token_type_ids is None:
+            token_type_ids = Tensor(
+                data=jnp.zeros(ids.shape, jnp.int32), device=ids.device,
+                requires_grad=False)
+        mask = _padding_mask(attention_mask)
+        if mask is not None:
+            mask = Tensor(data=mask, device=ids.device, requires_grad=False)
+        x = self.wte(ids) + self.wpe(_positions(ids)) + self.wtype(token_type_ids)
+        x = self.drop(self.ln_emb(x))
+        for blk in self.blocks:
+            x = blk(x, mask)
+        pooled = self.pool_act(self.pooler(x[:, 0, :]))
+        if self.classifier is not None:
+            return self.classifier(pooled)
+        return x, pooled
+
+    def train_one_batch(self, ids: Tensor, labels: Tensor,
+                        token_type_ids=None, attention_mask=None):
+        if self.classifier is None:
+            raise RuntimeError("BERT(num_labels=...) required for the "
+                               "canonical classification train step")
+        out = self.forward(ids, token_type_ids, attention_mask)
+        loss = autograd.softmax_cross_entropy(out, labels)
+        self.optimizer(loss)
+        return out, loss

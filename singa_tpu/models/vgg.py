@@ -1,0 +1,62 @@
+"""VGG — the reference CIFAR alternative backbone (BASELINE.json:8)."""
+
+from __future__ import annotations
+
+from typing import List, Union
+
+from .. import layer
+from ._base import Classifier
+
+__all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "create_model"]
+
+_CFGS = {
+    "vgg11": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "vgg13": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+              512, 512, "M"],
+    "vgg16": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+              "M", 512, 512, 512, "M"],
+    "vgg19": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512,
+              512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(Classifier):
+    def __init__(self, cfg: List[Union[int, str]], num_classes: int = 10,
+                 batch_norm: bool = True):
+        super().__init__()
+        blocks = []
+        for v in cfg:
+            if v == "M":
+                blocks.append(layer.MaxPool2d(2, 2))
+            else:
+                blocks.append(layer.Conv2d(v, 3, padding=1,
+                                           bias=not batch_norm))
+                if batch_norm:
+                    blocks.append(layer.BatchNorm2d(v))
+                blocks.append(layer.ReLU())
+        self.features = layer.Sequential(*blocks)
+        self.pool = layer.GlobalAvgPool2d()
+        self.head = layer.Linear(num_classes)
+
+    def forward(self, x):
+        return self.head(self.pool(self.features(x)))
+
+
+def vgg11(num_classes=10, batch_norm=True) -> VGG:
+    return VGG(_CFGS["vgg11"], num_classes, batch_norm)
+
+
+def vgg13(num_classes=10, batch_norm=True) -> VGG:
+    return VGG(_CFGS["vgg13"], num_classes, batch_norm)
+
+
+def vgg16(num_classes=10, batch_norm=True) -> VGG:
+    return VGG(_CFGS["vgg16"], num_classes, batch_norm)
+
+
+def vgg19(num_classes=10, batch_norm=True) -> VGG:
+    return VGG(_CFGS["vgg19"], num_classes, batch_norm)
+
+
+def create_model(model_name: str = "vgg16", **kwargs) -> VGG:
+    return VGG(_CFGS[model_name.lower()], **kwargs)

@@ -28,21 +28,45 @@ _FLASH_MIN_LEN = 512
 
 
 def _sdpa_reference(q, k, v, causal: bool, mask, scale: float):
-    # q,k,v: (B, T, H, D) — keep head dim last for MXU-friendly einsums
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    # q: (B, T, H, D); k/v: (B, T, K, D) with K | H (grouped-query attention
+    # when K < H — Llama-3 style).  Head dim kept last for MXU-friendly
+    # einsums; the group axis stays folded into one batched matmul.
+    H, K = q.shape[2], k.shape[2]
+    if K != H:
+        G = H // K
+        q = q.reshape(q.shape[:2] + (K, G, q.shape[-1]))
+        logits = jnp.einsum("bqkgd,bskd->bkgqs", q, k) * scale
+    else:
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    extra = logits.ndim - 2  # leading axes before (Tq, Tk)
     if causal:
         Tq, Tk = q.shape[1], k.shape[1]
         cm = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)
-        logits = jnp.where(cm[None, None], logits, jnp.finfo(logits.dtype).min)
+        cm = cm[(None,) * extra]
+        logits = jnp.where(cm, logits, jnp.finfo(logits.dtype).min)
     if mask is not None:
-        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+        m = jnp.asarray(mask)
+        if K != H and m.ndim == 4:
+            # user masks address (B, H|1, Tq|1, Ts); grouped logits are
+            # (B, K, G, Tq, Ts) — split the head axis so broadcasting can't
+            # silently land the batch dim on the kv-head axis
+            if m.shape[1] == H:
+                m = m.reshape(m.shape[0], K, G, *m.shape[2:])
+            else:
+                m = m[:, :, None]
+        logits = jnp.where(m, logits, jnp.finfo(logits.dtype).min)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if K != H:
+        out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v)
+        return out.reshape(out.shape[:2] + (H, out.shape[-1]))
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _use_flash(q) -> bool:
+def _use_flash(q, k=None) -> bool:
     if q.shape[1] < _FLASH_MIN_LEN:
         return False
+    if k is not None and k.shape[2] != q.shape[2]:
+        return False  # GQA routes through the grouped einsum path for now
     platform = jax.devices()[0].platform
     return platform in ("tpu", "axon")
 
@@ -56,7 +80,7 @@ class SDPA(autograd.Operator):
 
     def fwd(self, q, k, v):
         scale = self.scale or (1.0 / math.sqrt(q.shape[-1]))
-        if self.mask is None and _use_flash(q):
+        if self.mask is None and _use_flash(q, k):
             from .flash_attention import flash_attention
             return flash_attention(q, k, v, causal=self.causal, scale=scale)
         return _sdpa_reference(q, k, v, self.causal, self.mask, scale)
@@ -73,7 +97,7 @@ def attention(q: Tensor, k: Tensor, v: Tensor, causal: bool = False,
 def sdpa(q, k, v, causal=False, mask=None, scale=None):
     """Raw-array entry point used by models bypassing the tape."""
     scale = scale or (1.0 / math.sqrt(q.shape[-1]))
-    if mask is None and _use_flash(q):
+    if mask is None and _use_flash(q, k):
         from .flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal, scale=scale)
     return _sdpa_reference(q, k, v, causal, mask, scale)

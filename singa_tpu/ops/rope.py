@@ -3,6 +3,8 @@
 
 from __future__ import annotations
 
+import functools
+
 import jax.numpy as jnp
 
 from .. import autograd
@@ -11,8 +13,12 @@ from ..tensor import Tensor
 __all__ = ["rope_frequencies", "apply_rope"]
 
 
+@functools.lru_cache(maxsize=32)
 def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0):
-    """Precompute (cos, sin) tables of shape (max_len, head_dim//2)."""
+    """Precompute (cos, sin) tables of shape (max_len, head_dim//2).
+
+    Cached so every attention layer of a model shares one table pair
+    instead of baking per-layer copies into the compiled module."""
     inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
     t = jnp.arange(max_len, dtype=jnp.float32)
     freqs = jnp.outer(t, inv)

@@ -339,6 +339,11 @@ class DistOpt(Optimizer):
                                     topk_ratio=self.topk_ratio)
 
     # -- reference API surface ------------------------------------------------
+    def __call__(self, loss: Tensor) -> None:
+        """`opt(loss)` must sync gradients exactly like backward_and_update —
+        regression guard: the base-class __call__ skips reduce_gradients."""
+        self.backward_and_update(loss)
+
     def backward_and_update(self, loss: Tensor) -> None:
         pg = autograd.backward(loss)
         grads = {(p.name or str(id(p))): g.data for p, g in pg}

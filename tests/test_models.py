@@ -1,0 +1,173 @@
+"""Model-zoo acceptance tests (tiny configs) — each family builds, runs
+forward, and takes compiled graph-mode training steps (the BASELINE
+workloads of SURVEY.md §2.2 rows 11-13 at toy scale)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import models, opt, tensor
+
+
+def _img_batch(n=4, hw=32, c=3):
+    return tensor.from_numpy(np.random.randn(n, hw, hw, c).astype(np.float32))
+
+
+def _labels(n=4, classes=10):
+    return tensor.from_numpy(np.random.randint(0, classes, n).astype(np.int32))
+
+
+def _ids(b=2, t=16, vocab=256):
+    return tensor.from_numpy(np.random.randint(0, vocab, (b, t)).astype(np.int32))
+
+
+def _train_steps(m, batch, steps=3):
+    m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+    m.compile([batch[0]], is_train=True, use_graph=True)
+    losses = []
+    for _ in range(steps):
+        _, loss = m.train_step(*batch)
+        losses.append(float(loss.to_numpy()))
+    assert all(np.isfinite(l) for l in losses), losses
+    return losses
+
+
+def test_mlp_zoo():
+    x = tensor.from_numpy(np.random.randn(8, 20).astype(np.float32))
+    y = _labels(8, 4)
+    m = models.MLP(perceptron_size=(16, 16), num_classes=4)
+    losses = _train_steps(m, (x, y), steps=8)
+    assert losses[-1] < losses[0]
+
+
+def test_cnn_zoo():
+    x = _img_batch(4, 28, 1)
+    y = _labels(4)
+    m = models.CNN()
+    _train_steps(m, (x, y))
+
+
+def test_lenet_forward():
+    x = _img_batch(2, 28, 1)
+    m = models.LeNet5()
+    m.compile([x], is_train=False, use_graph=False)
+    out = m(x)
+    assert out.shape == (2, 10)
+
+
+def test_resnet18_cifar_trains():
+    x = _img_batch(2, 32, 3)
+    y = _labels(2)
+    m = models.resnet18(num_classes=10)
+    _train_steps(m, (x, y), steps=2)
+
+
+def test_resnet50_forward():
+    x = _img_batch(2, 64, 3)  # reduced spatial dims; same graph as 224
+    m = models.resnet50(num_classes=10)
+    m.compile([x], is_train=False, use_graph=False)
+    out = m(x)
+    assert out.shape == (2, 10)
+    # bottleneck blocks: 1+3*(3+4+6+3)+fc layers worth of params
+    assert len(m.get_params()) > 100
+
+
+def test_vgg11_trains():
+    x = _img_batch(2, 32, 3)
+    y = _labels(2)
+    m = models.vgg11(num_classes=10)
+    _train_steps(m, (x, y), steps=2)
+
+
+def test_gpt2_tiny_trains():
+    ids = _ids()
+    m = models.GPT2(models.GPT2Config.tiny())
+    losses = _train_steps(m, (ids,), steps=5)
+    assert losses[-1] < losses[0]
+
+
+def test_gpt2_padding_mask():
+    cfg = models.GPT2Config.tiny()
+    m = models.GPT2(cfg)
+    ids = _ids(2, 8)
+    am = tensor.from_numpy(
+        np.array([[1] * 8, [1] * 5 + [0] * 3], np.int32))
+    m.compile([ids], is_train=False, use_graph=False)
+    out = m(ids, am)
+    assert out.shape == (2, 8, cfg.vocab_size)
+
+
+def test_bert_tiny_classifier_trains():
+    m = models.BERT(models.BERTConfig.tiny(num_labels=3))
+    ids = _ids(4, 12)
+    y = _labels(4, 3)
+    losses = _train_steps(m, (ids, y), steps=5)
+    assert losses[-1] < losses[0]
+
+
+def test_bert_encoder_outputs():
+    cfg = models.BERTConfig.tiny()
+    m = models.BERT(cfg)
+    ids = _ids(2, 10)
+    m.compile([ids], is_train=False, use_graph=False)
+    seq, pooled = m(ids)
+    assert seq.shape == (2, 10, cfg.dim)
+    assert pooled.shape == (2, cfg.dim)
+
+
+def test_llama_tiny_trains():
+    m = models.Llama(models.LlamaConfig.tiny())
+    ids = _ids(2, 16)
+    losses = _train_steps(m, (ids,), steps=5)
+    assert losses[-1] < losses[0]
+
+
+def test_llama_gqa_shapes():
+    cfg = models.LlamaConfig.tiny()
+    assert cfg.num_kv_heads < cfg.num_heads  # GQA actually exercised
+    m = models.Llama(cfg)
+    ids = _ids(2, 16)
+    m.compile([ids], is_train=False, use_graph=False)
+    out = m(ids)
+    assert out.shape == (2, 16, cfg.vocab_size)
+    assert m.num_params() > 0
+
+
+def test_gqa_padding_mask_matches_repeated_heads():
+    """GQA with an explicit (B,1,1,T) mask must equal full-head attention
+    with kv heads repeated (regression: mask broadcast onto kv-head axis)."""
+    import jax.numpy as jnp
+    from singa_tpu.ops.attention import _sdpa_reference
+
+    rng = np.random.RandomState(0)
+    B, T, H, K, D = 4, 8, 4, 2, 16
+    q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, T, K, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, T, K, D).astype(np.float32))
+    am = rng.randint(0, 2, (B, T)).astype(bool)
+    am[:, 0] = True  # keep at least one key
+    mask = am[:, None, None, :]
+    scale = 1.0 / np.sqrt(D)
+    out = _sdpa_reference(q, k, v, False, mask, scale)
+    k_full = jnp.repeat(k, H // K, axis=2)
+    v_full = jnp.repeat(v, H // K, axis=2)
+    # repeat_interleave matches the (K, G) grouping of the GQA einsum
+    ref = _sdpa_reference(q, k_full, v_full, False, mask, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_llama_graph_matches_eager():
+    def run(use_graph):
+        tensor.set_seed(11)
+        np.random.seed(11)
+        m = models.Llama(models.LlamaConfig.tiny())
+        ids = _ids(2, 16)
+        m.set_optimizer(opt.SGD(lr=0.05))
+        m.compile([ids], is_train=True, use_graph=use_graph)
+        out = []
+        for _ in range(3):
+            _, loss = m.train_step(ids)
+            out.append(float(loss.to_numpy()))
+        return out
+
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-4, atol=1e-5)
