@@ -285,13 +285,59 @@ class _StepExecutor:
         from .parallel import mesh as mesh_mod
 
         mesh = mesh_mod.current_mesh()
-        dist = (isinstance(self.opt, DistOpt) and mesh is not None
-                and self.opt.data_axis in mesh.shape)
+        data_axis = (self.opt.data_axis if isinstance(self.opt, DistOpt)
+                     else "data")
+        # multi-axis mesh (TP/SP alongside DP) → GSPMD: jit the global-
+        # semantics step with rule-derived param shardings and let XLA
+        # insert the collectives.  1-D data mesh + DistOpt → shard_map with
+        # explicit in-graph pmean (the reference Communicator path).
+        extra = [a for a, n in (mesh.shape.items() if mesh else [])
+                 if a != data_axis and n > 1]
+        gspmd = mesh is not None and bool(extra)
+        dist = (not gspmd and isinstance(self.opt, DistOpt)
+                and mesh is not None and data_axis in mesh.shape)
         self.dist = dist
-        self.mesh = mesh if dist else None
+        self.gspmd = gspmd
+        self.mesh = mesh if (dist or gspmd) else None
 
         def fn(params, buffers, slots, step, rng, *batch):
             return self._traced_step(params, buffers, slots, step, rng, batch)
+
+        if gspmd:
+            from .parallel import spmd
+            P = mesh_mod.P
+            if isinstance(self.opt, DistOpt) and (
+                    self.opt.compress_dtype is not None
+                    or self.opt.topk_ratio):
+                import warnings
+                warnings.warn(
+                    "DistOpt compressed/sparsified allreduce applies only on "
+                    "1-D data-parallel meshes (explicit in-graph pmean); on "
+                    "multi-axis meshes GSPMD chooses the collectives and "
+                    "these options are ignored", stacklevel=2)
+            rules = getattr(self.model, "SHARD_RULES", None)
+            rep = mesh_mod.NamedSharding(mesh, P())
+            p_arrays = {n: t.data for n, t in self.param_tensors.items()}
+            b_arrays = {n: t.data for n, t in self.buffer_tensors.items()}
+            self._param_sh = spmd.param_shardings(p_arrays, rules, mesh)
+            self._buffer_sh = {n: rep for n in b_arrays}
+            self._slot_sh = spmd.tree_shardings(self.slots, self._param_sh,
+                                                mesh)
+            self._rep_sh = rep
+            self._batch_sh = tuple(
+                mesh_mod.NamedSharding(
+                    mesh, spmd.batch_spec(a.shape, a.dtype, mesh, data_axis))
+                for a in example_arrays)
+            in_sh = (self._param_sh, self._buffer_sh, self._slot_sh, rep,
+                     rep) + self._batch_sh
+            # step outputs unconstrained; state pinned to its input
+            # shardings so donation reuses buffers and steady state never
+            # reshards
+            out_sh = (None, self._param_sh, self._buffer_sh, self._slot_sh)
+            self._jitted = jax.jit(fn, in_shardings=in_sh,
+                                   out_shardings=out_sh,
+                                   donate_argnums=(0, 1, 2))
+            return
 
         if dist:
             P = mesh_mod.P
@@ -314,8 +360,6 @@ class _StepExecutor:
             wrapped = fn
 
         self._jitted = jax.jit(wrapped, donate_argnums=(0, 1, 2))
-        # capture graph artifacts on first lowering
-        self._lowered = None
 
     def __call__(self, batch_arrays):
         m = self.model
@@ -325,6 +369,8 @@ class _StepExecutor:
             self.opt.step_counter if self.opt is not None else m._step_count,
             jnp.int32)
         rng = jax.random.fold_in(m._base_key, m._step_count)
+        place = lambda a, s: a if (hasattr(a, "sharding") and a.sharding == s) \
+            else jax.device_put(a, s)
         if self.dist:
             # place state replicated / batch data-sharded over the mesh the
             # step was compiled against; no-op after the first step
@@ -332,14 +378,23 @@ class _StepExecutor:
             from .parallel import mesh as mesh_mod
             rep = mesh_mod.NamedSharding(self.mesh, mesh_mod.P())
             shard = mesh_mod.NamedSharding(self.mesh, mesh_mod.P(self.opt.data_axis))
-            place = lambda a, s: a if (hasattr(a, "sharding") and a.sharding == s) \
-                else jax.device_put(a, s)
             params = {n: place(a, rep) for n, a in params.items()}
             buffers = {n: place(a, rep) for n, a in buffers.items()}
             self.slots = jax.tree.map(lambda a: place(a, rep), self.slots)
             step = place(step, rep)
             rng = place(rng, rep)
             batch_arrays = tuple(place(a, shard) for a in batch_arrays)
+        elif self.gspmd:
+            # place state/batch onto their rule-derived shardings; no-op
+            # after the first step
+            params = {n: place(a, self._param_sh[n]) for n, a in params.items()}
+            buffers = {n: place(a, self._buffer_sh[n]) for n, a in buffers.items()}
+            self.slots = {n: jax.tree.map(place, s, self._slot_sh[n])
+                          for n, s in self.slots.items()}
+            step = place(step, self._rep_sh)
+            rng = place(rng, self._rep_sh)
+            batch_arrays = tuple(place(a, s)
+                                 for a, s in zip(batch_arrays, self._batch_sh))
         if self.captured is None:
             lowered = self._jitted.lower(params, buffers, self.slots, step,
                                          rng, *batch_arrays)

@@ -21,11 +21,9 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
-import jax.numpy as jnp
-
 from .. import autograd, layer, model
 from ..ops import rope as rope_ops
-from ..ops import attention as attn_ops
+from ..ops.ring_attention import ring_attention
 from ..tensor import Tensor
 from .transformer import next_token_loss
 
@@ -94,7 +92,9 @@ class _LlamaAttention(layer.Layer):
         v = self.v_proj(x).reshape((B, T, c.num_kv_heads, c.head_dim))
         q = rope_ops.apply_rope(q, cos, sin)
         k = rope_ops.apply_rope(k, cos, sin)
-        o = attn_ops.attention(q, k, v, causal=True)
+        # ring attention when a 'seq' mesh axis is installed (cross-chip
+        # context parallelism); fused SDPA otherwise
+        o = ring_attention(q, k, v, causal=True)
         return self.o_proj(o.reshape((B, T, c.num_heads * c.head_dim)))
 
 

@@ -5,12 +5,13 @@ from __future__ import annotations
 
 from typing import Sequence
 
-from .. import autograd, layer, model
+from .. import autograd, layer
+from ._base import Classifier
 
 __all__ = ["MLP", "create_model"]
 
 
-class MLP(model.Model):
+class MLP(Classifier):
     """Configurable fully-connected classifier.
 
     Reference shape: examples/mlp/model.py — stacked Linear+ReLU with a
@@ -33,12 +34,6 @@ class MLP(model.Model):
         for fc, act in zip(self.hidden, self.acts):
             x = act(fc(x))
         return self.head(x)
-
-    def train_one_batch(self, x, y):
-        out = self.forward(x)
-        loss = autograd.softmax_cross_entropy(out, y)
-        self.optimizer(loss)
-        return out, loss
 
 
 def create_model(pretrained: bool = False, **kwargs) -> MLP:

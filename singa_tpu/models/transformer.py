@@ -20,7 +20,6 @@ from dataclasses import dataclass
 from typing import Optional
 
 import jax.numpy as jnp
-import numpy as np
 
 from .. import autograd, layer, model
 from ..tensor import Tensor

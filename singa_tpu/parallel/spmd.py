@@ -1,0 +1,86 @@
+"""GSPMD sharding-rule machinery — multi-axis parallelism (DP × TP × SP).
+
+The reference's only strategy is allreduce data-parallelism
+(BASELINE.json:5); scaling past one chip's HBM (the Llama stretch,
+BASELINE.json:11) is done the TPU way instead of new runtime machinery:
+params get PartitionSpecs from per-model rules (regex over the param
+path), the whole captured training step is jitted with those shardings,
+and XLA/GSPMD inserts the collectives over ICI.
+
+Rules format (see models.transformer.TRANSFORMER_SHARD_RULES):
+    [(regex, spec_tuple), ...]   e.g. (r"q_proj\\.W$", (None, "model"))
+First matching rule wins; axes that the installed mesh lacks, or that
+don't divide the corresponding dim, are dropped (replicated) — so one
+rule set serves 1-D DP, 2-D DP×TP, and 3-D DP×TP×SP meshes unchanged.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from .mesh import Mesh, NamedSharding, P
+
+__all__ = ["spec_for", "param_shardings", "batch_spec", "tree_shardings"]
+
+
+def spec_for(name: str, shape: Sequence[int], rules, mesh: Mesh) -> P:
+    """PartitionSpec for a param path under `rules`, pruned to `mesh`."""
+    if not rules:
+        return P()
+    for pat, spec in rules:
+        if re.search(pat, name):
+            axes = []
+            for i, ax in enumerate(spec):
+                if (ax is not None and ax in mesh.shape and i < len(shape)
+                        and shape[i] % mesh.shape[ax] == 0
+                        and shape[i] >= mesh.shape[ax]):
+                    axes.append(ax)
+                else:
+                    axes.append(None)
+            while axes and axes[-1] is None:
+                axes.pop()
+            return P(*axes)
+    return P()
+
+
+def param_shardings(params: Dict[str, "jax.Array"], rules,
+                    mesh: Mesh) -> Dict[str, NamedSharding]:
+    return {n: NamedSharding(mesh, spec_for(n, p.shape, rules, mesh))
+            for n, p in params.items()}
+
+
+def batch_spec(shape: Sequence[int], dtype, mesh: Mesh,
+               data_axis: str = "data", seq_axis: str = "seq") -> P:
+    """Input-batch spec: dim 0 over the data axis; for token-id arrays
+    (2-D integer), dim 1 additionally over the seq axis — GSPMD-style
+    sequence parallelism for long context."""
+    axes: List[Optional[str]] = []
+    if (shape and data_axis in mesh.shape
+            and shape[0] % mesh.shape[data_axis] == 0):
+        axes.append(data_axis)
+    else:
+        axes.append(None)
+    import numpy as np
+    if (len(shape) == 2 and np.issubdtype(np.dtype(dtype), np.integer)
+            and seq_axis in mesh.shape
+            and shape[1] % mesh.shape[seq_axis] == 0):
+        axes.append(seq_axis)
+    while axes and axes[-1] is None:
+        axes.pop()
+    return P(*axes)
+
+
+def tree_shardings(tree, name_to_sharding: Dict[str, NamedSharding],
+                   mesh: Mesh):
+    """Map a {name: slot-pytree} dict (optimizer state) to shardings:
+    every leaf under `name` shares the param's sharding when shapes
+    match, else is replicated."""
+    rep = NamedSharding(mesh, P())
+    out = {}
+    for name, sub in tree.items():
+        sh = name_to_sharding.get(name, rep)
+        out[name] = jax.tree.map(lambda leaf, sh=sh: sh, sub)
+    return out

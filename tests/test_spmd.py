@@ -1,0 +1,161 @@
+"""Multi-axis GSPMD parallelism tests on the 8-device virtual CPU mesh:
+DP×TP×SP shardings of whole training steps, ring attention equivalence
+(sequence parallelism), shard-rule pruning."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from singa_tpu import models, opt, parallel, tensor
+from singa_tpu.parallel import spmd
+from singa_tpu.parallel.mesh import P
+
+
+def _ids(b=4, t=16, vocab=256, seed=0):
+    rng = np.random.RandomState(seed)
+    return tensor.from_numpy(rng.randint(0, vocab, (b, t)).astype(np.int32))
+
+
+def _run_llama(mesh_axes, steps=4, seed=5):
+    tensor.set_seed(seed)
+    np.random.seed(seed)
+    parallel.set_mesh(parallel.make_mesh(mesh_axes) if mesh_axes else None)
+    m = models.Llama(models.LlamaConfig.tiny())
+    base = opt.SGD(lr=0.1)
+    m.set_optimizer(opt.DistOpt(base) if mesh_axes else base)
+    ids = _ids()
+    m.compile([ids], is_train=True, use_graph=True)
+    losses = [float(m.train_step(ids)[1].to_numpy()) for _ in range(steps)]
+    execu = next(iter(m._executors.values()))
+    parallel.set_mesh(None)
+    return m, losses, execu
+
+
+def test_spec_for_rules_pruning():
+    mesh = parallel.make_mesh({"data": 2, "model": 4})
+    rules = [(r"q_proj\.W$", (None, "model")), (r"o_proj\.W$", ("model", None))]
+    # model axis divides 8 → kept
+    assert spec_for_tuple("blk.q_proj.W", (16, 8), rules, mesh) == (None, "model")
+    # axis doesn't divide dim → dropped
+    assert spec_for_tuple("blk.q_proj.W", (16, 6), rules, mesh) == ()
+    # axis absent from mesh → dropped
+    mesh1 = parallel.make_mesh({"data": 8})
+    assert spec_for_tuple("blk.o_proj.W", (8, 8), rules, mesh1) == ()
+    # unmatched name → replicated
+    assert spec_for_tuple("norm.gamma", (8,), rules, mesh) == ()
+
+
+def spec_for_tuple(name, shape, rules, mesh):
+    return tuple(spmd.spec_for(name, shape, rules, mesh))
+
+
+def test_batch_spec():
+    mesh = parallel.make_mesh({"data": 2, "seq": 4})
+    assert tuple(spmd.batch_spec((8, 16), np.int32, mesh)) == ("data", "seq")
+    assert tuple(spmd.batch_spec((8, 16), np.float32, mesh)) == ("data",)
+    assert tuple(spmd.batch_spec((7, 16), np.int32, mesh)) == (None, "seq")
+
+
+def test_llama_dp_tp_matches_single():
+    _, single, _ = _run_llama(None)
+    _, multi, ex = _run_llama({"data": 2, "model": 4})
+    assert ex.gspmd
+    np.testing.assert_allclose(multi, single, rtol=2e-4, atol=1e-5)
+
+
+def test_llama_dp_tp_sp_matches_single():
+    _, single, _ = _run_llama(None)
+    _, multi, ex = _run_llama({"data": 2, "model": 2, "seq": 2})
+    assert ex.gspmd
+    np.testing.assert_allclose(multi, single, rtol=2e-4, atol=1e-5)
+
+
+def test_tp_param_actually_sharded():
+    m, _, ex = _run_llama({"data": 2, "model": 4}, steps=1)
+    name = next(n for n in ex.param_tensors if n.endswith("q_proj.W"))
+    sh = ex._param_sh[name]
+    assert tuple(sh.spec) == (None, "model")
+    # the live array carries the sharding after a step
+    arr = ex.param_tensors[name].data
+    assert arr.sharding.spec == sh.spec
+
+
+def test_gpt2_tp_matches_single():
+    def run(mesh_axes):
+        tensor.set_seed(3)
+        np.random.seed(3)
+        parallel.set_mesh(parallel.make_mesh(mesh_axes) if mesh_axes else None)
+        m = models.GPT2(models.GPT2Config.tiny())
+        base = opt.SGD(lr=0.1)
+        m.set_optimizer(opt.DistOpt(base) if mesh_axes else base)
+        ids = _ids(4, 16)
+        m.compile([ids], is_train=True, use_graph=True)
+        out = [float(m.train_step(ids)[1].to_numpy()) for _ in range(3)]
+        parallel.set_mesh(None)
+        return out
+
+    np.testing.assert_allclose(run({"data": 2, "model": 4}), run(None),
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_ring_attention_matches_sdpa():
+    from singa_tpu.ops.attention import _sdpa_reference
+    from singa_tpu.ops.ring_attention import ring_attention_local
+
+    mesh = parallel.make_mesh({"seq": 8})
+    rng = np.random.RandomState(0)
+    B, T, H, D = 2, 32, 4, 8
+    q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    scale = 1.0 / np.sqrt(D)
+
+    for causal in (True, False):
+        ref = _sdpa_reference(q, k, v, causal, None, scale)
+        f = jax.shard_map(
+            lambda a, b, c: ring_attention_local(a, b, c, "seq", causal, scale),
+            mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+            out_specs=P(None, "seq"), check_vma=False)
+        out = f(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5, err_msg=f"causal={causal}")
+
+
+def test_ring_attention_grads_match():
+    from singa_tpu.ops.attention import _sdpa_reference
+    from singa_tpu.ops.ring_attention import ring_attention_local
+
+    mesh = parallel.make_mesh({"seq": 4})
+    rng = np.random.RandomState(1)
+    B, T, H, D = 1, 16, 2, 4
+    q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    scale = 1.0 / np.sqrt(D)
+
+    ring = jax.shard_map(
+        lambda a, b, c: ring_attention_local(a, b, c, "seq", True, scale),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"), check_vma=False)
+
+    g_ring = jax.grad(lambda a, b, c: jnp.sum(ring(a, b, c) ** 2), (0, 1, 2))(q, k, v)
+    g_ref = jax.grad(lambda a, b, c: jnp.sum(
+        _sdpa_reference(a, b, c, True, None, scale) ** 2), (0, 1, 2))(q, k, v)
+    for gr, gf in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_ring_attention_op_fallback_no_mesh():
+    """ring_attention on Tensors without a seq mesh = fused SDPA path."""
+    from singa_tpu.ops.ring_attention import ring_attention
+    from singa_tpu.ops import attention as attn_ops
+
+    rng = np.random.RandomState(2)
+    q = tensor.from_numpy(rng.randn(2, 8, 4, 8).astype(np.float32))
+    k = tensor.from_numpy(rng.randn(2, 8, 2, 8).astype(np.float32))
+    v = tensor.from_numpy(rng.randn(2, 8, 2, 8).astype(np.float32))
+    out = ring_attention(q, k, v, causal=True)
+    ref = attn_ops.attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out.to_numpy(), ref.to_numpy(), rtol=1e-5)
