@@ -216,10 +216,16 @@ class _StepExecutor:
     def _traced_step(self, params, buffers, slots, step, rng, batch):
         model, opt = self.model, self.opt
         # bind state into the live tensor objects
+        from .parallel import mesh as mesh_mod
         saved_key = tensor_mod._rng_key
         tensor_mod._rng_key = rng
         saved_training = autograd.is_training()
         autograd.set_training(self.is_train)
+        # trace-scoped batch-axis name, so ops (ring attention) agree with
+        # DistOpt.data_axis no matter when jit re-traces this body
+        saved_data_axis = mesh_mod.current_data_axis()
+        mesh_mod.set_data_axis(opt.data_axis if isinstance(opt, DistOpt)
+                               else "data")
         saved_opt_state = None
         saved_param_data = {n: t.data for n, t in self.param_tensors.items()}
         saved_buffer_data = {n: t.data for n, t in self.buffer_tensors.items()}
@@ -271,6 +277,7 @@ class _StepExecutor:
             # leave tracers in the live tensors/optimizer
             tensor_mod._rng_key = saved_key
             autograd.set_training(saved_training)
+            mesh_mod.set_data_axis(saved_data_axis)
             for n, t in self.param_tensors.items():
                 t.data = saved_param_data[n]
             for n, t in self.buffer_tensors.items():
@@ -321,8 +328,9 @@ class _StepExecutor:
             b_arrays = {n: t.data for n, t in self.buffer_tensors.items()}
             self._param_sh = spmd.param_shardings(p_arrays, rules, mesh)
             self._buffer_sh = {n: rep for n in b_arrays}
-            self._slot_sh = spmd.tree_shardings(self.slots, self._param_sh,
-                                                mesh)
+            self._slot_sh = spmd.tree_shardings(
+                self.slots, self._param_sh, mesh,
+                {n: a.shape for n, a in p_arrays.items()})
             self._rep_sh = rep
             self._batch_sh = tuple(
                 mesh_mod.NamedSharding(

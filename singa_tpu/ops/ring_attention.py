@@ -59,9 +59,7 @@ def ring_attention_local(q, k, v, axis: str = "seq", causal: bool = True,
 
     q_pos = idx * Tl + jnp.arange(Tl)
 
-    def step(carry, s):
-        o, m, l, k_blk, v_blk = carry
-        src = (idx - s) % S  # rank that produced the block we now hold
+    def accumulate(o, m, l, k_blk, v_blk, src):
         logits = jnp.einsum("bqhd,bkhd->bhqk", qf,
                             k_blk.astype(jnp.float32)) * scale
         if causal:
@@ -79,12 +77,26 @@ def ring_attention_local(q, k, v, axis: str = "seq", causal: bool = True,
         l = l * corr + p.sum(axis=-1)
         o = o * corr[..., None] + jnp.einsum(
             "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
-        k_blk = lax.ppermute(k_blk, axis, perm)
-        v_blk = lax.ppermute(v_blk, axis, perm)
-        return (o, m_new, l, k_blk, v_blk), None
+        return o, m_new, l
 
-    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v),
-                                  jnp.arange(S))
+    def step(carry, s):
+        o, m, l, k_blk, v_blk = carry
+        # kick off the next block's transfer before the compute that uses
+        # the current block — the permute doesn't depend on the matmuls, so
+        # XLA overlaps ICI transfer with MXU work within the iteration
+        k_next = lax.ppermute(k_blk, axis, perm)
+        v_next = lax.ppermute(v_blk, axis, perm)
+        src = (idx - s) % S  # rank that produced the block we now hold
+        o, m, l = accumulate(o, m, l, k_blk, v_blk, src)
+        return (o, m, l, k_next, v_next), None
+
+    if S > 1:
+        (o, m, l, k_last, v_last), _ = lax.scan(
+            step, (o0, m0, l0, k, v), jnp.arange(S - 1))
+    else:
+        o, m, l, k_last, v_last = o0, m0, l0, k, v
+    # final held block needs no further rotation — S-1 permutes total
+    o, m, l = accumulate(o, m, l, k_last, v_last, (idx - (S - 1)) % S)
     out = o / jnp.maximum(l, 1e-30)[..., None]
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # (B, Tl, H, D)
 
@@ -96,12 +108,8 @@ class _RingSDPA(autograd.Operator):
         self.axis, self.causal, self.scale = axis, causal, scale
 
     def fwd(self, q, k, v):
-        from ..parallel.mesh import NamedSharding
-        if not isinstance(q, jax.core.Tracer):
-            # eager call (e.g. the compile() dry-run): commit the concrete
-            # arrays onto the mesh so shard_map accepts them
-            q, k, v = (jax.device_put(a, NamedSharding(self.mesh, s))
-                       for a, s in zip((q, k, v), self.specs))
+        # operands are always tracers here: ring_attention routes concrete
+        # (eager) calls to the fused SDPA path before building this op
         body = partial(ring_attention_local, axis=self.axis,
                        causal=self.causal, scale=self.scale)
         sharded = jax.shard_map(body, mesh=self.mesh, in_specs=self.specs,
@@ -111,11 +119,17 @@ class _RingSDPA(autograd.Operator):
 
 def ring_attention(q: Tensor, k: Tensor, v: Tensor, causal: bool = True,
                    scale: Optional[float] = None, axis: str = "seq",
-                   data_axis: str = "data") -> Tensor:
+                   data_axis: Optional[str] = None,
+                   model_axis: str = "model") -> Tensor:
     """Global-tensor ring attention over the installed mesh's `axis`.
 
     Falls back to the fused SDPA op when no seq axis is installed, so
-    models can call this unconditionally."""
+    models can call this unconditionally.  `data_axis` defaults to the
+    executor-installed batch axis (mesh.current_data_axis), so a DistOpt
+    with a custom axis name keeps batch sharding inside the ring.  When
+    the mesh has a tensor-parallel `model_axis` that divides the head
+    count, heads stay sharded over it through the shard_map boundary —
+    each TP group computes only its own heads."""
     from ..parallel import mesh as mesh_mod
     from . import attention as attn_ops
 
@@ -134,9 +148,14 @@ def ring_attention(q: Tensor, k: Tensor, v: Tensor, causal: bool = True,
         k = _repeat_heads(k, rep)
         v = _repeat_heads(v, rep)
     P = mesh_mod.P
+    if data_axis is None:
+        data_axis = mesh_mod.current_data_axis()
     dspec = (data_axis if data_axis in mesh.shape
              and q.shape[0] % mesh.shape[data_axis] == 0 else None)
-    spec = P(dspec, axis)
+    hspec = (model_axis if model_axis in mesh.shape
+             and mesh.shape[model_axis] > 1
+             and q.shape[2] % mesh.shape[model_axis] == 0 else None)
+    spec = P(dspec, axis, hspec)
     return _RingSDPA(mesh, (spec, spec, spec), axis, causal, scale)(q, k, v)
 
 

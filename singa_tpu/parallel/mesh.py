@@ -21,9 +21,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["make_mesh", "set_mesh", "current_mesh", "data_parallel_mesh",
            "mesh_shape", "P", "NamedSharding", "named_sharding",
-           "process_index", "process_count", "local_devices"]
+           "process_index", "process_count", "local_devices",
+           "set_data_axis", "current_data_axis"]
 
 _current_mesh: Optional[Mesh] = None
+_data_axis: str = "data"
+
+
+def set_data_axis(name: str) -> None:
+    """Install the batch-sharding axis name (the executor calls this so
+    ops like ring_attention agree with DistOpt's data_axis)."""
+    global _data_axis
+    _data_axis = name
+
+
+def current_data_axis() -> str:
+    return _data_axis
 
 
 def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:

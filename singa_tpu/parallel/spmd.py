@@ -74,7 +74,7 @@ def batch_spec(shape: Sequence[int], dtype, mesh: Mesh,
 
 
 def tree_shardings(tree, name_to_sharding: Dict[str, NamedSharding],
-                   mesh: Mesh):
+                   mesh: Mesh, param_shapes: Optional[Dict[str, Tuple]] = None):
     """Map a {name: slot-pytree} dict (optimizer state) to shardings:
     every leaf under `name` shares the param's sharding when shapes
     match, else is replicated."""
@@ -82,5 +82,12 @@ def tree_shardings(tree, name_to_sharding: Dict[str, NamedSharding],
     out = {}
     for name, sub in tree.items():
         sh = name_to_sharding.get(name, rep)
-        out[name] = jax.tree.map(lambda leaf, sh=sh: sh, sub)
+        pshape = param_shapes.get(name) if param_shapes else None
+
+        def pick(leaf, sh=sh, pshape=pshape):
+            if pshape is not None and tuple(getattr(leaf, "shape", ())) != tuple(pshape):
+                return rep
+            return sh
+
+        out[name] = jax.tree.map(pick, sub)
     return out

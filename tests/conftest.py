@@ -30,11 +30,13 @@ def _reset_singa_state():
     st.tensor.set_seed(0)
     st.autograd.set_training(False)
     st.parallel.set_mesh(None)
+    st.parallel.mesh.set_data_axis("data")
     dev = st.device.create_cpu_device()
     st.device.set_default_device(dev)
     np.random.seed(0)
     yield
     st.parallel.set_mesh(None)
+    st.parallel.mesh.set_data_axis("data")
     st.autograd.set_training(False)
 
 
