@@ -1,0 +1,47 @@
+"""examples/cnn — the reference CNN workloads (BASELINE.json:8,10:
+MNIST CNN, CIFAR ResNet-18/VGG in singa.model graph mode, ImageNet
+ResNet-50 data-parallel).
+
+    python examples/cnn/train.py --model cnn      --dataset mnist
+    python examples/cnn/train.py --model resnet18 --dataset cifar10
+    python examples/cnn/train.py --model vgg11    --dataset cifar10
+    python examples/cnn/train.py --model resnet50 --dataset imagenet --dist
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from common import base_parser, dataset_arrays, train_classifier  # noqa: E402
+
+from singa_tpu import models  # noqa: E402
+
+_MODELS = {
+    "mlp": lambda c: models.MLP(num_classes=c),
+    "cnn": lambda c: models.CNN(num_classes=c),
+    "lenet": lambda c: models.LeNet5(num_classes=c),
+    "alexnet": lambda c: models.AlexNet(num_classes=c),
+    "resnet18": lambda c: models.resnet18(num_classes=c),
+    "resnet34": lambda c: models.resnet34(num_classes=c),
+    "resnet50": lambda c: models.resnet50(num_classes=c),
+    "vgg11": lambda c: models.vgg11(num_classes=c),
+    "vgg13": lambda c: models.vgg13(num_classes=c),
+    "vgg16": lambda c: models.vgg16(num_classes=c),
+}
+
+
+def main():
+    p = base_parser("CNN family on MNIST/CIFAR/ImageNet (reference examples/cnn)")
+    p.add_argument("--model", default="cnn", choices=sorted(_MODELS))
+    p.add_argument("--dataset", default="mnist",
+                   choices=["mnist", "cifar10", "cifar100", "imagenet"])
+    args = p.parse_args()
+    xt, yt, xe, ye, classes, _ = dataset_arrays(args.dataset, args.data_dir)
+    m = _MODELS[args.model](classes)
+    train_classifier(m, args, xt, yt, xe, ye)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""examples/mlp — the reference smoke workload (BASELINE.json:7:
+"examples/mlp MNIST eager CppCPU parity smoke").
+
+    python examples/mlp/train.py                    # synthetic MNIST shapes
+    python examples/mlp/train.py --device tpu       # one-line device change
+    python examples/mlp/train.py --no-graph         # eager (debug) mode
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from common import base_parser, dataset_arrays, train_classifier  # noqa: E402
+
+from singa_tpu import models  # noqa: E402
+
+
+def main():
+    p = base_parser("MLP on MNIST (reference examples/mlp)")
+    p.add_argument("--hidden", type=int, nargs="+", default=[100])
+    p.add_argument("--dataset", default="mnist")
+    args = p.parse_args()
+    xt, yt, xe, ye, classes, _ = dataset_arrays(args.dataset, args.data_dir)
+    m = models.MLP(perceptron_size=tuple(args.hidden), num_classes=classes)
+    train_classifier(m, args, xt, yt, xe, ye)
+
+
+if __name__ == "__main__":
+    main()

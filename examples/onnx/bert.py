@@ -1,0 +1,88 @@
+"""examples/onnx/bert — BERT-base through the sonnx path
+(BASELINE.json:9: "ONNX BERT-base ... inference via sonnx import").
+
+With no network egress we can't fetch the official ONNX zoo file, so the
+script (a) loads `--onnx <path>` when given one, else (b) builds a BERT
+with our model zoo, EXPORTS it to ONNX with sonnx, reimports, and checks
+import==native — which exercises the identical import path an official
+file takes.
+
+    python examples/onnx/bert.py                    # self-exported round-trip
+    python examples/onnx/bert.py --onnx bert.onnx   # a real exported file
+    python examples/onnx/bert.py --device tpu --compile
+"""
+
+import argparse
+import time
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np  # noqa: E402
+
+# importing common pins the cpu backend when --device cpu was passed
+import common  # noqa: E402,F401
+
+import singa_tpu as singa
+from singa_tpu import models, sonnx
+from singa_tpu.tensor import Tensor
+
+
+def main():
+    p = argparse.ArgumentParser(description="BERT via sonnx")
+    p.add_argument("--onnx", default="", help="path to a BERT .onnx file")
+    p.add_argument("--device", default="auto", choices=["auto", "cpu", "tpu"])
+    p.add_argument("--layers", type=int, default=2, help="(self-export mode)")
+    p.add_argument("--dim", type=int, default=128)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--vocab", type=int, default=1000)
+    p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--seq", type=int, default=64)
+    p.add_argument("--compile", action="store_true",
+                   help="compile the imported graph to one XLA module")
+    args = p.parse_args()
+
+    dev = singa.device.create_device(args.device)
+    singa.device.set_default_device(dev)
+    ids = np.random.RandomState(0).randint(
+        0, args.vocab, (args.batch, args.seq)).astype(np.int64)
+    t_ids = Tensor(data=ids, device=dev)
+
+    ref_logits = None
+    if args.onnx:
+        model_proto = sonnx.load(args.onnx)
+    else:
+        cfg = models.BERTConfig(vocab_size=args.vocab, dim=args.dim,
+                                num_heads=args.heads, num_layers=args.layers,
+                                max_position=max(128, args.seq), dropout=0.0)
+        native = models.BERT(cfg)
+        hidden, _pooled = native(t_ids)
+        ref_logits = np.asarray(hidden.data)
+        print("exporting BERT to ONNX via sonnx.to_onnx ...")
+        model_proto = sonnx.to_onnx(native, [t_ids])
+        n_nodes = len(model_proto.graph.node)
+        n_init = len(model_proto.graph.initializer)
+        print(f"  graph: {n_nodes} nodes, {n_init} initializers")
+
+    print("importing with sonnx.prepare ...")
+    rep = sonnx.prepare(model_proto, device=dev)
+    if args.compile:
+        rep.compile([t_ids], is_train=False, use_graph=True)
+    t0 = time.perf_counter()
+    outs = rep.run([t_ids])
+    lat = time.perf_counter() - t0
+    out = np.asarray(outs[0].data)
+    print(f"encoder output shape {out.shape}  "
+          f"first-call latency {lat * 1e3:.1f} ms")
+    if ref_logits is not None:
+        err = np.max(np.abs(out - ref_logits))
+        print(f"import vs native max |diff| = {err:.2e}")
+        assert err < 1e-2, "sonnx round-trip mismatch"
+        print("round-trip OK")
+
+
+if __name__ == "__main__":
+    main()

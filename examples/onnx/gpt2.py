@@ -1,0 +1,94 @@
+"""examples/onnx/gpt2 — GPT-2 through the sonnx path + greedy generation
+(BASELINE.json:9: "GPT-2 ... inference via sonnx import").
+
+Like bert.py: imports `--onnx <path>` if given, else exports our zoo
+GPT-2 and reimports it.  Generation re-runs the imported graph at a
+fixed sequence length (static shapes — the XLA-friendly formulation)
+with left-padding, taking the logits at the last real position.
+
+    python examples/onnx/gpt2.py --steps 8
+    python examples/onnx/gpt2.py --onnx gpt2.onnx --device tpu
+"""
+
+import argparse
+import time
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np  # noqa: E402
+
+# importing common pins the cpu backend when --device cpu was passed
+import common  # noqa: E402,F401
+
+import singa_tpu as singa
+from singa_tpu import models, sonnx
+from singa_tpu.tensor import Tensor
+
+
+def main():
+    p = argparse.ArgumentParser(description="GPT-2 via sonnx + generation")
+    p.add_argument("--onnx", default="", help="path to a GPT-2 .onnx file")
+    p.add_argument("--device", default="auto", choices=["auto", "cpu", "tpu"])
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--dim", type=int, default=128)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--vocab", type=int, default=1000)
+    p.add_argument("--seq", type=int, default=32)
+    p.add_argument("--steps", type=int, default=8, help="tokens to generate")
+    args = p.parse_args()
+
+    dev = singa.device.create_device(args.device)
+    singa.device.set_default_device(dev)
+
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, args.vocab, (1, args.seq // 2)).astype(np.int64)
+
+    if args.onnx:
+        model_proto = sonnx.load(args.onnx)
+        ref = None
+    else:
+        cfg = models.GPT2Config(vocab_size=args.vocab, dim=args.dim,
+                                num_heads=args.heads, num_layers=args.layers,
+                                max_position=max(64, args.seq), dropout=0.0)
+        native = models.GPT2(cfg)
+        full = np.zeros((1, args.seq), np.int64)
+        full[0, :prompt.shape[1]] = prompt
+        t_full = Tensor(data=full, device=dev)
+        ref = np.asarray(native(t_full).data)
+        print("exporting GPT-2 to ONNX via sonnx.to_onnx ...")
+        model_proto = sonnx.to_onnx(native, [t_full])
+        print(f"  graph: {len(model_proto.graph.node)} nodes")
+
+    rep = sonnx.prepare(model_proto, device=dev)
+
+    ids = np.zeros((1, args.seq), np.int64)
+    n = prompt.shape[1]
+    ids[0, :n] = prompt
+    t_ids = Tensor(data=ids, device=dev)
+    if ref is not None:
+        (logits,) = rep.run([t_ids])
+        err = np.max(np.abs(np.asarray(logits.data) - ref))
+        print(f"import vs native max |diff| = {err:.2e}")
+        assert err < 1e-2
+
+    print(f"greedy generation, {args.steps} tokens:")
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        if n >= args.seq:
+            break
+        t_ids.copy_from(ids)
+        (logits,) = rep.run([t_ids])
+        nxt = int(np.asarray(logits.data)[0, n - 1].argmax())
+        ids[0, n] = nxt
+        n += 1
+    dt = time.perf_counter() - t0
+    print("generated ids:", ids[0, prompt.shape[1]:n].tolist())
+    print(f"{(n - prompt.shape[1]) / dt:.2f} tok/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""singa_tpu.sonnx — ONNX interchange (reference `sonnx`, BASELINE.json:5,9).
+
+Frozen API parity surface:
+    sonnx.prepare(onnx_model, device)  -> backend rep; rep.run(inputs)
+    sonnx.to_onnx(model, inputs)       -> ModelProto export
+    sonnx.load / sonnx.save            -> file IO
+plus the `onnx`-compatible proto/helper layer in `sonnx.proto` (this
+image has no onnx wheel; the codec is self-contained — see proto.py).
+
+TPU-first: an imported graph is a `model.Model`, so `compile()` captures
+it into one XLA module; float initializers are trainable, making the
+import training-capable (BERT-base / GPT-2 fine-tuning, BASELINE.json:9).
+"""
+
+from . import proto
+from .backend import SingaBackend, SingaRep, prepare, supported_ops
+from .export import export, to_onnx
+from .proto import (AttributeProto, GraphProto, ModelProto, NodeProto,
+                    TensorProto, from_array, load, load_model_from_string,
+                    make_graph, make_model, make_node, make_tensor,
+                    make_tensor_value_info, save, to_array)
+
+__all__ = [
+    "prepare", "SingaBackend", "SingaRep", "supported_ops",
+    "to_onnx", "export", "load", "save", "load_model_from_string",
+    "proto", "ModelProto", "GraphProto", "NodeProto", "TensorProto",
+    "AttributeProto", "make_node", "make_graph", "make_model",
+    "make_tensor", "make_tensor_value_info", "to_array", "from_array",
+]

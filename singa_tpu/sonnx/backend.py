@@ -1,0 +1,921 @@
+"""sonnx import backend — ONNX graph → singa_tpu autograd execution.
+
+Capability parity: the reference's `sonnx.prepare(onnx_model, device)`
+returning a backend rep whose `.run(inputs)` replays the graph through
+`singa.autograd` operators (BASELINE.json:9 — ONNX BERT-base / GPT-2
+inference; SURVEY.md §3.4 import call stack).  TPU-first design: every
+handler maps an ONNX node onto autograd Operators (differentiable, so
+imported models are *training-capable*) or pure-jnp ops; a `SingaRep`
+is a `model.Model`, so `compile()` captures the whole imported graph
+into one XLA module exactly like a hand-written model.
+
+Static-shape discipline (XLA): shape-computation chains
+(Shape → Gather/Concat/... → Reshape/Expand/Slice) are *partially
+evaluated on the host* — `Shape` yields a concrete numpy vector because
+tensor shapes are static under jit, and any node whose inputs are all
+host constants folds at import time.  Data-dependent shapes (NonZero
+etc.) are rejected with a clear error rather than silently miscompiled.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import autograd
+from .. import model as model_mod
+from ..device import get_default_device
+from ..tensor import Tensor
+from . import proto
+from .proto import (AttributeProto, GraphProto, ModelProto, NodeProto,
+                    TensorProto, attribute_value, to_array)
+
+__all__ = ["prepare", "SingaBackend", "SingaRep", "supported_ops"]
+
+
+# ---------------------------------------------------------------------------
+# value lanes: host constants (numpy — shape math, folded at trace time)
+# vs device tensors (autograd Tensors — the compute lane)
+# ---------------------------------------------------------------------------
+
+_HostVal = (np.ndarray, np.generic, int, float, bool)
+
+
+def _is_host(v) -> bool:
+    return isinstance(v, _HostVal)
+
+
+def _host(v) -> np.ndarray:
+    return np.asarray(v)
+
+
+def _require_host(v, node: NodeProto, what: str) -> np.ndarray:
+    if not _is_host(v):
+        raise ValueError(
+            f"ONNX node {node.op_type} ({node.name}): {what} must be a "
+            f"compile-time constant — XLA requires static shapes; a "
+            f"data-dependent value reached a shape position")
+    return _host(v)
+
+
+class _Ctx:
+    def __init__(self, device, opset: int, training: bool):
+        self.device = device
+        self.opset = opset
+        self.training = training
+
+    def tensor(self, v, requires_grad=False) -> Tensor:
+        if isinstance(v, Tensor):
+            return v
+        return Tensor(data=jnp.asarray(v), device=self.device,
+                      requires_grad=requires_grad)
+
+
+def _attrs(node: NodeProto) -> Dict[str, Any]:
+    return {a.name: attribute_value(a) for a in node.attribute}
+
+
+class _JnpOp(autograd.Operator):
+    """Wrap a pure jnp function as an autograd Operator: backward comes
+    free from jax.vjp, so imported graphs stay differentiable."""
+
+    def __init__(self, fn: Callable):
+        super().__init__()
+        self.fn = fn
+
+    def fwd(self, *arrays):
+        return self.fn(*arrays)
+
+
+def _apply(ctx: _Ctx, fn: Callable, *vals):
+    """Run `fn` on mixed host/tensor values through the autograd tape."""
+    ts = [ctx.tensor(v) for v in vals]
+    return _JnpOp(fn)(*ts)
+
+
+# ---------------------------------------------------------------------------
+# handler registry
+# ---------------------------------------------------------------------------
+
+_HANDLERS: Dict[str, Callable] = {}
+
+
+def handles(*op_types: str):
+    def deco(fn):
+        for t in op_types:
+            _HANDLERS[t] = fn
+        return fn
+    return deco
+
+
+def supported_ops() -> List[str]:
+    return sorted(_HANDLERS)
+
+
+# -- elementwise unary -------------------------------------------------------
+
+_UNARY = {
+    "Relu": (autograd.relu, lambda a: np.maximum(a, 0)),
+    "Sigmoid": (autograd.sigmoid, lambda a: 1 / (1 + np.exp(-a))),
+    "Tanh": (autograd.tanh, np.tanh),
+    "Exp": (autograd.exp, np.exp),
+    "Log": (autograd.log, np.log),
+    "Sqrt": (autograd.sqrt, np.sqrt),
+    "Abs": (autograd.abs, np.abs),
+    "Neg": (autograd.neg, np.negative),
+    "Erf": (autograd.erf, None),
+    "Floor": (lambda t: _nyi_grad(jnp.floor, t), np.floor),
+    "Ceil": (lambda t: _nyi_grad(jnp.ceil, t), np.ceil),
+    "Round": (lambda t: _nyi_grad(jnp.round, t), np.round),
+    "Sign": (lambda t: _nyi_grad(jnp.sign, t), np.sign),
+    "Reciprocal": (lambda t: _JnpOp(lambda a: 1.0 / a)(t), lambda a: 1.0 / a),
+    "Softplus": (autograd.softplus, None),
+    "Not": (lambda t: _JnpOp(jnp.logical_not)(t), np.logical_not),
+    "Identity": (lambda t: t, lambda a: a),
+}
+
+
+def _nyi_grad(fn, t):
+    return _JnpOp(fn)(t)
+
+
+@handles(*_UNARY)
+def _h_unary(ctx, node, attrs, ins):
+    t_fn, np_fn = _UNARY[node.op_type]
+    (x,) = ins
+    if _is_host(x) and np_fn is not None:
+        return [np_fn(_host(x))]
+    return [t_fn(ctx.tensor(x))]
+
+
+# -- elementwise binary / variadic ------------------------------------------
+
+_BINARY = {
+    "Add": (jnp.add, np.add),
+    "Sub": (jnp.subtract, np.subtract),
+    "Mul": (jnp.multiply, np.multiply),
+    "Div": (jnp.divide, np.divide),
+    "Pow": (jnp.power, np.power),
+    "Equal": (jnp.equal, np.equal),
+    "Greater": (jnp.greater, np.greater),
+    "GreaterOrEqual": (jnp.greater_equal, np.greater_equal),
+    "Less": (jnp.less, np.less),
+    "LessOrEqual": (jnp.less_equal, np.less_equal),
+    "And": (jnp.logical_and, np.logical_and),
+    "Or": (jnp.logical_or, np.logical_or),
+    "Xor": (jnp.logical_xor, np.logical_xor),
+    "Mod": (jnp.mod, np.mod),
+}
+
+
+@handles(*_BINARY)
+def _h_binary(ctx, node, attrs, ins):
+    j_fn, np_fn = _BINARY[node.op_type]
+    a, b = ins
+    if _is_host(a) and _is_host(b):
+        return [np_fn(_host(a), _host(b))]
+    return [_apply(ctx, j_fn, a, b)]
+
+
+@handles("Min", "Max", "Sum", "Mean")
+def _h_variadic(ctx, node, attrs, ins):
+    j_fn = {"Min": jnp.minimum, "Max": jnp.maximum,
+            "Sum": jnp.add, "Mean": jnp.add}[node.op_type]
+    if all(_is_host(v) for v in ins):
+        np_fn = {"Min": np.minimum, "Max": np.maximum,
+                 "Sum": np.add, "Mean": np.add}[node.op_type]
+        out = _host(ins[0])
+        for v in ins[1:]:
+            out = np_fn(out, _host(v))
+        if node.op_type == "Mean":
+            out = out / len(ins)
+        return [out]
+    out = ctx.tensor(ins[0])
+    for v in ins[1:]:
+        out = _apply(ctx, j_fn, out, v)
+    if node.op_type == "Mean":
+        out = _apply(ctx, lambda a: a / len(ins), out)
+    return [out]
+
+
+@handles("Clip")
+def _h_clip(ctx, node, attrs, ins):
+    x = ins[0]
+    lo = attrs.get("min")
+    hi = attrs.get("max")
+    if len(ins) > 1 and ins[1] is not None:
+        lo = float(_require_host(ins[1], node, "min"))
+    if len(ins) > 2 and ins[2] is not None:
+        hi = float(_require_host(ins[2], node, "max"))
+    lo = -np.inf if lo is None else lo
+    hi = np.inf if hi is None else hi
+    if _is_host(x):
+        return [np.clip(_host(x), lo, hi)]
+    return [autograd.clip(ctx.tensor(x), lo, hi)]
+
+
+@handles("LeakyRelu")
+def _h_leaky(ctx, node, attrs, ins):
+    return [autograd.leakyrelu(ctx.tensor(ins[0]), attrs.get("alpha", 0.01))]
+
+
+@handles("Elu")
+def _h_elu(ctx, node, attrs, ins):
+    return [autograd.elu(ctx.tensor(ins[0]), attrs.get("alpha", 1.0))]
+
+
+@handles("Selu")
+def _h_selu(ctx, node, attrs, ins):
+    alpha = attrs.get("alpha", 1.6732632)
+    gamma = attrs.get("gamma", 1.050701)
+    return [_apply(ctx, lambda a: gamma * jnp.where(a > 0, a, alpha * (jnp.exp(a) - 1)),
+                   ins[0])]
+
+
+@handles("HardSigmoid")
+def _h_hardsigmoid(ctx, node, attrs, ins):
+    alpha = attrs.get("alpha", 0.2)
+    beta = attrs.get("beta", 0.5)
+    return [_apply(ctx, lambda a: jnp.clip(alpha * a + beta, 0, 1), ins[0])]
+
+
+@handles("Gelu")
+def _h_gelu(ctx, node, attrs, ins):
+    if attrs.get("approximate", "none") == "tanh":
+        return [_apply(ctx, lambda a: jax.nn.gelu(a, approximate=True), ins[0])]
+    return [autograd.gelu(ctx.tensor(ins[0]))]
+
+
+@handles("PRelu")
+def _h_prelu(ctx, node, attrs, ins):
+    return [_apply(ctx, lambda a, s: jnp.where(a > 0, a, s * a), ins[0], ins[1])]
+
+
+def _softmax_like(ctx, node, attrs, ins, fn):
+    x = ctx.tensor(ins[0])
+    if ctx.opset >= 13:
+        return [fn(x, attrs.get("axis", -1))]
+    # opset 1-12: coerce to 2-D — flatten dims [axis:] and normalize over
+    # the whole flattened block jointly
+    axis = attrs.get("axis", 1)
+    shape = x.shape
+    nd = len(shape)
+    axis = axis % nd
+    lead = int(np.prod(shape[:axis])) if axis > 0 else 1
+    flat = autograd.reshape(x, (lead, -1))
+    return [autograd.reshape(fn(flat, -1), shape)]
+
+
+@handles("Softmax")
+def _h_softmax(ctx, node, attrs, ins):
+    return _softmax_like(ctx, node, attrs, ins, autograd.softmax)
+
+
+@handles("LogSoftmax")
+def _h_logsoftmax(ctx, node, attrs, ins):
+    return _softmax_like(ctx, node, attrs, ins, autograd.log_softmax)
+
+
+# -- matmul family -----------------------------------------------------------
+
+@handles("MatMul")
+def _h_matmul(ctx, node, attrs, ins):
+    return [autograd.matmul(ctx.tensor(ins[0]), ctx.tensor(ins[1]))]
+
+
+@handles("Gemm")
+def _h_gemm(ctx, node, attrs, ins):
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    ta, tb = attrs.get("transA", 0), attrs.get("transB", 0)
+
+    def gemm(a, b, *c):
+        a2 = a.T if ta else a
+        b2 = b.T if tb else b
+        y = alpha * jnp.matmul(a2, b2)
+        if c:
+            y = y + beta * c[0]
+        return y
+
+    return [_apply(ctx, gemm, *[v for v in ins if v is not None])]
+
+
+@handles("Einsum")
+def _h_einsum(ctx, node, attrs, ins):
+    return [autograd.einsum(attrs["equation"], *[ctx.tensor(v) for v in ins])]
+
+
+# -- shape lane --------------------------------------------------------------
+
+@handles("Shape")
+def _h_shape(ctx, node, attrs, ins):
+    shape = np.asarray(_shape_of(ins[0]), np.int64)
+    start = attrs.get("start", 0)
+    end = attrs.get("end")
+    return [shape[start:end]]
+
+
+def _shape_of(v):
+    return tuple(_host(v).shape) if _is_host(v) else tuple(v.shape)
+
+
+@handles("Size")
+def _h_size(ctx, node, attrs, ins):
+    return [np.asarray(int(np.prod(_shape_of(ins[0]))), np.int64)]
+
+
+@handles("Constant")
+def _h_constant(ctx, node, attrs, ins):
+    if "value" in attrs:
+        return [attrs["value"]]
+    for k in ("value_float", "value_int"):
+        if k in attrs:
+            return [np.asarray(attrs[k])]
+    for k in ("value_floats", "value_ints"):
+        if k in attrs:
+            return [np.asarray(attrs[k])]
+    raise ValueError("Constant node without a value attribute")
+
+
+@handles("ConstantOfShape")
+def _h_constant_of_shape(ctx, node, attrs, ins):
+    shape = tuple(int(d) for d in _require_host(ins[0], node, "shape").reshape(-1))
+    val = attrs.get("value")
+    if val is None:
+        val = np.zeros((1,), np.float32)
+    return [np.full(shape, np.asarray(val).reshape(-1)[0])]
+
+
+@handles("Cast")
+def _h_cast(ctx, node, attrs, ins):
+    dt = proto.tensor_dtype_to_np_dtype(int(attrs["to"]))
+    (x,) = ins
+    if _is_host(x):
+        return [_host(x).astype(dt)]
+    return [autograd.cast(ctx.tensor(x), jnp.dtype(dt))]
+
+
+@handles("CastLike")
+def _h_castlike(ctx, node, attrs, ins):
+    x, like = ins
+    dt = _host(like).dtype if _is_host(like) else like.dtype
+    if _is_host(x):
+        return [_host(x).astype(dt)]
+    return [autograd.cast(ctx.tensor(x), dt)]
+
+
+@handles("Reshape")
+def _h_reshape(ctx, node, attrs, ins):
+    x = ins[0]
+    target = [int(d) for d in _require_host(ins[1], node, "shape").reshape(-1)]
+    allowzero = attrs.get("allowzero", 0)
+    cur = _shape_of(x)
+    shape = []
+    for i, d in enumerate(target):
+        if d == 0 and not allowzero:
+            shape.append(cur[i])
+        else:
+            shape.append(d)
+    if _is_host(x):
+        return [_host(x).reshape(shape)]
+    return [autograd.reshape(ctx.tensor(x), shape)]
+
+
+@handles("Transpose")
+def _h_transpose(ctx, node, attrs, ins):
+    perm = attrs.get("perm")
+    (x,) = ins
+    if _is_host(x):
+        return [np.transpose(_host(x), perm)]
+    return [autograd.transpose(ctx.tensor(x), perm)]
+
+
+@handles("Flatten")
+def _h_flatten(ctx, node, attrs, ins):
+    axis = attrs.get("axis", 1)
+    shape = _shape_of(ins[0])
+    if axis < 0:
+        axis += len(shape)
+    lead = int(np.prod(shape[:axis])) if axis > 0 else 1
+    return [autograd.reshape(ctx.tensor(ins[0]), (lead, -1))]
+
+
+def _axes_arg(node, attrs, ins, idx, opset) -> Optional[List[int]]:
+    """axes moved from attribute to input at opset 13 — accept both."""
+    if len(ins) > idx and ins[idx] is not None:
+        return [int(a) for a in _require_host(ins[idx], node, "axes").reshape(-1)]
+    if "axes" in attrs:
+        return [int(a) for a in attrs["axes"]]
+    return None
+
+
+@handles("Squeeze")
+def _h_squeeze(ctx, node, attrs, ins):
+    axes = _axes_arg(node, attrs, ins, 1, ctx.opset)
+    x = ins[0]
+    if _is_host(x):
+        return [np.squeeze(_host(x), tuple(axes) if axes else None)]
+    ax = tuple(axes) if axes else None
+    return [autograd.squeeze(ctx.tensor(x), ax)]
+
+
+@handles("Unsqueeze")
+def _h_unsqueeze(ctx, node, attrs, ins):
+    axes = _axes_arg(node, attrs, ins, 1, ctx.opset)
+    x = ins[0]
+    if _is_host(x):
+        out = _host(x)
+        ndim_out = out.ndim + len(axes)
+        for a in sorted(a % ndim_out for a in axes):
+            out = np.expand_dims(out, a)
+        return [out]
+    t = ctx.tensor(x)
+    ndim_out = len(t.shape) + len(axes)
+    return [autograd.unsqueeze(t, sorted(a % ndim_out for a in axes))]
+
+
+@handles("Concat")
+def _h_concat(ctx, node, attrs, ins):
+    axis = attrs["axis"]
+    if all(_is_host(v) for v in ins):
+        return [np.concatenate([_host(v) for v in ins], axis=axis)]
+    return [autograd.cat([ctx.tensor(v) for v in ins], axis)]
+
+
+@handles("Split")
+def _h_split(ctx, node, attrs, ins):
+    axis = attrs.get("axis", 0)
+    parts = None
+    if len(ins) > 1 and ins[1] is not None:
+        parts = [int(v) for v in _require_host(ins[1], node, "split").reshape(-1)]
+    elif "split" in attrs:
+        parts = [int(v) for v in attrs["split"]]
+    n_out = len(node.output)
+    t = ctx.tensor(ins[0])
+    if parts is None:
+        size = t.shape[axis]
+        num = attrs.get("num_outputs", n_out)
+        base = -(-size // num)  # ceil-div per ONNX num_outputs semantics
+        parts = [base] * (size // base)
+        if size % base:
+            parts.append(size % base)
+    outs = autograd.split(t, parts, axis)
+    return list(outs)
+
+
+@handles("Slice")
+def _h_slice(ctx, node, attrs, ins):
+    x = ins[0]
+    nd = len(_shape_of(x))
+    if ctx.opset >= 10 or len(ins) > 1:
+        starts = _require_host(ins[1], node, "starts").reshape(-1)
+        ends = _require_host(ins[2], node, "ends").reshape(-1)
+        axes = (_require_host(ins[3], node, "axes").reshape(-1)
+                if len(ins) > 3 and ins[3] is not None
+                else np.arange(len(starts)))
+        steps = (_require_host(ins[4], node, "steps").reshape(-1)
+                 if len(ins) > 4 and ins[4] is not None
+                 else np.ones(len(starts), np.int64))
+    else:
+        starts = np.asarray(attrs["starts"])
+        ends = np.asarray(attrs["ends"])
+        axes = np.asarray(attrs.get("axes", list(range(len(starts)))))
+        steps = np.ones(len(starts), np.int64)
+    slices = [slice(None)] * nd
+    int_max = np.iinfo(np.int64).max
+    for s, e, a, st in zip(starts, ends, axes, steps):
+        s, e, st = int(s), int(e), int(st)
+        a = int(a) % nd
+        # INT64_MAX / INT64_MIN are ONNX's "to the end" sentinels
+        s_ = None if s in (int_max, -int_max - 1) else s
+        e_ = None if e in (int_max, -int_max - 1) else e
+        slices[a] = slice(s_, e_, st)
+    slices = tuple(slices)
+    if _is_host(x):
+        return [_host(x)[slices]]
+    return [autograd.index(ctx.tensor(x), slices)]
+
+
+@handles("Gather")
+def _h_gather(ctx, node, attrs, ins):
+    axis = attrs.get("axis", 0)
+    data, idx = ins
+    if _is_host(data) and _is_host(idx):
+        return [np.take(_host(data), _host(idx).astype(np.int64), axis=axis)]
+    iv = _host(idx).astype(np.int64) if _is_host(idx) else idx.data
+    return [autograd.gather(ctx.tensor(data), axis, iv)]
+
+
+@handles("GatherElements")
+def _h_gather_elements(ctx, node, attrs, ins):
+    axis = attrs.get("axis", 0)
+    idx = ins[1]
+    iv = _host(idx).astype(np.int64) if _is_host(idx) else idx.data
+    return [_apply(ctx, lambda d: jnp.take_along_axis(d, jnp.asarray(iv), axis=axis),
+                   ins[0])]
+
+
+@handles("Expand")
+def _h_expand(ctx, node, attrs, ins):
+    target = tuple(int(d) for d in _require_host(ins[1], node, "shape").reshape(-1))
+    cur = _shape_of(ins[0])
+    out_shape = np.broadcast_shapes(cur, target)
+    if _is_host(ins[0]):
+        return [np.broadcast_to(_host(ins[0]), out_shape)]
+    return [_apply(ctx, lambda a: jnp.broadcast_to(a, out_shape), ins[0])]
+
+
+@handles("Tile")
+def _h_tile(ctx, node, attrs, ins):
+    reps = tuple(int(d) for d in _require_host(ins[1], node, "repeats").reshape(-1))
+    if _is_host(ins[0]):
+        return [np.tile(_host(ins[0]), reps)]
+    return [_apply(ctx, lambda a: jnp.tile(a, reps), ins[0])]
+
+
+@handles("Range")
+def _h_range(ctx, node, attrs, ins):
+    s, l, d = (_require_host(v, node, "range arg") for v in ins)
+    return [np.arange(s.item(), l.item(), d.item())]
+
+
+@handles("Where")
+def _h_where(ctx, node, attrs, ins):
+    cond, a, b = ins
+    if all(_is_host(v) for v in ins):
+        return [np.where(_host(cond), _host(a), _host(b))]
+    cv = _host(cond) if _is_host(cond) else cond
+    return [autograd.where(cv, ctx.tensor(a), ctx.tensor(b))]
+
+
+@handles("Trilu")
+def _h_trilu(ctx, node, attrs, ins):
+    upper = attrs.get("upper", 1)
+    k = int(_require_host(ins[1], node, "k")) if len(ins) > 1 and ins[1] is not None else 0
+    fn = (lambda a: jnp.triu(a, k)) if upper else (lambda a: jnp.tril(a, k))
+    if _is_host(ins[0]):
+        return [np.triu(_host(ins[0]), k) if upper else np.tril(_host(ins[0]), k)]
+    return [_apply(ctx, fn, ins[0])]
+
+
+@handles("OneHot")
+def _h_onehot(ctx, node, attrs, ins):
+    axis = attrs.get("axis", -1)
+    depth = int(_require_host(ins[1], node, "depth"))
+    values = _require_host(ins[2], node, "values").reshape(-1)
+    off, on = values[0], values[1]
+
+    def onehot(idx):
+        oh = jax.nn.one_hot(idx.astype(jnp.int32), depth, axis=axis)
+        return oh * (on - off) + off
+
+    return [_apply(ctx, onehot, ins[0])]
+
+
+@handles("CumSum")
+def _h_cumsum(ctx, node, attrs, ins):
+    axis = int(_require_host(ins[1], node, "axis"))
+    return [_apply(ctx, lambda a: jnp.cumsum(a, axis=axis), ins[0])]
+
+
+@handles("Pad")
+def _h_pad(ctx, node, attrs, ins):
+    mode = attrs.get("mode", "constant")
+    if len(ins) > 1 and ins[1] is not None:
+        pads = [int(v) for v in _require_host(ins[1], node, "pads").reshape(-1)]
+        cval = float(_require_host(ins[2], node, "value")) if len(ins) > 2 and ins[2] is not None else 0.0
+    else:
+        pads = [int(v) for v in attrs["pads"]]
+        cval = attrs.get("value", 0.0)
+    nd = len(pads) // 2
+    pw = [(pads[i], pads[i + nd]) for i in range(nd)]
+    if mode != "constant":
+        return [_apply(ctx, lambda a: jnp.pad(a, pw, mode=mode), ins[0])]
+    return [autograd.pad(ctx.tensor(ins[0]), pw, cval)]
+
+
+# -- reductions --------------------------------------------------------------
+
+_REDUCE = {
+    "ReduceSum": autograd.reduce_sum,
+    "ReduceMean": autograd.reduce_mean,
+    "ReduceMax": autograd.reduce_max,
+    "ReduceMin": autograd.reduce_min,
+}
+
+
+@handles(*_REDUCE, "ReduceProd", "ReduceL2")
+def _h_reduce(ctx, node, attrs, ins):
+    keepdims = bool(attrs.get("keepdims", 1))
+    axes = _axes_arg(node, attrs, ins, 1, ctx.opset)
+    if axes is None and attrs.get("noop_with_empty_axes", 0):
+        return [ctx.tensor(ins[0])]
+    ax = tuple(axes) if axes is not None else None
+    if node.op_type == "ReduceProd":
+        return [_apply(ctx, lambda a: jnp.prod(a, axis=ax, keepdims=keepdims), ins[0])]
+    if node.op_type == "ReduceL2":
+        return [_apply(ctx, lambda a: jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdims)),
+                       ins[0])]
+    return [_REDUCE[node.op_type](ctx.tensor(ins[0]), ax, keepdims)]
+
+
+@handles("ArgMax", "ArgMin")
+def _h_argmax(ctx, node, attrs, ins):
+    axis = attrs.get("axis", 0)
+    keepdims = bool(attrs.get("keepdims", 1))
+    fn = jnp.argmax if node.op_type == "ArgMax" else jnp.argmin
+
+    def arg(a):
+        out = fn(a, axis=axis).astype(jnp.int64)
+        return jnp.expand_dims(out, axis) if keepdims else out
+
+    return [_apply(ctx, arg, ins[0])]
+
+
+# -- NN ops ------------------------------------------------------------------
+
+@handles("Conv")
+def _h_conv(ctx, node, attrs, ins):
+    """ONNX Conv is NCHW/OIHW; our MXU path is NHWC/HWIO
+    (autograd.Conv2d) — transpose in, convolve, transpose out; XLA
+    cancels back-to-back transposes between stacked convs."""
+    x = ctx.tensor(ins[0])
+    w = ctx.tensor(ins[1])
+    b = ctx.tensor(ins[2]) if len(ins) > 2 and ins[2] is not None else None
+    spatial = len(x.shape) - 2
+    one_d = spatial == 1
+    if one_d:  # lift 1-D conv to H=1 2-D
+        x = autograd.unsqueeze(x, 2)   # N C 1 W
+        w = autograd.unsqueeze(w, 2)   # O I 1 K
+        spatial = 2
+    if spatial != 2:
+        raise ValueError(f"Conv: only 1-D/2-D supported, got {spatial}-D")
+    strides = list(attrs.get("strides", [1] * spatial))
+    dil = list(attrs.get("dilations", [1] * spatial))
+    groups = attrs.get("group", 1)
+    if one_d:
+        strides = [1] + strides if len(strides) == 1 else strides
+        dil = [1] + dil if len(dil) == 1 else dil
+    in_sp = x.shape[2:]
+    k_sp = w.shape[2:]
+    eff_k = [(k - 1) * d + 1 for k, d in zip(k_sp, dil)]
+    auto = attrs.get("auto_pad", "NOTSET")
+    if auto in ("NOTSET", ""):
+        pads_attr = list(attrs.get("pads", [0] * (2 * spatial)))
+        if one_d and len(pads_attr) == 2:
+            pads_attr = [0, pads_attr[0], 0, pads_attr[1]]
+        pads = [(pads_attr[i], pads_attr[i + spatial]) for i in range(spatial)]
+    elif auto == "VALID":
+        pads = [(0, 0)] * spatial
+    else:
+        pads = []
+        for i in range(spatial):
+            rem = in_sp[i] % strides[i]
+            total = max(0, eff_k[i] - (rem if rem else strides[i]))
+            lo, hi = total // 2, total - total // 2
+            pads.append((lo, hi) if auto == "SAME_UPPER" else (hi, lo))
+    xh = autograd.transpose(x, (0, 2, 3, 1))          # NCHW -> NHWC
+    wh = autograd.transpose(w, (2, 3, 1, 0))          # OIHW -> HWIO
+    y = autograd.conv2d(xh, wh, None, stride=tuple(strides), padding=pads,
+                        groups=groups, dilation=tuple(dil))
+    y = autograd.transpose(y, (0, 3, 1, 2))           # NHWC -> NCHW
+    if b is not None:
+        y = autograd.add_bias(y, b, axis=1)
+    if one_d:
+        y = autograd.squeeze(y, 2)
+    return [y]
+
+
+@handles("MaxPool", "AveragePool")
+def _h_pool(ctx, node, attrs, ins):
+    x = ctx.tensor(ins[0])
+    if len(x.shape) != 4:
+        raise ValueError("MaxPool/AveragePool: 2-D only")
+    kernel = tuple(attrs["kernel_shape"])
+    strides = tuple(attrs.get("strides", kernel))
+    pads = list(attrs.get("pads", [0, 0, 0, 0]))
+    if attrs.get("ceil_mode", 0):
+        raise ValueError("pool ceil_mode=1 not supported (static shapes)")
+    if pads[0] != pads[2] or pads[1] != pads[3]:
+        raise ValueError("asymmetric pool padding not supported")
+    if pads[0] != pads[1]:
+        raise ValueError("non-square pool padding not supported")
+    p = pads[0]
+    xh = autograd.transpose(x, (0, 2, 3, 1))
+    if node.op_type == "MaxPool":
+        y = autograd.max_pool2d(xh, kernel, strides, p)
+    elif attrs.get("count_include_pad", 0) or p == 0:
+        y = autograd.avg_pool2d(xh, kernel, strides, p)
+    else:
+        # ONNX default count_include_pad=0: denominator excludes padding
+        def avg_excl_pad(xv):  # NHWC
+            pw = ((0, 0), (p, p), (p, p), (0, 0))
+            win = (1,) + kernel + (1,)
+            st = (1,) + strides + (1,)
+            s = jax.lax.reduce_window(xv, 0.0, jax.lax.add, win, st, pw)
+            cnt = jax.lax.reduce_window(jnp.ones_like(xv), 0.0, jax.lax.add,
+                                        win, st, pw)
+            return s / cnt
+
+        y = _apply(ctx, avg_excl_pad, xh)
+    return [autograd.transpose(y, (0, 3, 1, 2))]
+
+
+@handles("GlobalAveragePool")
+def _h_gap(ctx, node, attrs, ins):
+    x = ctx.tensor(ins[0])
+    sp = tuple(range(2, len(x.shape)))
+    return [autograd.reduce_mean(x, sp, keepdims=True)]
+
+
+@handles("GlobalMaxPool")
+def _h_gmp(ctx, node, attrs, ins):
+    x = ctx.tensor(ins[0])
+    sp = tuple(range(2, len(x.shape)))
+    return [autograd.reduce_max(x, sp, keepdims=True)]
+
+
+@handles("BatchNormalization")
+def _h_batchnorm(ctx, node, attrs, ins):
+    eps = attrs.get("epsilon", 1e-5)
+    x, scale, bias, mean, var = (ctx.tensor(v) for v in ins[:5])
+
+    def bn(xv, s, b, m, v):
+        shp = (1, -1) + (1,) * (xv.ndim - 2)  # channel axis 1 (NCHW)
+        return ((xv - m.reshape(shp)) * jax.lax.rsqrt(v.reshape(shp) + eps)
+                * s.reshape(shp) + b.reshape(shp))
+
+    y = _JnpOp(bn)(x, scale, bias, mean, var)
+    outs = [y]
+    # training-mode extra outputs (running stats) are not produced; the
+    # importer targets inference graphs (training uses singa.layer.BatchNorm2d)
+    for _ in node.output[1:]:
+        outs.append(mean)
+    return outs[:len(node.output)]
+
+
+@handles("LayerNormalization")
+def _h_layernorm(ctx, node, attrs, ins):
+    eps = attrs.get("epsilon", 1e-5)
+    axis = attrs.get("axis", -1)
+    x = ctx.tensor(ins[0])
+    scale = ctx.tensor(ins[1])
+    bias = ctx.tensor(ins[2]) if len(ins) > 2 and ins[2] is not None else None
+    nd = len(x.shape)
+    ax = axis % nd
+    axes = tuple(range(ax, nd))
+
+    def ln(xv, s, *b):
+        mu = jnp.mean(xv, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(xv - mu), axis=axes, keepdims=True)
+        y = (xv - mu) * jax.lax.rsqrt(var + eps) * s
+        if b:
+            y = y + b[0]
+        return y
+
+    args = (x, scale) + ((bias,) if bias is not None else ())
+    y = _JnpOp(ln)(*args)
+    outs = [y]
+    for name in node.output[1:]:
+        outs.append(y)  # mean/invstd outputs rarely consumed; placeholder
+    return outs[:len(node.output)]
+
+
+@handles("InstanceNormalization")
+def _h_instancenorm(ctx, node, attrs, ins):
+    eps = attrs.get("epsilon", 1e-5)
+
+    def inorm(xv, s, b):
+        axes = tuple(range(2, xv.ndim))
+        mu = jnp.mean(xv, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(xv - mu), axis=axes, keepdims=True)
+        shp = (1, -1) + (1,) * (xv.ndim - 2)
+        return (xv - mu) * jax.lax.rsqrt(var + eps) * s.reshape(shp) + b.reshape(shp)
+
+    return [_apply(ctx, inorm, *ins[:3])]
+
+
+@handles("Dropout")
+def _h_dropout(ctx, node, attrs, ins):
+    x = ctx.tensor(ins[0])
+    ratio = attrs.get("ratio", 0.5)
+    if len(ins) > 1 and ins[1] is not None:
+        ratio = float(_require_host(ins[1], node, "ratio"))
+    train = False
+    if len(ins) > 2 and ins[2] is not None:
+        train = bool(_require_host(ins[2], node, "training_mode"))
+    y = autograd.dropout(x, ratio) if (train and ctx.training) else x
+    outs = [y]
+    if len(node.output) > 1:
+        outs.append(np.ones(x.shape, np.bool_))
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# the backend rep
+# ---------------------------------------------------------------------------
+
+class SingaRep(model_mod.Model):
+    """An imported ONNX graph as a singa model.
+
+    `run(inputs)` mirrors the reference backend-rep surface; because this
+    is a `model.Model`, `compile()` + graph mode captures the whole
+    imported network into a single XLA module, and float initializers are
+    trainable params (training-capable import)."""
+
+    def __init__(self, model_proto: ModelProto, device=None,
+                 init_inputs: Optional[Sequence] = None, name: str = "onnx"):
+        super().__init__(name=name)
+        self.proto_model = model_proto
+        g = model_proto.graph
+        if g is None:
+            raise ValueError("ModelProto has no graph")
+        self.onnx_graph = g
+        self.device_ = device or get_default_device()
+        self.opset = 18
+        for op in model_proto.opset_import:
+            if (op.domain or "") == "":
+                self.opset = int(op.version or 18)
+        # initializers → params (float ⇒ trainable) / constants
+        self._consts: Dict[str, Any] = {}
+        self._param_alias: Dict[str, str] = {}
+        for init in g.initializer:
+            arr = to_array(init)
+            # 0-d float initializers are scale/eps constants, not weights
+            if np.issubdtype(arr.dtype, np.floating) and arr.ndim > 0:
+                pname = _sanitize(init.name)
+                t = Tensor(data=jnp.asarray(arr), device=self.device_,
+                           requires_grad=True, stores_grad=True,
+                           name=pname)
+                self.register_param(pname, t)
+                self._param_alias[init.name] = pname
+            else:
+                self._consts[init.name] = arr
+        init_names = ({i.name for i in g.initializer})
+        self.input_names = [vi.name for vi in g.input if vi.name not in init_names]
+        self.output_names = [vi.name for vi in g.output]
+        unsupported = sorted({n.op_type for n in g.node if n.op_type not in _HANDLERS})
+        if unsupported:
+            raise NotImplementedError(
+                f"unsupported ONNX ops: {unsupported}; supported: "
+                f"{supported_ops()}")
+
+    # -- execution ------------------------------------------------------------
+    def forward(self, *inputs):
+        if len(inputs) != len(self.input_names):
+            raise ValueError(
+                f"expected {len(self.input_names)} inputs "
+                f"{self.input_names}, got {len(inputs)}")
+        ctx = _Ctx(self.device_, self.opset, autograd.is_training())
+        env: Dict[str, Any] = dict(self._consts)
+        for onnx_name, pname in self._param_alias.items():
+            env[onnx_name] = self._params[pname]
+        for name, v in zip(self.input_names, inputs):
+            env[name] = v if isinstance(v, Tensor) else ctx.tensor(np.asarray(v))
+        for node in self.onnx_graph.node:
+            ins = [env[i] if i else None for i in node.input]
+            outs = _HANDLERS[node.op_type](ctx, node, _attrs(node), ins)
+            for name, v in zip(node.output, outs):
+                if name:
+                    env[name] = v
+        outs = []
+        for name in self.output_names:
+            v = env[name]
+            outs.append(v if isinstance(v, Tensor) else ctx.tensor(v))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def run(self, inputs: Sequence) -> List[Tensor]:
+        """Reference backend-rep surface: list in, list of Tensors out."""
+        out = self(*inputs)
+        return list(out) if isinstance(out, tuple) else [out]
+
+
+def _sanitize(name: str) -> str:
+    out = "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+    return out or "param"
+
+
+class SingaBackend:
+    """onnx-backend-style entry (reference `sonnx.SingaBackend`)."""
+
+    @staticmethod
+    def supports_device(device: str) -> bool:
+        return True
+
+    @staticmethod
+    def prepare(model_proto: ModelProto, device=None, **kwargs) -> SingaRep:
+        return SingaRep(model_proto, device=device, **kwargs)
+
+
+def prepare(model_proto: Union[ModelProto, bytes, str], device=None,
+            **kwargs) -> SingaRep:
+    """Import an ONNX model (path / bytes / ModelProto) for execution +
+    training on singa_tpu (reference sonnx.prepare, SURVEY.md §3.4)."""
+    if isinstance(model_proto, (bytes, bytearray)):
+        model_proto = ModelProto.FromString(bytes(model_proto))
+    elif isinstance(model_proto, str):
+        model_proto = proto.load(model_proto)
+    return SingaBackend.prepare(model_proto, device=device, **kwargs)

@@ -1,0 +1,629 @@
+"""sonnx export — singa_tpu model → ONNX ModelProto.
+
+Capability parity: the reference's `sonnx.to_onnx` export path
+(BASELINE.json:5 "the sonnx ONNX importer" — import+export is the
+interchange surface; SURVEY.md §5 checkpoint/interchange).  Mechanism:
+run one forward pass with every `autograd.Operator.__call__` recorded
+(a real tape with output identity, so multi-output ops export
+correctly), then map each recorded op to ONNX node(s).
+
+Layout note: our conv/pool/batchnorm compute in NHWC (the TPU/MXU
+layout); ONNX spec ops are NCHW, so export wraps them in Transpose
+pairs and stores conv weights transposed HWIO→OIHW.  Reimporting with
+`sonnx.prepare` cancels the transposes inside one XLA fusion.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import autograd
+from ..tensor import Tensor
+from . import proto
+from .proto import TensorProto, make_model, make_node, make_tensor_value_info
+
+__all__ = ["to_onnx", "export", "save"]
+
+
+@contextlib.contextmanager
+def _record_ops():
+    """Temporarily wrap Operator.__call__ to log (op, inputs, outputs)."""
+    orig = autograd.Operator.__call__
+    tape: List[Tuple[Any, Tuple[Tensor, ...], Tuple[Tensor, ...]]] = []
+
+    def wrapped(self, *inputs):
+        out = orig(self, *inputs)
+        outs = out if isinstance(out, tuple) else (out,)
+        tape.append((self, inputs, outs))
+        return out
+
+    autograd.Operator.__call__ = wrapped
+    try:
+        yield tape
+    finally:
+        autograd.Operator.__call__ = orig
+
+
+class _Exporter:
+    def __init__(self):
+        self.nodes: List[proto.NodeProto] = []
+        self.initializers: List[TensorProto] = []
+        self.names: Dict[int, str] = {}      # id(Tensor) -> graph name
+        self._counter = 0
+        self._used: set = set()
+
+    # -- naming ---------------------------------------------------------------
+    def fresh(self, hint: str = "t") -> str:
+        self._counter += 1
+        name = f"{hint}_{self._counter}"
+        while name in self._used:
+            self._counter += 1
+            name = f"{hint}_{self._counter}"
+        self._used.add(name)
+        return name
+
+    def name_of(self, t: Tensor) -> str:
+        n = self.names.get(id(t))
+        if n is None:
+            # leaf never seen: a captured constant — emit as initializer
+            n = self.fresh("const")
+            self.names[id(t)] = n
+            self.initializers.append(proto.from_array(np.asarray(t.data), n))
+        return n
+
+    def bind(self, t: Tensor, name: str) -> None:
+        self.names[id(t)] = name
+        self._used.add(name)
+
+    def add_init(self, arr: np.ndarray, hint: str) -> str:
+        n = self.fresh(hint)
+        self.initializers.append(proto.from_array(np.asarray(arr), n))
+        return n
+
+    def emit(self, op_type: str, ins: Sequence[str], outs: Sequence[str],
+             **attrs) -> None:
+        self.nodes.append(make_node(op_type, ins, outs,
+                                    name=self.fresh(op_type.lower()), **attrs))
+
+
+# ---------------------------------------------------------------------------
+# per-op export rules: fn(ex, op, in_names, out_tensors) -> None
+# (out_tensors already have names bound via ex.names)
+# ---------------------------------------------------------------------------
+
+_EXPORT: Dict[type, Callable] = {}
+
+
+def _exports(*op_classes):
+    def deco(fn):
+        for c in op_classes:
+            _EXPORT[c] = fn
+        return fn
+    return deco
+
+
+def _outn(ex, outs):
+    return [ex.names[id(o)] for o in outs]
+
+
+_SIMPLE = {
+    autograd.Add: "Add", autograd.Sub: "Sub", autograd.Mul: "Mul",
+    autograd.Div: "Div", autograd.Pow: "Pow", autograd.Neg: "Neg",
+    autograd.Abs: "Abs", autograd.Exp: "Exp", autograd.Log: "Log",
+    autograd.Sqrt: "Sqrt", autograd.Erf: "Erf", autograd.Matmul: "MatMul",
+    autograd.ReLU: "Relu", autograd.Sigmoid: "Sigmoid",
+    autograd.Tanh: "Tanh", autograd.Softplus: "Softplus",
+}
+
+
+@_exports(*_SIMPLE)
+def _e_simple(ex, op, ins, outs):
+    ex.emit(_SIMPLE[type(op)], ins, _outn(ex, outs))
+
+
+@_exports(autograd.Gelu)
+def _e_gelu(ex, op, ins, outs):
+    ex.emit("Gelu", ins, _outn(ex, outs))
+
+
+@_exports(autograd.SiLU)
+def _e_silu(ex, op, ins, outs):
+    mid = ex.fresh("sig")
+    ex.emit("Sigmoid", ins, [mid])
+    ex.emit("Mul", [ins[0], mid], _outn(ex, outs))
+
+
+@_exports(autograd.Rsqrt)
+def _e_rsqrt(ex, op, ins, outs):
+    mid = ex.fresh("sqrt")
+    ex.emit("Sqrt", ins, [mid])
+    ex.emit("Reciprocal", [mid], _outn(ex, outs))
+
+
+@_exports(autograd.LeakyReLU)
+def _e_leaky(ex, op, ins, outs):
+    ex.emit("LeakyRelu", ins, _outn(ex, outs), alpha=float(op.slope))
+
+
+@_exports(autograd.Elu)
+def _e_elu(ex, op, ins, outs):
+    ex.emit("Elu", ins, _outn(ex, outs), alpha=float(op.alpha))
+
+
+@_exports(autograd.Softmax)
+def _e_softmax(ex, op, ins, outs):
+    ex.emit("Softmax", ins, _outn(ex, outs), axis=int(op.axis))
+
+
+@_exports(autograd.LogSoftmax)
+def _e_logsoftmax(ex, op, ins, outs):
+    ex.emit("LogSoftmax", ins, _outn(ex, outs), axis=int(op.axis))
+
+
+@_exports(autograd.Cast)
+def _e_cast(ex, op, ins, outs):
+    to = proto.np_dtype_to_tensor_dtype(np.dtype(op.dtype))
+    ex.emit("Cast", ins, _outn(ex, outs), to=to)
+
+
+@_exports(autograd.Clip)
+def _e_clip(ex, op, ins, outs):
+    dt = np.asarray(outs[0].data).dtype
+    lo = ex.add_init(np.asarray(op.lo, dt), "clip_min")
+    hi = ex.add_init(np.asarray(op.hi, dt), "clip_max")
+    ex.emit("Clip", [ins[0], lo, hi], _outn(ex, outs))
+
+
+@_exports(autograd.Linear)
+def _e_linear(ex, op, ins, outs):
+    x_nd = len(op._x.shape)
+    if x_nd == 2:
+        if op.has_bias:
+            ex.emit("Gemm", ins, _outn(ex, outs))
+        else:
+            ex.emit("MatMul", ins[:2], _outn(ex, outs))
+        return
+    mm = ex.fresh("mm") if op.has_bias else _outn(ex, outs)[0]
+    ex.emit("MatMul", ins[:2], [mm])
+    if op.has_bias:
+        ex.emit("Add", [mm, ins[2]], _outn(ex, outs))
+
+
+@_exports(autograd.AddBias)
+def _e_addbias(ex, op, ins, outs):
+    x_nd = len(outs[0].shape)
+    shape = [1] * x_nd
+    shape[op.axis] = -1
+    sh = ex.add_init(np.asarray(shape, np.int64), "shape")
+    mid = ex.fresh("b_rs")
+    ex.emit("Reshape", [ins[1], sh], [mid])
+    ex.emit("Add", [ins[0], mid], _outn(ex, outs))
+
+
+@_exports(autograd.Einsum)
+def _e_einsum(ex, op, ins, outs):
+    ex.emit("Einsum", ins, _outn(ex, outs), equation=op.subscripts)
+
+
+@_exports(autograd.Reshape, autograd.Flatten, autograd.Squeeze,
+          autograd.Unsqueeze)
+def _e_reshape(ex, op, ins, outs):
+    # all four are bijective reshapes; output shape is static at export
+    sh = ex.add_init(np.asarray(outs[0].shape, np.int64), "shape")
+    ex.emit("Reshape", [ins[0], sh], _outn(ex, outs))
+
+
+@_exports(autograd.Transpose)
+def _e_transpose(ex, op, ins, outs):
+    perm = op.axes
+    if perm is None:
+        perm = tuple(reversed(range(len(outs[0].shape))))
+    ex.emit("Transpose", ins, _outn(ex, outs), perm=list(perm))
+
+
+@_exports(autograd.Cat)
+def _e_cat(ex, op, ins, outs):
+    ex.emit("Concat", ins, _outn(ex, outs), axis=int(op.axis))
+
+
+@_exports(autograd.Stack)
+def _e_stack(ex, op, ins, outs):
+    axis = int(op.axis)
+    mids = []
+    ax_init = ex.add_init(np.asarray([axis], np.int64), "axes")
+    for i in ins:
+        m = ex.fresh("unsq")
+        ex.emit("Unsqueeze", [i, ax_init], [m])
+        mids.append(m)
+    ex.emit("Concat", mids, _outn(ex, outs), axis=axis)
+
+
+@_exports(autograd.Split)
+def _e_split(ex, op, ins, outs):
+    axis = int(op.axis)
+    if isinstance(op.parts, int):
+        total = sum(o.shape[axis] for o in outs)
+        parts = [total // op.parts] * op.parts
+    else:
+        parts = list(op.parts)
+    sp = ex.add_init(np.asarray(parts, np.int64), "split")
+    ex.emit("Split", [ins[0], sp], _outn(ex, outs), axis=axis)
+
+
+@_exports(autograd.Gather)
+def _e_gather(ex, op, ins, outs):
+    idx = ex.add_init(np.asarray(op.indices, np.int64), "indices")
+    ex.emit("Gather", [ins[0], idx], _outn(ex, outs), axis=int(op.axis))
+
+
+@_exports(autograd.Embedding)
+def _e_embedding(ex, op, ins, outs):
+    ex.emit("Gather", [ins[0], ins[1]], _outn(ex, outs), axis=0)
+
+
+@_exports(autograd.Index)
+def _e_index(ex, op, ins, outs):
+    idx = op.idx if isinstance(op.idx, tuple) else (op.idx,)
+    if not all(isinstance(s, (slice, int)) for s in idx):
+        raise NotImplementedError(
+            "ONNX export of advanced (array) indexing is unsupported")
+    in_shape = op._shape
+    starts, ends, axes, steps = [], [], [], []
+    squeeze_axes = []
+    for a, s in enumerate(idx):
+        if isinstance(s, int):
+            starts.append(s)
+            ends.append(s + 1 if s != -1 else np.iinfo(np.int64).max)
+            axes.append(a)
+            steps.append(1)
+            squeeze_axes.append(a)
+            continue
+        if s == slice(None):
+            continue
+        step = 1 if s.step is None else s.step
+        i64 = np.iinfo(np.int64)
+        # open bounds flip sentinels under negative step (ONNX Slice spec)
+        starts.append((i64.max if step < 0 else 0) if s.start is None else s.start)
+        ends.append((i64.min if step < 0 else i64.max) if s.stop is None else s.stop)
+        axes.append(a)
+        steps.append(step)
+    del in_shape
+    outn = _outn(ex, outs)
+    target = outn[0] if not squeeze_axes else ex.fresh("sliced")
+    if starts:
+        ex.emit("Slice",
+                [ins[0],
+                 ex.add_init(np.asarray(starts, np.int64), "starts"),
+                 ex.add_init(np.asarray(ends, np.int64), "ends"),
+                 ex.add_init(np.asarray(axes, np.int64), "axes"),
+                 ex.add_init(np.asarray(steps, np.int64), "steps")],
+                [target])
+    else:
+        ex.emit("Identity", [ins[0]], [target])
+    if squeeze_axes:
+        sq = ex.add_init(np.asarray(squeeze_axes, np.int64), "axes")
+        ex.emit("Squeeze", [target, sq], outn)
+
+
+@_exports(autograd.Pad)
+def _e_pad(ex, op, ins, outs):
+    pw = op.pad_width
+    pads = [p[0] for p in pw] + [p[1] for p in pw]
+    pn = ex.add_init(np.asarray(pads, np.int64), "pads")
+    dt = np.asarray(outs[0].data).dtype
+    cv = ex.add_init(np.asarray(op.value, dt), "pad_value")
+    ex.emit("Pad", [ins[0], pn, cv], _outn(ex, outs))
+
+
+@_exports(autograd.Where)
+def _e_where(ex, op, ins, outs):
+    cond = ex.add_init(np.asarray(op.cond, np.bool_), "cond")
+    ex.emit("Where", [cond, ins[0], ins[1]], _outn(ex, outs))
+
+
+@_exports(autograd.Dropout)
+def _e_dropout(ex, op, ins, outs):
+    ex.emit("Identity", ins, _outn(ex, outs))  # export = inference graph
+
+
+def _reduce_common(ex, op, ins, outs, op_type):
+    axes = op.axis
+    outn = _outn(ex, outs)
+    inputs = [ins[0]]
+    if axes is not None:
+        ax = [axes] if isinstance(axes, int) else list(axes)
+        inputs.append(ex.add_init(np.asarray(ax, np.int64), "axes"))
+    ex.emit(op_type, inputs, outn, keepdims=int(bool(op.keepdims)))
+
+
+@_exports(autograd.ReduceSum)
+def _e_rsum(ex, op, ins, outs):
+    _reduce_common(ex, op, ins, outs, "ReduceSum")
+
+
+@_exports(autograd.ReduceMean)
+def _e_rmean(ex, op, ins, outs):
+    _reduce_common(ex, op, ins, outs, "ReduceMean")
+
+
+@_exports(autograd.ReduceMax)
+def _e_rmax(ex, op, ins, outs):
+    _reduce_common(ex, op, ins, outs, "ReduceMax")
+
+
+@_exports(autograd.ReduceMin)
+def _e_rmin(ex, op, ins, outs):
+    _reduce_common(ex, op, ins, outs, "ReduceMin")
+
+
+@_exports(autograd.LayerNorm)
+def _e_layernorm(ex, op, ins, outs):
+    ex.emit("LayerNormalization", ins, _outn(ex, outs),
+            axis=-1, epsilon=float(op.eps))
+
+
+@_exports(autograd.RMSNorm)
+def _e_rmsnorm(ex, op, ins, outs):
+    # decompose: y = x * rsqrt(mean(x^2) + eps) * gamma  (portable ONNX)
+    x, gamma = ins
+    sq = ex.fresh("sq")
+    ex.emit("Mul", [x, x], [sq])
+    mean = ex.fresh("ms")
+    ax = ex.add_init(np.asarray([-1], np.int64), "axes")
+    ex.emit("ReduceMean", [sq, ax], [mean], keepdims=1)
+    dt = np.asarray(outs[0].data).dtype
+    epsn = ex.add_init(np.asarray(op.eps, np.float32 if dt == np.float32 else dt), "eps")
+    shifted = ex.fresh("ms_eps")
+    ex.emit("Add", [mean, epsn], [shifted])
+    rt = ex.fresh("sqrt")
+    ex.emit("Sqrt", [shifted], [rt])
+    normed = ex.fresh("normed")
+    ex.emit("Div", [x, rt], [normed])
+    ex.emit("Mul", [normed, gamma], _outn(ex, outs))
+
+
+def _nhwc_in(ex, name):
+    out = ex.fresh("nchw")
+    ex.emit("Transpose", [name], [out], perm=[0, 3, 1, 2])
+    return out
+
+
+def _nhwc_out(ex, nchw_name, final_name):
+    ex.emit("Transpose", [nchw_name], [final_name], perm=[0, 2, 3, 1])
+
+
+@_exports(autograd.Conv2d)
+def _e_conv(ex, op, ins, outs):
+    if isinstance(op.padding, str):
+        pads = None
+        auto_pad = "SAME_UPPER" if op.padding == "SAME" else "VALID"
+    else:
+        (pt, pb), (pl, pr) = op.padding
+        pads = [pt, pl, pb, pr]
+        auto_pad = None
+    # weight initializer was stored HWIO (our layout) — re-emit as OIHW
+    x_nchw = _nhwc_in(ex, ins[0])
+    w_t = ex.fresh("w_oihw")
+    ex.emit("Transpose", [ins[1]], [w_t], perm=[3, 2, 0, 1])
+    conv_in = [x_nchw, w_t]
+    y_nchw = ex.fresh("conv_out")
+    attrs = dict(strides=list(op.stride), dilations=list(op.dilation),
+                 group=int(op.groups))
+    if pads is not None:
+        attrs["pads"] = pads
+    else:
+        attrs["auto_pad"] = auto_pad
+    ex.emit("Conv", conv_in, [y_nchw], **attrs)
+    if len(ins) > 2:  # bias was added inside our fused conv
+        y_b = ex.fresh("conv_bias")
+        shp = ex.add_init(np.asarray([1, -1, 1, 1], np.int64), "shape")
+        b_r = ex.fresh("b_r")
+        ex.emit("Reshape", [ins[2], shp], [b_r])
+        ex.emit("Add", [y_nchw, b_r], [y_b])
+        y_nchw = y_b
+    _nhwc_out(ex, y_nchw, _outn(ex, outs)[0])
+
+
+@_exports(autograd.MaxPool2d, autograd.AvgPool2d)
+def _e_pool(ex, op, ins, outs):
+    is_max = isinstance(op, autograd.MaxPool2d)
+    p = int(op.padding)
+    x_nchw = _nhwc_in(ex, ins[0])
+    y_nchw = ex.fresh("pool_out")
+    attrs = dict(kernel_shape=list(op.kernel), strides=list(op.stride),
+                 pads=[p, p, p, p])
+    if not is_max:
+        # our AvgPool2d always divides by the full kernel area
+        attrs["count_include_pad"] = 1
+    ex.emit("MaxPool" if is_max else "AveragePool", [x_nchw], [y_nchw],
+            **attrs)
+    _nhwc_out(ex, y_nchw, _outn(ex, outs)[0])
+
+
+@_exports(autograd.BatchNorm)
+def _e_batchnorm(ex, op, ins, outs):
+    # ins: x (NHWC), gamma, beta, mean, var
+    x_nchw = _nhwc_in(ex, ins[0])
+    y_nchw = ex.fresh("bn_out")
+    ex.emit("BatchNormalization",
+            [x_nchw, ins[1], ins[2], ins[3], ins[4]], [y_nchw],
+            epsilon=float(op.eps))
+    _nhwc_out(ex, y_nchw, _outn(ex, outs)[0])
+
+
+def _register_sdpa_rule():
+    """Fused attention (singa_tpu.ops.attention.SDPA) → portable ONNX:
+    head-transposed MatMul / Mul(scale) / Where(mask) / Softmax / MatMul.
+    GQA (kv heads K < H) is expressed by tiling kv heads to H via
+    Unsqueeze+Expand+Reshape, which ONNX runtimes fold."""
+    from ..ops.attention import SDPA
+
+    @_exports(SDPA)
+    def _e_sdpa(ex, op, ins, outs):
+        import math
+        q_t, k_t, v_t = ex.cur_in_tensors
+        B, Tq, H, D = q_t.shape
+        Tk, K = k_t.shape[1], k_t.shape[2]
+        scale = op.scale or (1.0 / math.sqrt(D))
+        kn, vn = ins[1], ins[2]
+        if K != H:  # tile kv heads up to H
+            for src, tag in ((kn, "k"), (vn, "v")):
+                u = ex.fresh(f"{tag}_unsq")
+                ex.emit("Unsqueeze",
+                        [src, ex.add_init(np.asarray([3], np.int64), "axes")],
+                        [u])
+                e = ex.fresh(f"{tag}_exp")
+                ex.emit("Expand",
+                        [u, ex.add_init(
+                            np.asarray([B, Tk, K, H // K, D], np.int64),
+                            "shape")], [e])
+                r = ex.fresh(f"{tag}_rep")
+                ex.emit("Reshape",
+                        [e, ex.add_init(np.asarray([B, Tk, H, D], np.int64),
+                                        "shape")], [r])
+                if tag == "k":
+                    kn = r
+                else:
+                    vn = r
+        qh = ex.fresh("qh")
+        ex.emit("Transpose", [ins[0]], [qh], perm=[0, 2, 1, 3])  # B,H,Tq,D
+        kT = ex.fresh("kT")
+        ex.emit("Transpose", [kn], [kT], perm=[0, 2, 3, 1])      # B,H,D,Tk
+        raw = ex.fresh("scores_raw")
+        ex.emit("MatMul", [qh, kT], [raw])
+        scores = ex.fresh("scores")
+        ex.emit("Mul", [raw, ex.add_init(np.asarray(scale, np.float32),
+                                         "scale")], [scores])
+        neg = ex.add_init(
+            np.asarray(np.finfo(np.float32).min, np.float32), "neg_inf")
+        if op.causal:
+            cm = np.tril(np.ones((Tq, Tk), np.bool_), k=Tk - Tq)
+            cmn = ex.add_init(cm, "causal_mask")
+            masked = ex.fresh("masked")
+            ex.emit("Where", [cmn, scores, neg], [masked])
+            scores = masked
+        if op.mask is not None:
+            import warnings
+            warnings.warn(
+                "sonnx export: the attention mask passed at trace time is "
+                "frozen into the exported graph as a constant (trace-time "
+                "constant folding). Export without attention_mask if the "
+                "mask varies per batch.", stacklevel=2)
+            mn = ex.add_init(np.asarray(op.mask, np.bool_), "attn_mask")
+            masked = ex.fresh("masked")
+            ex.emit("Where", [mn, scores, neg], [masked])
+            scores = masked
+        probs = ex.fresh("probs")
+        ex.emit("Softmax", [scores], [probs], axis=-1)
+        vh = ex.fresh("vh")
+        ex.emit("Transpose", [vn], [vh], perm=[0, 2, 1, 3])      # B,H,Tk,D
+        ctx = ex.fresh("ctx")
+        ex.emit("MatMul", [probs, vh], [ctx])                    # B,H,Tq,D
+        ex.emit("Transpose", [ctx], _outn(ex, outs), perm=[0, 2, 1, 3])
+
+
+_register_sdpa_rule()
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def to_onnx(model, inputs: Sequence, name: Optional[str] = None,
+            opset_version: int = 18) -> proto.ModelProto:
+    """Trace `model(*inputs)` and build an ONNX ModelProto.
+
+    `inputs` — example Tensors (shapes become the graph signature).
+    The model runs in eval mode; params become initializers."""
+    from ..device import get_default_device
+
+    was_training = autograd.is_training()
+    autograd.set_training(False)
+    try:
+        ts = []
+        dev = getattr(model, "device_", None) or get_default_device()
+        for x in inputs:
+            ts.append(x if isinstance(x, Tensor)
+                      else Tensor(data=np.asarray(x), device=dev))
+        with _record_ops() as tape:
+            out = model(*ts) if len(ts) > 1 else model(ts[0])
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+    finally:
+        autograd.set_training(was_training)
+
+    ex = _Exporter()
+    # bind params first so they keep their model names
+    graph_inputs = []
+    for i, t in enumerate(ts):
+        in_name = f"input_{i}"
+        ex.bind(t, in_name)
+        graph_inputs.append(make_tensor_value_info(
+            in_name, proto.np_dtype_to_tensor_dtype(np.asarray(t.data).dtype),
+            list(t.shape)))
+    param_map = {}
+    if hasattr(model, "get_params"):
+        for pname, p in model.get_params().items():
+            if id(p) not in ex.names:
+                ex.bind(p, pname)
+                param_map[pname] = p
+                ex.initializers.append(
+                    proto.from_array(np.asarray(p.data), pname))
+    if hasattr(model, "_get_buffers"):
+        for sname, s in model._get_buffers().items():
+            if id(s) not in ex.names:
+                ex.bind(s, sname)
+                ex.initializers.append(
+                    proto.from_array(np.asarray(s.data), sname))
+
+    # name every tape output, then emit in recorded (topological) order
+    needed = _live_ops(tape, outs)
+    for op, op_ins, op_outs in needed:
+        for o in op_outs:
+            if id(o) not in ex.names:
+                ex.bind(o, ex.fresh("t"))
+    for op, op_ins, op_outs in needed:
+        rule = _EXPORT.get(type(op))
+        if rule is None:
+            raise NotImplementedError(
+                f"no ONNX export rule for autograd.{type(op).__name__}")
+        in_names = [ex.name_of(t) for t in op_ins]
+        ex.cur_in_tensors = op_ins  # rules that need input shapes read this
+        rule(ex, op, in_names, op_outs)
+
+    graph_outputs = []
+    for i, o in enumerate(outs):
+        oname = ex.names.get(id(o))
+        if oname is None:  # output is a direct input/param passthrough
+            oname = ex.name_of(o)
+        graph_outputs.append(make_tensor_value_info(
+            oname, proto.np_dtype_to_tensor_dtype(np.asarray(o.data).dtype),
+            list(o.shape)))
+
+    g = proto.make_graph(ex.nodes, name or getattr(model, "name", "singa_model"),
+                         graph_inputs, graph_outputs, ex.initializers)
+    return make_model(g, opset_version=opset_version)
+
+
+def _live_ops(tape, outs):
+    """Keep only ops on a path to the requested outputs (dead-code prune:
+    e.g. metric branches recorded during the trace)."""
+    live = {id(o) for o in outs}
+    keep = []
+    for op, op_ins, op_outs in reversed(tape):
+        if any(id(o) in live for o in op_outs):
+            keep.append((op, op_ins, op_outs))
+            for t in op_ins:
+                live.add(id(t))
+    return list(reversed(keep))
+
+
+def export(model, inputs: Sequence, path: str, **kw) -> proto.ModelProto:
+    m = to_onnx(model, inputs, **kw)
+    proto.save(m, path)
+    return m
+
+
+save = proto.save

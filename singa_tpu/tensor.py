@@ -37,7 +37,10 @@ __all__ = [
     "softmax", "lt", "le", "gt", "ge", "eq",
 ]
 
-_rng_key = jax.random.PRNGKey(0)
+# lazy: creating a PRNGKey initializes the JAX backend, and importing
+# singa_tpu must not force that (e.g. the axon TPU tunnel can take tens
+# of seconds to come up when the user only wants CPU)
+_rng_key = None
 
 
 def set_seed(seed: int) -> None:
@@ -47,6 +50,8 @@ def set_seed(seed: int) -> None:
 
 def _next_key():
     global _rng_key
+    if _rng_key is None:
+        _rng_key = jax.random.PRNGKey(0)
     _rng_key, sub = jax.random.split(_rng_key)
     return sub
 

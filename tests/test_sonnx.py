@@ -1,0 +1,496 @@
+"""sonnx tests — ONNX proto codec, import backend, export round-trips
+(SURVEY.md §3.4 import call stack; BASELINE.json:9 BERT/GPT-2 via ONNX).
+"""
+
+import numpy as np
+import pytest
+
+import singa_tpu as st
+from singa_tpu import sonnx
+from singa_tpu.sonnx import proto
+from singa_tpu.tensor import Tensor
+
+
+def T(arr, dev=None, **kw):
+    dev = dev or st.device.get_default_device()
+    return Tensor(data=np.asarray(arr), device=dev, **kw)
+
+
+# ---------------------------------------------------------------------------
+# protobuf codec
+# ---------------------------------------------------------------------------
+
+class TestProtoCodec:
+    def test_tensor_roundtrip_f32(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        tp = proto.from_array(a, "w")
+        back = proto.to_array(proto.TensorProto.FromString(tp.SerializeToString()))
+        np.testing.assert_array_equal(a, back)
+
+    @pytest.mark.parametrize("dtype", [np.int64, np.int32, np.bool_,
+                                       np.float16, np.float64, np.uint8])
+    def test_tensor_roundtrip_dtypes(self, dtype):
+        a = (np.random.randn(2, 5) * 3).astype(dtype)
+        back = proto.to_array(proto.TensorProto.FromString(
+            proto.from_array(a, "t").SerializeToString()))
+        np.testing.assert_array_equal(a, back)
+        assert back.dtype == a.dtype
+
+    def test_tensor_bf16_roundtrip(self):
+        import ml_dtypes
+        a = np.random.randn(4, 4).astype(ml_dtypes.bfloat16)
+        back = proto.to_array(proto.TensorProto.FromString(
+            proto.from_array(a, "t").SerializeToString()))
+        np.testing.assert_array_equal(a.view(np.uint16), back.view(np.uint16))
+
+    def test_typed_field_decoding(self):
+        # float_data lane (non-raw), as real exporters sometimes emit
+        tp = proto.TensorProto(dims=[2, 2], data_type=proto.TensorProto.FLOAT,
+                               float_data=[1.0, 2.0, 3.0, 4.0])
+        rt = proto.TensorProto.FromString(tp.SerializeToString())
+        np.testing.assert_allclose(proto.to_array(rt),
+                                   [[1, 2], [3, 4]])
+
+    def test_model_roundtrip(self, tmp_path):
+        n = proto.make_node("Add", ["a", "b"], ["c"], alpha=1.5, beta=2)
+        g = proto.make_graph(
+            [n], "g",
+            [proto.make_tensor_value_info("a", proto.TensorProto.FLOAT, [2, "N"]),
+             proto.make_tensor_value_info("b", proto.TensorProto.FLOAT, [2, 1])],
+            [proto.make_tensor_value_info("c", proto.TensorProto.FLOAT, [2, None])],
+            initializer=[proto.from_array(np.ones((2, 1), np.float32), "b")])
+        m = proto.make_model(g, opset_version=17)
+        p = tmp_path / "m.onnx"
+        proto.save(m, str(p))
+        m2 = proto.load(str(p))
+        assert m2.ir_version == m.ir_version
+        assert m2.opset_import[0].version == 17
+        g2 = m2.graph
+        assert g2.node[0].op_type == "Add"
+        assert g2.node[0].input == ["a", "b"]
+        attrs = {a.name: a for a in g2.node[0].attribute}
+        assert attrs["alpha"].f == pytest.approx(1.5)
+        assert attrs["beta"].i == 2
+        assert g2.input[0].type.tensor_type.shape.dim[1].dim_param == "N"
+
+    def test_unknown_fields_skipped(self):
+        # decoder must skip fields it doesn't know (forward compat)
+        from singa_tpu.sonnx.proto import Message
+
+        class V2(Message):
+            FIELDS = {1: ("a", "int64", False), 99: ("z", "string", False)}
+
+        class V1(Message):
+            FIELDS = {1: ("a", "int64", False)}
+
+        data = V2(a=7, z="future").SerializeToString()
+        assert V1.FromString(data).a == 7
+
+
+# ---------------------------------------------------------------------------
+# import: single-op graphs
+# ---------------------------------------------------------------------------
+
+def _one_op_model(op_type, in_shapes, out_shape, n_out=1, opset=18, **attrs):
+    inputs = [proto.make_tensor_value_info(f"x{i}", proto.TensorProto.FLOAT, s)
+              for i, s in enumerate(in_shapes)]
+    outs = [proto.make_tensor_value_info(f"y{i}", proto.TensorProto.FLOAT, out_shape)
+            for i in range(n_out)]
+    node = proto.make_node(op_type, [f"x{i}" for i in range(len(in_shapes))],
+                           [f"y{i}" for i in range(n_out)], **attrs)
+    g = proto.make_graph([node], "t", inputs, outs)
+    return proto.make_model(g, opset_version=opset)
+
+
+class TestImportOps:
+    @pytest.mark.parametrize("op,fn", [
+        ("Relu", lambda x: np.maximum(x, 0)),
+        ("Neg", np.negative),
+        ("Exp", np.exp),
+        ("Tanh", np.tanh),
+        ("Sqrt", np.sqrt),
+    ])
+    def test_unary(self, op, fn):
+        x = np.random.randn(3, 4).astype(np.float32)
+        if op == "Sqrt":
+            x = np.abs(x) + 1.0
+        rep = sonnx.prepare(_one_op_model(op, [[3, 4]], [3, 4]))
+        (y,) = rep.run([T(x)])
+        np.testing.assert_allclose(np.asarray(y.data), fn(x), rtol=1e-5)
+
+    @pytest.mark.parametrize("op,fn", [
+        ("Add", np.add), ("Sub", np.subtract), ("Mul", np.multiply),
+        ("Div", np.divide),
+    ])
+    def test_binary_broadcast(self, op, fn):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4).astype(np.float32) + 2.0
+        rep = sonnx.prepare(_one_op_model(op, [[3, 4], [4]], [3, 4]))
+        (y,) = rep.run([T(a), T(b)])
+        np.testing.assert_allclose(np.asarray(y.data), fn(a, b), rtol=1e-5)
+
+    def test_gemm(self):
+        a = np.random.randn(4, 3).astype(np.float32)
+        b = np.random.randn(5, 3).astype(np.float32)
+        c = np.random.randn(5).astype(np.float32)
+        rep = sonnx.prepare(_one_op_model("Gemm", [[4, 3], [5, 3], [5]], [4, 5],
+                                          alpha=0.5, beta=2.0, transB=1))
+        (y,) = rep.run([T(a), T(b), T(c)])
+        np.testing.assert_allclose(np.asarray(y.data),
+                                   0.5 * (a @ b.T) + 2.0 * c, rtol=1e-4)
+
+    def test_softmax_default_axis_opset12_vs_13(self):
+        x = np.random.randn(2, 3, 4).astype(np.float32)
+
+        def sm(x, ax):
+            e = np.exp(x - x.max(axis=ax, keepdims=True))
+            return e / e.sum(axis=ax, keepdims=True)
+
+        r13 = sonnx.prepare(_one_op_model("Softmax", [[2, 3, 4]], [2, 3, 4],
+                                          opset=13))
+        (y13,) = r13.run([T(x)])
+        np.testing.assert_allclose(np.asarray(y13.data), sm(x, -1), rtol=1e-5)
+
+        # opset 1-12: 2-D coercion — normalize jointly over flattened [axis:]
+        r11 = sonnx.prepare(_one_op_model("Softmax", [[2, 3, 4]], [2, 3, 4],
+                                          opset=11))
+        (y11,) = r11.run([T(x)])
+        ref11 = sm(x.reshape(2, 12), -1).reshape(2, 3, 4)
+        np.testing.assert_allclose(np.asarray(y11.data), ref11, rtol=1e-5)
+
+    def test_averagepool_excludes_pad_by_default(self):
+        # ONNX count_include_pad=0 (default): corners divide by the number
+        # of real elements, not the kernel area
+        x = np.ones((1, 1, 4, 4), np.float32)
+        rep = sonnx.prepare(_one_op_model(
+            "AveragePool", [[1, 1, 4, 4]], [1, 1, 4, 4],
+            kernel_shape=[3, 3], strides=[1, 1], pads=[1, 1, 1, 1]))
+        (y,) = rep.run([T(x)])
+        np.testing.assert_allclose(np.asarray(y.data), np.ones((1, 1, 4, 4)),
+                                   rtol=1e-6)
+        rep_inc = sonnx.prepare(_one_op_model(
+            "AveragePool", [[1, 1, 4, 4]], [1, 1, 4, 4],
+            kernel_shape=[3, 3], strides=[1, 1], pads=[1, 1, 1, 1],
+            count_include_pad=1))
+        (y2,) = rep_inc.run([T(x)])
+        assert np.asarray(y2.data)[0, 0, 0, 0] == pytest.approx(4.0 / 9.0)
+
+    def test_conv_vs_torch_semantics(self):
+        # NCHW conv with padding, against scipy-free manual reference
+        import jax.numpy as jnp
+        import jax
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        w = np.random.randn(5, 3, 3, 3).astype(np.float32)
+        b = np.random.randn(5).astype(np.float32)
+        rep = sonnx.prepare(_one_op_model(
+            "Conv", [[2, 3, 8, 8], [5, 3, 3, 3], [5]], [2, 5, 8, 8],
+            pads=[1, 1, 1, 1], strides=[1, 1]))
+        (y,) = rep.run([T(x), T(w), T(b)])
+        ref = jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        ref = np.asarray(ref) + b[None, :, None, None]
+        np.testing.assert_allclose(np.asarray(y.data), ref, rtol=1e-3, atol=1e-4)
+
+    def test_maxpool(self):
+        x = np.random.randn(1, 2, 6, 6).astype(np.float32)
+        rep = sonnx.prepare(_one_op_model("MaxPool", [[1, 2, 6, 6]], [1, 2, 3, 3],
+                                          kernel_shape=[2, 2], strides=[2, 2]))
+        (y,) = rep.run([T(x)])
+        ref = x.reshape(1, 2, 3, 2, 3, 2).max(axis=(3, 5))
+        np.testing.assert_allclose(np.asarray(y.data), ref, rtol=1e-6)
+
+    def test_shape_lane_reshape(self):
+        # Shape -> Gather -> Concat -> Reshape: classic exported-shape chain,
+        # must fold to a static reshape (no dynamic shapes reach XLA)
+        x_vi = proto.make_tensor_value_info("x", proto.TensorProto.FLOAT, [2, 3, 4])
+        y_vi = proto.make_tensor_value_info("y", proto.TensorProto.FLOAT, [2, 12])
+        nodes = [
+            proto.make_node("Shape", ["x"], ["s"]),
+            proto.make_node("Gather", ["s", "i0"], ["d0"], axis=0),
+            proto.make_node("Concat", ["d0", "neg1"], ["tgt"], axis=0),
+            proto.make_node("Reshape", ["x", "tgt"], ["y"]),
+        ]
+        inits = [proto.from_array(np.array([0], np.int64), "i0"),
+                 proto.from_array(np.array([-1], np.int64), "neg1")]
+        m = proto.make_model(proto.make_graph(nodes, "g", [x_vi], [y_vi], inits))
+        rep = sonnx.prepare(m)
+        x = np.random.randn(2, 3, 4).astype(np.float32)
+        (y,) = rep.run([T(x)])
+        np.testing.assert_allclose(np.asarray(y.data), x.reshape(2, 12))
+
+    def test_slice_and_transpose(self):
+        x = np.random.randn(4, 6).astype(np.float32)
+        x_vi = proto.make_tensor_value_info("x", proto.TensorProto.FLOAT, [4, 6])
+        y_vi = proto.make_tensor_value_info("y", proto.TensorProto.FLOAT, [3, 2])
+        nodes = [
+            proto.make_node("Slice", ["x", "st", "en", "ax"], ["s"]),
+            proto.make_node("Transpose", ["s"], ["y"], perm=[1, 0]),
+        ]
+        inits = [proto.from_array(np.array([1, 0], np.int64), "st"),
+                 proto.from_array(np.array([3, 3], np.int64), "en"),
+                 proto.from_array(np.array([1, 0], np.int64), "ax")]
+        m = proto.make_model(proto.make_graph(nodes, "g", [x_vi], [y_vi], inits))
+        (y,) = sonnx.prepare(m).run([T(x)])
+        np.testing.assert_allclose(np.asarray(y.data), x[0:3, 1:3].T)
+
+    def test_cast_where_mask(self):
+        # GPT-2-style causal mask: Trilu on host const + Where
+        x = np.random.randn(2, 4, 4).astype(np.float32)
+        x_vi = proto.make_tensor_value_info("x", proto.TensorProto.FLOAT, [2, 4, 4])
+        y_vi = proto.make_tensor_value_info("y", proto.TensorProto.FLOAT, [2, 4, 4])
+        nodes = [
+            proto.make_node("Trilu", ["ones"], ["m"], upper=0),
+            proto.make_node("Cast", ["m"], ["mb"], to=proto.TensorProto.BOOL),
+            proto.make_node("Where", ["mb", "x", "ninf"], ["y"]),
+        ]
+        inits = [proto.from_array(np.ones((4, 4), np.float32), "ones"),
+                 proto.from_array(np.array(-1e9, np.float32), "ninf")]
+        m = proto.make_model(proto.make_graph(nodes, "g", [x_vi], [y_vi], inits))
+        (y,) = sonnx.prepare(m).run([T(x)])
+        mask = np.tril(np.ones((4, 4))) > 0
+        ref = np.where(mask, x, -1e9)
+        np.testing.assert_allclose(np.asarray(y.data), ref)
+
+    def test_unsupported_op_reports_clearly(self):
+        m = _one_op_model("NonMaxSuppression", [[3, 4]], [3, 4])
+        with pytest.raises(NotImplementedError, match="NonMaxSuppression"):
+            sonnx.prepare(m)
+
+
+# ---------------------------------------------------------------------------
+# import: transformer-block graphs (BERT / GPT-2 patterns, BASELINE.json:9)
+# ---------------------------------------------------------------------------
+
+def _attention_block_onnx(B, S, H, D):
+    """Self-attention in the shape HF BERT exports: MatMul/Add projections,
+    Reshape/Transpose to heads, scaled QK^T softmax, context, out-proj,
+    residual + LayerNormalization."""
+    E = H * D
+    rng = np.random.RandomState(3)
+    f32 = proto.TensorProto.FLOAT
+    mk, arr = proto.make_node, proto.from_array
+    inits, nodes = [], []
+
+    def lin(prefix, x_name, out_name):
+        w = rng.randn(E, E).astype(np.float32) * 0.05
+        b = rng.randn(E).astype(np.float32) * 0.05
+        inits.append(arr(w, f"{prefix}_w"))
+        inits.append(arr(b, f"{prefix}_b"))
+        nodes.append(mk("MatMul", [x_name, f"{prefix}_w"], [f"{prefix}_mm"]))
+        nodes.append(mk("Add", [f"{prefix}_mm", f"{prefix}_b"], [out_name]))
+        return w, b
+
+    wq, bq = lin("q", "x", "q")
+    wk, bk = lin("k", "x", "k")
+    wv, bv = lin("v", "x", "v")
+
+    heads_shape = arr(np.array([B, S, H, D], np.int64), "heads_shape")
+    merge_shape = arr(np.array([B, S, E], np.int64), "merge_shape")
+    inits += [heads_shape, merge_shape,
+              arr(np.array(np.sqrt(D), np.float32), "scale")]
+    for n in ("q", "k", "v"):
+        nodes.append(mk("Reshape", [n, "heads_shape"], [f"{n}4"]))
+        nodes.append(mk("Transpose", [f"{n}4"], [f"{n}h"], perm=[0, 2, 1, 3]))
+    nodes.append(mk("Transpose", ["kh"], ["kT"], perm=[0, 1, 3, 2]))
+    nodes.append(mk("MatMul", ["qh", "kT"], ["scores_raw"]))
+    nodes.append(mk("Div", ["scores_raw", "scale"], ["scores"]))
+    nodes.append(mk("Softmax", ["scores"], ["probs"], axis=-1))
+    nodes.append(mk("MatMul", ["probs", "vh"], ["ctx_h"]))
+    nodes.append(mk("Transpose", ["ctx_h"], ["ctx_t"], perm=[0, 2, 1, 3]))
+    nodes.append(mk("Reshape", ["ctx_t", "merge_shape"], ["ctx"]))
+    wo, bo = lin("o", "ctx", "attn_out")
+    nodes.append(mk("Add", ["attn_out", "x"], ["resid"]))
+    g = rng.rand(E).astype(np.float32) + 0.5
+    be = rng.randn(E).astype(np.float32) * 0.1
+    inits += [arr(g, "ln_g"), arr(be, "ln_b")]
+    nodes.append(mk("LayerNormalization", ["resid", "ln_g", "ln_b"], ["y"],
+                    axis=-1, epsilon=1e-5))
+
+    gi = [proto.make_tensor_value_info("x", f32, [B, S, E])]
+    go = [proto.make_tensor_value_info("y", f32, [B, S, E])]
+    model = proto.make_model(proto.make_graph(nodes, "attn", gi, go, inits))
+    weights = dict(wq=wq, bq=bq, wk=wk, bk=bk, wv=wv, bv=bv, wo=wo, bo=bo,
+                   g=g, be=be)
+    return model, weights
+
+
+def _attention_ref(x, w, H, D):
+    B, S, E = x.shape
+
+    def lin(x, W, b):
+        return x @ W + b
+
+    def heads(t):
+        return t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+
+    q, k, v = (heads(lin(x, w[f"w{n}"], w[f"b{n}"])) for n in "qkv")
+    s = q @ k.transpose(0, 1, 3, 2) / np.sqrt(D)
+    e = np.exp(s - s.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ctx = (p @ v).transpose(0, 2, 1, 3).reshape(B, S, E)
+    resid = lin(ctx, w["wo"], w["bo"]) + x
+    mu = resid.mean(-1, keepdims=True)
+    var = ((resid - mu) ** 2).mean(-1, keepdims=True)
+    return (resid - mu) / np.sqrt(var + 1e-5) * w["g"] + w["be"]
+
+
+class TestTransformerImport:
+    def test_bert_style_attention_block(self):
+        B, S, H, D = 2, 6, 4, 8
+        m, w = _attention_block_onnx(B, S, H, D)
+        rep = sonnx.prepare(m)
+        x = np.random.randn(B, S, H * D).astype(np.float32)
+        (y,) = rep.run([T(x)])
+        np.testing.assert_allclose(np.asarray(y.data),
+                                   _attention_ref(x, w, H, D),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_imported_graph_is_trainable(self):
+        """Float initializers must be trainable params: fine-tune the
+        attention block one SGD step and see the loss drop."""
+        B, S, H, D = 2, 4, 2, 4
+        m, _ = _attention_block_onnx(B, S, H, D)
+        rep = sonnx.prepare(m)
+        params = rep.get_params()
+        assert len(params) == 10  # 4 matmuls * (W, b) + ln (g, b)
+        x = T(np.random.randn(B, S, H * D).astype(np.float32))
+        tgt = T(np.random.randn(B, S, H * D).astype(np.float32))
+        opt = st.opt.SGD(lr=0.05)
+        losses = []
+        with st.autograd.train_mode():
+            for _ in range(5):
+                (y,) = rep.run([x])
+                loss = st.autograd.mse_loss(y, tgt)
+                losses.append(float(np.asarray(loss.data)))
+                for p, g in st.autograd.backward(loss):
+                    opt.update(p, g)
+                opt.step()
+        assert losses[-1] < losses[0]
+
+    def test_imported_rep_compiles_to_graph_mode(self):
+        """SingaRep is a Model: compile() captures one XLA module."""
+        B, S, H, D = 2, 4, 2, 4
+        m, w = _attention_block_onnx(B, S, H, D)
+        rep = sonnx.prepare(m)
+        x = T(np.random.randn(B, S, H * D).astype(np.float32))
+        y_eager = rep.run([x])[0]
+        rep2 = sonnx.prepare(m)
+        rep2.compile([x], is_train=False, use_graph=True)
+        y_graph = rep2(x)
+        np.testing.assert_allclose(np.asarray(y_graph.data),
+                                   np.asarray(y_eager.data),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gpt2_style_causal_block(self):
+        """Causal LM pattern: embedding Gather + causal-masked attention."""
+        V, B, S, E = 11, 2, 5, 8
+        rng = np.random.RandomState(0)
+        f32, i64 = proto.TensorProto.FLOAT, proto.TensorProto.INT64
+        mk, arr = proto.make_node, proto.from_array
+        emb = rng.randn(V, E).astype(np.float32) * 0.1
+        w = rng.randn(E, E).astype(np.float32) * 0.1
+        inits = [arr(emb, "emb"), arr(w, "w"),
+                 arr(np.tril(np.ones((S, S), np.float32)), "tril"),
+                 arr(np.array(-1e9, np.float32), "ninf"),
+                 arr(np.array(np.sqrt(E), np.float32), "scale")]
+        nodes = [
+            mk("Gather", ["emb", "ids"], ["h"], axis=0),
+            mk("MatMul", ["h", "w"], ["q"]),
+            mk("Transpose", ["h"], ["hT"], perm=[0, 2, 1]),
+            mk("MatMul", ["q", "hT"], ["s_raw"]),
+            mk("Div", ["s_raw", "scale"], ["s_scaled"]),
+            mk("Cast", ["tril"], ["mb"], to=proto.TensorProto.BOOL),
+            mk("Where", ["mb", "s_scaled", "ninf"], ["s_masked"]),
+            mk("Softmax", ["s_masked"], ["p"], axis=-1),
+            mk("MatMul", ["p", "h"], ["ctx"]),
+            mk("MatMul", ["ctx", "emb_T"], ["logits"]),
+        ]
+        inits.append(arr(emb.T.copy(), "emb_T"))
+        gi = [proto.make_tensor_value_info("ids", i64, [B, S])]
+        go = [proto.make_tensor_value_info("logits", f32, [B, S, V])]
+        rep = sonnx.prepare(proto.make_model(
+            proto.make_graph(nodes, "gpt2ish", gi, go, inits)))
+        ids = np.array([[1, 4, 2, 7, 0], [3, 3, 9, 10, 5]], np.int64)
+        (y,) = rep.run([T(ids)])
+        # numpy reference
+        h = emb[ids]
+        s = (h @ w) @ h.transpose(0, 2, 1) / np.sqrt(E)
+        s = np.where(np.tril(np.ones((S, S))) > 0, s, -1e9)
+        e = np.exp(s - s.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        logits = (p @ h) @ emb.T
+        np.testing.assert_allclose(np.asarray(y.data), logits,
+                                   rtol=1e-3, atol=1e-4)
+        # causality: logits at position t must not depend on ids[t+1:]
+        ids2 = ids.copy()
+        ids2[:, -1] = (ids2[:, -1] + 1) % V
+        (y2,) = rep.run([T(ids2)])
+        np.testing.assert_allclose(np.asarray(y.data)[:, :-1],
+                                   np.asarray(y2.data)[:, :-1],
+                                   rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# export → reimport round-trips
+# ---------------------------------------------------------------------------
+
+class TestExport:
+    def _roundtrip(self, model, xs, rtol=1e-4, atol=1e-5):
+        out = model(*xs) if len(xs) > 1 else model(xs[0])
+        ref = np.asarray((out[0] if isinstance(out, tuple) else out).data)
+        mp = sonnx.to_onnx(model, xs)
+        # codec round-trip through bytes, like a file save/load
+        mp = proto.ModelProto.FromString(mp.SerializeToString())
+        rep = sonnx.prepare(mp)
+        (y,) = rep.run(list(xs))
+        np.testing.assert_allclose(np.asarray(y.data), ref, rtol=rtol, atol=atol)
+        return mp
+
+    def test_mlp_roundtrip(self):
+        from singa_tpu.models.mlp import MLP
+        m = MLP(perceptron_size=16, num_classes=5)
+        x = T(np.random.randn(3, 12).astype(np.float32))
+        mp = self._roundtrip(m, [x])
+        ops = {n.op_type for n in mp.graph.node}
+        assert "Gemm" in ops or "MatMul" in ops
+
+    def test_cnn_roundtrip(self):
+        from singa_tpu.models.cnn import CNN
+        m = CNN(num_classes=4)
+        x = T(np.random.randn(2, 12, 12, 1).astype(np.float32))
+        self._roundtrip(m, [x], rtol=1e-3, atol=1e-4)
+
+    def test_transformer_block_roundtrip(self):
+        from singa_tpu import layer
+        import singa_tpu.autograd as ag
+
+        class TinyFFN(st.model.Model):
+            def __init__(self):
+                super().__init__()
+                self.ln = layer.LayerNorm(8)
+                self.fc1 = layer.Linear(16, 8)
+                self.fc2 = layer.Linear(8, 16)
+
+            def forward(self, x):
+                h = self.ln(x)
+                h = ag.gelu(self.fc1(h))
+                return ag.add(self.fc2(h), x)
+
+        m = TinyFFN()
+        x = T(np.random.randn(2, 5, 8).astype(np.float32))
+        mp = self._roundtrip(m, [x], rtol=1e-3, atol=1e-4)
+        ops = [n.op_type for n in mp.graph.node]
+        assert "LayerNormalization" in ops
+        assert "Gelu" in ops
+
+    def test_export_file_io(self, tmp_path):
+        from singa_tpu.models.mlp import MLP
+        m = MLP(perceptron_size=8, num_classes=3)
+        x = T(np.random.randn(2, 6).astype(np.float32))
+        ref = np.asarray(m(x).data)
+        p = str(tmp_path / "mlp.onnx")
+        sonnx.export(m, [x], p)
+        rep = sonnx.prepare(p)
+        (y,) = rep.run([x])
+        np.testing.assert_allclose(np.asarray(y.data), ref, rtol=1e-4, atol=1e-5)
