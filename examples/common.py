@@ -110,7 +110,11 @@ def train_classifier(model, args, x_train, y_train, x_test, y_test,
         sgd = base
     model.set_optimizer(sgd)
 
-    dtype = np.float32
+    if args.bf16:
+        import ml_dtypes
+        dtype = ml_dtypes.bfloat16
+    else:
+        dtype = np.float32
     tx = Tensor(data=x_train[:args.batch_size].astype(dtype), device=dev)
     ty = Tensor(data=y_train[:args.batch_size].astype(np.int32), device=dev)
     model.compile([tx], is_train=True, use_graph=args.graph)

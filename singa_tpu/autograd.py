@@ -1133,18 +1133,33 @@ class SoftmaxCrossEntropy(Operator):
     """
 
     def forward(self, logits, target):
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        if jnp.issubdtype(target.dtype, jnp.integer):
-            onehot = jax.nn.one_hot(target, logits.shape[-1], dtype=logits.dtype)
-        else:
-            onehot = target
-        self._p = jnp.exp(logp)
-        self._t = onehot
+        self._dtype = logits.dtype
+        self._shape = logits.shape
+        V = logits.shape[-1]
+        # softmax in f32 regardless of compute dtype (bf16 logits with a
+        # 100k vocab lose the loss signal otherwise)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         self._n = float(np.prod(logits.shape[:-1]))
-        return -jnp.sum(onehot * logp) / self._n
+        self._p = jnp.exp(logp)
+        if jnp.issubdtype(target.dtype, jnp.integer):
+            # gather the target log-prob — never materialize a (N, V) one-hot
+            self._tgt = target.reshape(-1)
+            picked = jnp.take_along_axis(logp.reshape(-1, V),
+                                         self._tgt[:, None], axis=-1)
+            return -jnp.sum(picked) / self._n
+        self._tgt = None
+        self._t = target.astype(jnp.float32)
+        return -jnp.sum(self._t * logp) / self._n
 
     def backward(self, dy):
-        return (dy * (self._p - self._t) / self._n, None)
+        V = self._shape[-1]
+        if self._tgt is not None:
+            n = self._tgt.shape[0]
+            g = self._p.reshape(-1, V).at[jnp.arange(n), self._tgt].add(-1.0)
+        else:
+            g = self._p.reshape(-1, V) - self._t.reshape(-1, V)
+        g = (dy * g / self._n).reshape(self._shape).astype(self._dtype)
+        return (g, None)
 
 
 class MSELoss(Operator):
@@ -1308,8 +1323,9 @@ class BatchNorm(Operator):
         self.eps = eps
 
     def fwd(self, x, gamma, beta, mean, var):
-        inv = jax.lax.rsqrt(var + self.eps)
-        return (x - mean) * inv * gamma + beta
+        xf = x.astype(jnp.float32)
+        inv = jax.lax.rsqrt(var.astype(jnp.float32) + self.eps)
+        return ((xf - mean) * inv * gamma + beta).astype(x.dtype)
 
 
 class LayerNorm(Operator):
@@ -1318,9 +1334,13 @@ class LayerNorm(Operator):
         self.eps = eps
 
     def fwd(self, x, gamma, beta):
-        mu = jnp.mean(x, axis=-1, keepdims=True)
-        var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
-        return (x - mu) * jax.lax.rsqrt(var + self.eps) * gamma + beta
+        # stats in f32 (bf16 mean/var loses precision), output in x dtype;
+        # f32 master gamma/beta are cast so they don't re-promote bf16
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+        y = (xf - mu) * jax.lax.rsqrt(var + self.eps) * gamma + beta
+        return y.astype(x.dtype)
 
 
 class RMSNorm(Operator):
@@ -1332,7 +1352,7 @@ class RMSNorm(Operator):
         # norm in f32 for stability, output in input dtype (llama-style)
         xf = x.astype(jnp.float32)
         ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
-        return (xf * jax.lax.rsqrt(ms + self.eps)).astype(x.dtype) * gamma
+        return (xf * jax.lax.rsqrt(ms + self.eps) * gamma).astype(x.dtype)
 
 
 def conv2d(x, w, b=None, stride=1, padding=0, groups=1, dilation=1):

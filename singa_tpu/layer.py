@@ -445,7 +445,14 @@ class Embedding(Layer):
                             np.float32).gaussian(0.0, 0.02))
 
     def forward(self, ids: Tensor) -> Tensor:
-        return autograd.embedding(self.table, ids)
+        out = autograd.embedding(self.table, ids)
+        # master table is f32; activations run in the device compute dtype
+        # (bf16 on TPU) — cast after the gather so only B*T*D bytes move
+        dev = ids.device
+        dt = getattr(dev, "default_dtype", None)
+        if dt is not None and np.dtype(dt) != np.dtype(np.float32):
+            out = autograd.cast(out, dt)
+        return out
 
 
 class LayerNorm(Layer):

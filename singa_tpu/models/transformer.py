@@ -131,8 +131,12 @@ class GPT2(model.Model):
         for blk in self.blocks:
             x = blk(x, mask)
         x = self.ln_f(x)
-        # tied LM head: logits = x @ wte.T
-        return autograd.matmul(x, autograd.transpose(self.wte.table))
+        # tied LM head: logits = x @ wte.T (table cast to the compute
+        # dtype so bf16 activations don't promote back to f32)
+        w = self.wte.table
+        if w.dtype != x.dtype:
+            w = autograd.cast(w, x.dtype)
+        return autograd.matmul(x, autograd.transpose(w))
 
     def train_one_batch(self, ids: Tensor, labels: Optional[Tensor] = None):
         logits = self.forward(ids)

@@ -62,10 +62,14 @@ def _require_host(v, node: NodeProto, what: str) -> np.ndarray:
 
 
 class _Ctx:
-    def __init__(self, device, opset: int, training: bool):
+    def __init__(self, device, opset: int, training: bool,
+                 consumed: Optional[set] = None):
         self.device = device
         self.opset = opset
         self.training = training
+        # names read by downstream nodes / graph outputs — used to reject
+        # requests for aux outputs we don't compute (norm stats etc.)
+        self.consumed = consumed or set()
 
     def tensor(self, v, requires_grad=False) -> Tensor:
         if isinstance(v, Tensor):
@@ -458,10 +462,12 @@ def _h_split(ctx, node, attrs, ins):
     if parts is None:
         size = t.shape[axis]
         num = attrs.get("num_outputs", n_out)
-        base = -(-size // num)  # ceil-div per ONNX num_outputs semantics
-        parts = [base] * (size // base)
-        if size % base:
-            parts.append(size % base)
+        base = -(-size // num)  # ceil-div; last chunk smaller (maybe 0)
+        parts = [base] * (num - 1) + [size - base * (num - 1)]
+        if parts[-1] < 0:
+            raise ValueError(
+                f"Split: axis size {size} cannot fill {num} outputs of "
+                f"chunk {base}")
     outs = autograd.split(t, parts, axis)
     return list(outs)
 
@@ -738,6 +744,15 @@ def _h_gmp(ctx, node, attrs, ins):
     return [autograd.reduce_max(x, sp, keepdims=True)]
 
 
+def _reject_consumed_aux(ctx, node):
+    used = [n for n in node.output[1:] if n and n in ctx.consumed]
+    if used:
+        raise NotImplementedError(
+            f"{node.op_type}: auxiliary outputs {used} are consumed by the "
+            f"graph but this importer only computes the primary output "
+            f"(training-graph stats are not supported)")
+
+
 @handles("BatchNormalization")
 def _h_batchnorm(ctx, node, attrs, ins):
     eps = attrs.get("epsilon", 1e-5)
@@ -749,12 +764,10 @@ def _h_batchnorm(ctx, node, attrs, ins):
                 * s.reshape(shp) + b.reshape(shp))
 
     y = _JnpOp(bn)(x, scale, bias, mean, var)
-    outs = [y]
     # training-mode extra outputs (running stats) are not produced; the
     # importer targets inference graphs (training uses singa.layer.BatchNorm2d)
-    for _ in node.output[1:]:
-        outs.append(mean)
-    return outs[:len(node.output)]
+    _reject_consumed_aux(ctx, node)
+    return [y] + [None] * (len(node.output) - 1)
 
 
 @handles("LayerNormalization")
@@ -778,10 +791,8 @@ def _h_layernorm(ctx, node, attrs, ins):
 
     args = (x, scale) + ((bias,) if bias is not None else ())
     y = _JnpOp(ln)(*args)
-    outs = [y]
-    for name in node.output[1:]:
-        outs.append(y)  # mean/invstd outputs rarely consumed; placeholder
-    return outs[:len(node.output)]
+    _reject_consumed_aux(ctx, node)  # Mean/InvStdDev outputs not computed
+    return [y] + [None] * (len(node.output) - 1)
 
 
 @handles("InstanceNormalization")
@@ -869,7 +880,10 @@ class SingaRep(model_mod.Model):
             raise ValueError(
                 f"expected {len(self.input_names)} inputs "
                 f"{self.input_names}, got {len(inputs)}")
-        ctx = _Ctx(self.device_, self.opset, autograd.is_training())
+        consumed = set(self.output_names)
+        for n in self.onnx_graph.node:
+            consumed.update(i for i in n.input if i)
+        ctx = _Ctx(self.device_, self.opset, autograd.is_training(), consumed)
         env: Dict[str, Any] = dict(self._consts)
         for onnx_name, pname in self._param_alias.items():
             env[onnx_name] = self._params[pname]
@@ -879,7 +893,7 @@ class SingaRep(model_mod.Model):
             ins = [env[i] if i else None for i in node.input]
             outs = _HANDLERS[node.op_type](ctx, node, _attrs(node), ins)
             for name, v in zip(node.output, outs):
-                if name:
+                if name and v is not None:
                     env[name] = v
         outs = []
         for name in self.output_names:

@@ -320,6 +320,11 @@ def _e_pad(ex, op, ins, outs):
 
 @_exports(autograd.Where)
 def _e_where(ex, op, ins, outs):
+    import warnings
+    warnings.warn(
+        "sonnx export: the Where condition evaluated at trace time is "
+        "frozen into the graph as a constant; input-dependent masks will "
+        "not vary in the exported model.", stacklevel=2)
     cond = ex.add_init(np.asarray(op.cond, np.bool_), "cond")
     ex.emit("Where", [cond, ins[0], ins[1]], _outn(ex, outs))
 

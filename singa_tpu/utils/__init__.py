@@ -1,6 +1,10 @@
-"""singa_tpu.utils — checkpointing, metrics, data pipeline."""
+"""singa_tpu.utils — checkpointing, metrics, data pipeline, profiling,
+failure detection (SURVEY.md §5 auxiliary subsystems)."""
 
 from . import checkpoint
+from . import data
+from . import failure
 from . import metrics
+from . import profiler
 
-__all__ = ["checkpoint", "metrics"]
+__all__ = ["checkpoint", "data", "failure", "metrics", "profiler"]

@@ -11,7 +11,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
-__all__ = ["save_states", "load_states", "save_arrays", "load_arrays"]
+__all__ = ["save_states", "load_states", "save_arrays", "load_arrays",
+           "CheckpointManager"]
 
 _AUX_KEY = "__aux__"
 
@@ -59,3 +60,68 @@ def load_states(model, fpath: str) -> Dict:
     if "optimizer" in aux and getattr(model, "optimizer", None) is not None:
         model.optimizer.set_states(aux["optimizer"])
     return aux
+
+
+class CheckpointManager:
+    """Stepped checkpoints with retention + resume (SURVEY.md §5: the
+    recovery half of the failure-detection story — a dead pod restarts
+    and resumes from the newest intact checkpoint; atomic writes mean a
+    crash mid-save can never corrupt the latest one).
+
+        ckpt = CheckpointManager("ckpts", keep=3)
+        start = ckpt.restore_latest(model)          # 0 if none
+        for step in range(start, total):
+            ...
+            ckpt.save(step, model)                  # every save_every steps
+    """
+
+    def __init__(self, directory: str, keep: int = 3, save_every: int = 1):
+        self.dir = directory
+        self.keep = keep
+        self.save_every = max(1, save_every)
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.dir, f"ckpt_{step:012d}.npz")
+
+    def steps(self):
+        out = []
+        for f in os.listdir(self.dir):
+            if f.startswith("ckpt_") and f.endswith(".npz"):
+                try:
+                    out.append(int(f[5:-4]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def save(self, step: int, model, aux: Optional[Dict] = None,
+             force: bool = False) -> Optional[str]:
+        if not force and step % self.save_every:
+            return None
+        path = self._path(step)
+        a = dict(aux or {})
+        a["step"] = int(step)
+        save_states(model, path, a)
+        for old in self.steps()[:-self.keep]:
+            try:
+                os.unlink(self._path(old))
+            except OSError:
+                pass
+        return path
+
+    def restore_latest(self, model) -> int:
+        """Load the newest intact checkpoint; returns the step after it
+        (0 when starting fresh). Only decode/IO failures (torn writes)
+        fall back to an older file — a checkpoint that *loads* but does
+        not fit the model (shape/arch mismatch) raises, because silently
+        restarting from step 0 would also rotate away the good files."""
+        for step in reversed(self.steps()):
+            try:
+                arrays, aux = load_arrays(self._path(step))
+            except Exception:
+                continue  # torn/corrupt file: fall back to the previous
+            model.set_states(arrays)
+            if "optimizer" in aux and getattr(model, "optimizer", None) is not None:
+                model.optimizer.set_states(aux["optimizer"])
+            return step + 1
+        return 0

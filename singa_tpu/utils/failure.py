@@ -1,0 +1,141 @@
+"""Failure detection / clean abort (SURVEY.md §5).
+
+The reference lineage has none (synchronous SGD; a dead rank hangs the
+job). Our plan, stated there: heartbeat + clean abort so a pod failure
+surfaces as an error instead of an indefinite hang, with
+checkpoint/resume (utils.checkpoint.CheckpointManager) as the recovery
+path.  Two mechanisms:
+
+* `Heartbeat` — liveness watchdog for the training loop.  The loop calls
+  `beat()` every step; a monitor thread raises the alarm when no beat
+  arrives within `timeout` (a hung collective, a dead coordinator, a
+  wedged input pipeline all look the same from here — which is the
+  point).
+* `device_liveness_check` — active probe: submit a trivial op to the
+  device and require completion within a deadline.  Catches a dead PJRT
+  client / dropped TPU tunnel without waiting for the next step.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["Heartbeat", "device_liveness_check", "clean_abort",
+           "FailureDetected"]
+
+
+class FailureDetected(RuntimeError):
+    pass
+
+
+def clean_abort(msg: str, exit_code: int = 42) -> None:
+    """Default failure action: loud message, immediate hard exit with a
+    recognizable code so the launcher can restart-from-checkpoint.
+    os._exit (not sys.exit) because the hung thread we're aborting over
+    would block normal interpreter shutdown."""
+    print(f"[singa_tpu.failure] FATAL: {msg}", file=sys.stderr, flush=True)
+    os._exit(exit_code)
+
+
+class Heartbeat:
+    """Step-liveness watchdog.
+
+        hb = Heartbeat(timeout=300)        # 5 min per step budget
+        hb.start()
+        for step in ...:
+            train_step(...)
+            hb.beat(step)
+        hb.stop()
+
+    `on_failure(age_s, last_step)` defaults to `clean_abort`; tests pass
+    a callback instead."""
+
+    def __init__(self, timeout: float = 300.0, check_every: float = 1.0,
+                 on_failure: Optional[Callable[[float, int], None]] = None):
+        self.timeout = float(timeout)
+        self.check_every = float(check_every)
+        self.on_failure = on_failure or (
+            lambda age, step: clean_abort(
+                f"no heartbeat for {age:.1f}s (last step {step}); "
+                f"assuming hung collective or dead device"))
+        self._last = time.monotonic()
+        self._last_step = -1
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._fired = False
+
+    def start(self) -> "Heartbeat":
+        self._last = time.monotonic()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="singa-heartbeat")
+        self._thread.start()
+        return self
+
+    def beat(self, step: int = -1) -> None:
+        self._last = time.monotonic()
+        self._last_step = step
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.check_every)
+            self._thread = None
+
+    @property
+    def fired(self) -> bool:
+        return self._fired
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.check_every):
+            age = time.monotonic() - self._last
+            if age > self.timeout:
+                self._fired = True
+                try:
+                    self.on_failure(age, self._last_step)
+                finally:
+                    return
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def device_liveness_check(device=None, timeout: float = 30.0) -> bool:
+    """Submit a trivial computation and require completion within
+    `timeout` seconds. The probe runs in a *daemon* thread (not a
+    ThreadPoolExecutor: its atexit hook joins workers, so a wedged PJRT
+    client would hang interpreter shutdown — the exact dead-device case
+    this probe exists to detect)."""
+    import queue
+
+    import jax
+    import jax.numpy as jnp
+
+    q: "queue.Queue" = queue.Queue()
+
+    def probe():
+        try:
+            if device is not None and hasattr(device, "jax_devices"):
+                d = device.jax_devices[0]
+            elif device is not None:
+                d = device
+            else:
+                d = jax.devices()[0]
+            x = jax.device_put(jnp.ones(()), d)
+            q.put(float(jax.block_until_ready(x + 1.0)))
+        except Exception:
+            q.put(None)
+
+    threading.Thread(target=probe, daemon=True,
+                     name="singa-liveness-probe").start()
+    try:
+        return q.get(timeout=timeout) == 2.0
+    except queue.Empty:
+        return False

@@ -1,0 +1,115 @@
+"""Tracing / profiling (SURVEY.md §5).
+
+The reference lineage has per-op timing in the scheduler at best; the
+plan stated in the survey: step-time logging + the XLA/device profiler
+that comes free from the runtime, plus compiled-module cost analysis so
+the ≥45% MFU target (BASELINE.json:2,5) is checkable, not vibes.
+
+* `StepProfiler` — wall-clock per step with warmup discard; feeds MFU
+  from the captured graph's XLA cost analysis (true compiled FLOPs, not
+  an analytic formula) when a model is attached.
+* `device_trace` — context manager around `jax.profiler` traces; the
+  dumped trace opens in TensorBoard/XProf with per-HLO timing.
+* `profile_model` — one-call summary: compiled FLOPs, bytes accessed,
+  arithmetic intensity, step time, MFU.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .metrics import peak_flops
+
+__all__ = ["StepProfiler", "device_trace", "profile_model"]
+
+
+class StepProfiler:
+    """Accumulate per-step wall time; first `warmup` steps discarded
+    (compile + cache population).
+
+        prof = StepProfiler(warmup=2)
+        for ...:
+            with prof.step():
+                model.train_step(x, y)
+        print(prof.summary(model))
+    """
+
+    def __init__(self, warmup: int = 2):
+        self.warmup = warmup
+        self.times: List[float] = []
+        self._n = 0
+
+    @contextlib.contextmanager
+    def step(self):
+        t0 = time.perf_counter()
+        yield
+        dt = time.perf_counter() - t0
+        self._n += 1
+        if self._n > self.warmup:
+            self.times.append(dt)
+
+    @property
+    def mean_s(self) -> float:
+        return float(np.mean(self.times)) if self.times else 0.0
+
+    @property
+    def p50_s(self) -> float:
+        return float(np.median(self.times)) if self.times else 0.0
+
+    def summary(self, model=None, device_kind: Optional[str] = None) -> Dict:
+        out = {
+            "steps_timed": len(self.times),
+            "step_time_ms": round(self.mean_s * 1e3, 3),
+            "step_time_p50_ms": round(self.p50_s * 1e3, 3),
+        }
+        g = getattr(model, "graph", None) if model is not None else None
+        if g is not None and self.mean_s > 0:
+            flops = g.flops()
+            if flops:
+                achieved = flops / self.mean_s
+                out["compiled_gflops_per_step"] = round(flops / 1e9, 6)
+                out["achieved_tflops"] = round(achieved / 1e12, 6)
+                out["mfu"] = round(achieved / peak_flops(device_kind), 8)
+        return out
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str):
+    """XLA device trace (TensorBoard/XProf format): per-HLO device
+    timing, memory viewer, roofline — free from the runtime."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def profile_model(model, batch, steps: int = 10, warmup: int = 2,
+                  device_kind: Optional[str] = None) -> Dict:
+    """Run `steps` compiled train steps and return the cost/latency
+    summary (model must be compiled with use_graph=True)."""
+    import jax
+
+    prof = StepProfiler(warmup=warmup)
+    out = None
+    for _ in range(warmup + steps):
+        with prof.step():
+            out = model.train_step(*batch)
+            jax.block_until_ready(out[-1].data if isinstance(out, tuple)
+                                  else out.data)
+    s = prof.summary(model, device_kind)
+    g = model.graph
+    if g is not None:
+        ca = g.cost_analysis()
+        if "bytes accessed" in ca and s.get("step_time_ms"):
+            ba = float(ca["bytes accessed"])
+            s["bytes_accessed_per_step"] = int(ba)
+            if ca.get("flops"):
+                s["arithmetic_intensity"] = round(float(ca["flops"]) / ba, 2)
+    return s

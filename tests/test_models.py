@@ -171,3 +171,59 @@ def test_llama_graph_matches_eager():
         return out
 
     np.testing.assert_allclose(run(False), run(True), rtol=1e-4, atol=1e-5)
+
+
+class TestBF16ComputePath:
+    """On a bf16-default device (TPU), activations must run bf16 with f32
+    master weights and an f32 loss (the MXU-feeding dtype discipline)."""
+
+    def _bf16_dev(self):
+        import singa_tpu as st
+        import jax.numpy as jnp
+        dev = st.device.create_cpu_device()
+        dev.default_dtype = jnp.bfloat16  # simulate the TPU default on CPU
+        return dev
+
+    def test_gpt2_activations_bf16_loss_f32(self):
+        import numpy as np
+        import jax.numpy as jnp
+        import singa_tpu as st
+        from singa_tpu import models
+        from singa_tpu.tensor import Tensor
+
+        dev = self._bf16_dev()
+        st.device.set_default_device(dev)
+        cfg = models.GPT2Config(vocab_size=64, dim=32, num_heads=2,
+                                num_layers=1, max_position=32, dropout=0.0)
+        m = models.GPT2(cfg)
+        ids = Tensor(data=np.zeros((2, 8), np.int32), device=dev)
+        logits = m(ids)
+        assert logits.dtype == jnp.bfloat16, "activations must be bf16"
+        for name, p in m.get_params().items():
+            assert p.dtype == np.float32, f"master weight {name} not f32"
+        with st.autograd.train_mode():
+            logits = m(ids)
+            loss = st.autograd.softmax_cross_entropy(
+                st.autograd.reshape(logits, (16, 64)),
+                Tensor(data=np.zeros(16, np.int32), device=dev))
+            assert loss.dtype == jnp.float32, "loss must be f32"
+            pairs = st.autograd.backward(loss)
+            assert pairs, "no gradients"
+            for p, g in pairs:
+                assert g.dtype == np.float32 or g.dtype == jnp.bfloat16
+
+    def test_llama_activations_bf16(self):
+        import numpy as np
+        import jax.numpy as jnp
+        import singa_tpu as st
+        from singa_tpu import models
+        from singa_tpu.tensor import Tensor
+
+        dev = self._bf16_dev()
+        st.device.set_default_device(dev)
+        m = models.Llama(models.LlamaConfig.tiny())
+        ids = Tensor(data=np.zeros((2, 8), np.int32), device=dev)
+        logits = m(ids)
+        assert logits.dtype == jnp.bfloat16, "llama logits must be bf16"
+        for name, p in m.get_params().items():
+            assert p.dtype == np.float32, f"master weight {name} not f32"
