@@ -1142,11 +1142,15 @@ class SoftmaxCrossEntropy(Operator):
         self._n = float(np.prod(logits.shape[:-1]))
         self._p = jnp.exp(logp)
         if jnp.issubdtype(target.dtype, jnp.integer):
-            # gather the target log-prob — never materialize a (N, V) one-hot
-            self._tgt = target.reshape(-1)
+            # gather the target log-prob — never materialize a (N, V) one-hot.
+            # out-of-range ids (e.g. -1 padding labels) are ignored: zero
+            # loss AND zero gradient for those rows.
+            tgt = target.reshape(-1)
+            self._valid = (tgt >= 0) & (tgt < V)
+            self._tgt = jnp.clip(tgt, 0, V - 1)
             picked = jnp.take_along_axis(logp.reshape(-1, V),
-                                         self._tgt[:, None], axis=-1)
-            return -jnp.sum(picked) / self._n
+                                         self._tgt[:, None], axis=-1)[:, 0]
+            return -jnp.sum(jnp.where(self._valid, picked, 0.0)) / self._n
         self._tgt = None
         self._t = target.astype(jnp.float32)
         return -jnp.sum(self._t * logp) / self._n
@@ -1156,6 +1160,7 @@ class SoftmaxCrossEntropy(Operator):
         if self._tgt is not None:
             n = self._tgt.shape[0]
             g = self._p.reshape(-1, V).at[jnp.arange(n), self._tgt].add(-1.0)
+            g = jnp.where(self._valid[:, None], g, 0.0)
         else:
             g = self._p.reshape(-1, V) - self._t.reshape(-1, V)
         g = (dy * g / self._n).reshape(self._shape).astype(self._dtype)

@@ -868,6 +868,9 @@ class SingaRep(model_mod.Model):
         init_names = ({i.name for i in g.initializer})
         self.input_names = [vi.name for vi in g.input if vi.name not in init_names]
         self.output_names = [vi.name for vi in g.output]
+        self._consumed = set(self.output_names)
+        for n in g.node:
+            self._consumed.update(i for i in n.input if i)
         unsupported = sorted({n.op_type for n in g.node if n.op_type not in _HANDLERS})
         if unsupported:
             raise NotImplementedError(
@@ -880,10 +883,8 @@ class SingaRep(model_mod.Model):
             raise ValueError(
                 f"expected {len(self.input_names)} inputs "
                 f"{self.input_names}, got {len(inputs)}")
-        consumed = set(self.output_names)
-        for n in self.onnx_graph.node:
-            consumed.update(i for i in n.input if i)
-        ctx = _Ctx(self.device_, self.opset, autograd.is_training(), consumed)
+        ctx = _Ctx(self.device_, self.opset, autograd.is_training(),
+                   self._consumed)
         env: Dict[str, Any] = dict(self._consts)
         for onnx_name, pname in self._param_alias.items():
             env[onnx_name] = self._params[pname]

@@ -69,6 +69,8 @@ class Heartbeat:
         self._fired = False
 
     def start(self) -> "Heartbeat":
+        self._stop.clear()   # restartable after stop()
+        self._fired = False
         self._last = time.monotonic()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="singa-heartbeat")

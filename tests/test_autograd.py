@@ -199,3 +199,25 @@ def test_split_multi_output_backward():
     g = grads[0][1].to_numpy()
     np.testing.assert_allclose(g[:, :2], 2.0)
     np.testing.assert_allclose(g[:, 2:], 0.0)
+
+
+def test_softmax_cross_entropy_ignores_out_of_range_labels(cpu_dev):
+    """-1 padding labels: zero loss AND zero gradient for those rows."""
+    import jax.numpy as jnp
+    from singa_tpu.tensor import Tensor
+    logits_np = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+    t_all = Tensor(data=logits_np, device=cpu_dev, requires_grad=True,
+                   stores_grad=True)
+    labels = Tensor(data=np.array([1, -1, 2, -1], np.int32), device=cpu_dev)
+    with autograd.train_mode():
+        loss = autograd.softmax_cross_entropy(t_all, labels)
+        pairs = autograd.backward(loss)
+    # loss counts only the valid rows (denominator stays N=4, ref parity)
+    p = np.exp(logits_np - logits_np.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    expected = -(np.log(p[0, 1]) + np.log(p[2, 2])) / 4.0
+    assert float(np.asarray(loss.data)) == pytest.approx(expected, rel=1e-5)
+    g = np.asarray(dict((id(a), b) for a, b in pairs)[id(t_all)].data)
+    np.testing.assert_allclose(g[1], 0.0, atol=1e-7)
+    np.testing.assert_allclose(g[3], 0.0, atol=1e-7)
+    assert np.abs(g[0]).sum() > 0

@@ -122,3 +122,15 @@ class TestProfiler:
             jnp.ones((8, 8)).sum().block_until_ready()
         dumped = [f for _, _, fs in os.walk(tmp_path) for f in fs]
         assert dumped, "profiler trace produced no files"
+
+    def test_heartbeat_restartable(self):
+        fired = []
+        hb = failure.Heartbeat(timeout=0.2, check_every=0.05,
+                               on_failure=lambda age, step: fired.append(step))
+        hb.start(); hb.beat(0); hb.stop()
+        assert not hb.fired
+        hb.start()          # restart must arm a live monitor again
+        hb.beat(7)
+        time.sleep(0.6)
+        hb.stop()
+        assert hb.fired and fired == [7]

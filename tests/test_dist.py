@@ -145,3 +145,60 @@ def test_set_mesh_none_after_compile_still_runs():
     x, y = _data()
     out, loss = m.train_step(tensor.from_numpy(x), tensor.from_numpy(y))
     assert out.shape == (64, 4)
+
+
+def test_quantized_allreduce_error_bound():
+    """int8 blockwise quantized allreduce (EQuARX-style): result within
+    the shared-scale quantization bound of the exact mean."""
+    mesh = parallel.data_parallel_mesh(8)
+    from singa_tpu.parallel import communicator as comm
+
+    rng = np.random.RandomState(0)
+    g = rng.randn(8, 300).astype(np.float32)  # non-multiple of block
+
+    f = jax.shard_map(lambda x: comm.quantized_allreduce(x, "data", block=64),
+                      mesh=mesh, in_specs=parallel.mesh.P("data"),
+                      out_specs=parallel.mesh.P("data"), check_vma=False)
+    out = np.asarray(f(jnp.asarray(g)))
+    exact = g.mean(axis=0, keepdims=True)
+    # per-element error <= s/2 per replica contribution; s = absmax/127
+    s = np.abs(g).max() / 127.0
+    assert np.max(np.abs(out - exact)) <= s * 1.01
+    # identical inputs quantize exactly onto the shared grid
+    same = np.tile(np.linspace(-1, 1, 300, dtype=np.float32) , (8, 1))
+    out2 = np.asarray(f(jnp.asarray(same)))
+    assert np.max(np.abs(out2 - same[:1])) <= (1.0 / 127.0) / 2 + 1e-6
+
+
+def test_quantized_allreduce_in_distopt_training():
+    """DistOpt with int8-compressed gradients still trains."""
+    from singa_tpu import models
+    mesh = parallel.data_parallel_mesh(8)
+    parallel.set_mesh(mesh)
+    try:
+        tensor.set_seed(0)
+        m = models.MLP(perceptron_size=16, num_classes=4)
+        m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.1), compress_dtype="int8"))
+        x = tensor.from_numpy(np.random.RandomState(0).randn(16, 8).astype(np.float32))
+        y = tensor.from_numpy(np.random.RandomState(1).randint(0, 4, 16).astype(np.int32))
+        m.compile([x], is_train=True, use_graph=True)
+        losses = [float(np.asarray(m.train_step(x, y)[1].data))
+                  for _ in range(8)]
+        assert losses[-1] < losses[0], losses
+    finally:
+        parallel.set_mesh(None)
+
+
+def test_int8_dtype_object_routes_to_quantized_path():
+    """compress_dtype=jnp.int8 (dtype object) must quantize, not truncate."""
+    mesh = parallel.data_parallel_mesh(8)
+    from singa_tpu.parallel import communicator as comm
+
+    g = np.full((8, 64), 0.01, np.float32)  # would truncate to 0 via astype
+    f = jax.shard_map(
+        lambda x: comm.allreduce_grads({"g": x}, "data",
+                                       compress_dtype=jnp.int8)["g"],
+        mesh=mesh, in_specs=parallel.mesh.P("data"),
+        out_specs=parallel.mesh.P("data"), check_vma=False)
+    out = np.asarray(f(jnp.asarray(g)))
+    np.testing.assert_allclose(out, 0.01, rtol=0.05)
