@@ -42,12 +42,33 @@ def _peak_flops(dev) -> float:
     return 275e12  # assume v4 class
 
 
+def _probe_flash(seqlen: int) -> None:
+    """Compile-check the Pallas flash kernel on this backend; if Mosaic
+    isn't supported here, fall back to the XLA-fused attention path
+    rather than dying mid-benchmark."""
+    import os
+
+    import jax.numpy as jnp
+
+    try:
+        from singa_tpu.ops.flash_attention import flash_attention
+        q = jnp.zeros((1, min(512, seqlen), 2, 64), jnp.bfloat16)
+        jax.block_until_ready(
+            jax.jit(lambda q: flash_attention(q, q, q, causal=True))(q))
+    except Exception as e:  # pragma: no cover - backend-specific
+        print(f"# flash kernel unavailable ({type(e).__name__}); "
+              f"using XLA attention", file=sys.stderr)
+        os.environ["SINGA_DISABLE_FLASH"] = "1"
+
+
 def main() -> None:
     from singa_tpu import device, models, opt, parallel, tensor
 
     parallel.set_mesh(None)
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
+    if on_tpu:
+        _probe_flash(1024)
     if on_tpu:
         device.set_default_device(device.create_tpu_device())
         cfg = models.LlamaConfig.small()

@@ -63,10 +63,13 @@ def _sdpa_reference(q, k, v, causal: bool, mask, scale: float):
 
 
 def _use_flash(q, k=None) -> bool:
+    import os
+    if os.environ.get("SINGA_DISABLE_FLASH"):
+        return False
     if q.shape[1] < _FLASH_MIN_LEN:
         return False
-    if k is not None and k.shape[2] != q.shape[2]:
-        return False  # GQA routes through the grouped einsum path for now
+    if k is not None and q.shape[2] % k.shape[2] != 0:
+        return False  # non-grouping head ratio: einsum reference path
     platform = jax.devices()[0].platform
     return platform in ("tpu", "axon")
 

@@ -1,21 +1,340 @@
-"""Flash attention — blockwise attention kernel (Pallas TPU).
+"""Flash attention — blockwise Pallas TPU kernel (forward + backward).
 
-Milestone note: the Pallas kernel lands with the transformer-model
-milestone; until then this module provides the same signature backed by
-the XLA-fused reference computation so callers never break.
+Online-softmax attention with O(block) VMEM: K/V stream through the
+innermost grid dimension one (BLOCK_K, D) tile at a time while the
+(running max, denominator, f32 accumulator) persist in VMEM scratch, so
+sequence length is bounded by HBM, not VMEM — the long-context half of
+the single-chip design (cross-chip sequence scaling is
+ops.ring_attention).  Structure follows FlashAttention-2; backward
+recomputes score tiles from the saved logsumexp with separate dQ and
+dK/dV kernels.
+
+TPU mapping (pallas_guide.md): QK^T and PV tiles ride the MXU via
+jnp.dot(..., preferred_element_type=f32); tiles live in VMEM; causal
+skips fully-masked tiles with pl.when; GQA maps G query heads onto one
+kv head in the BlockSpec index map so grouped (Llama-3) attention needs
+no head replication in HBM.  Causal masking is bottom-right aligned
+(qpos + Tk - Tq >= kpos), matching the XLA reference for Tq != Tk
+(KV-cached decoding).
+
+Falls back to the XLA-fused reference for shapes the kernel does not
+tile (T not a multiple of 128, tiny head dims) and off-TPU; interpret
+mode runs the same kernels on CPU for tests.
 """
 
 from __future__ import annotations
 
+import functools
 import math
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flash_attention"]
 
+_NEG_INF = -1e30
 
-def flash_attention(q, k, v, causal: bool = False, scale: float = None):
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _causal_ids(qi, kj, block_q, block_k, off):
+    qpos = qi * block_q + off + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    kpos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return qpos, kpos
+
+
+# ---------------------------------------------------------------------------
+# forward: grid (B, H, nq, nkv); kv streams innermost; acc/m/l in scratch
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, block_q, block_k, off):
+    qi, kj = pl.program_id(2), pl.program_id(3)
+    nkv = pl.num_programs(3)
+
+    @pl.when(kj == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: skip tiles where even the last q row precedes the first key
+    live = True
+    if causal:
+        live = (qi * block_q + block_q - 1 + off) >= kj * block_k
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos, kpos = _causal_ids(qi, kj, block_q, block_k, off)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.dot(p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = m_new
+
+    @pl.when(kj == nkv - 1)
+    def _():
+        l = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_ref[:] + jnp.log(l))[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# backward: dQ streams kv innermost; dK/dV streams q innermost
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, causal, block_q, block_k, off):
+    qi, kj = pl.program_id(2), pl.program_id(3)
+    nkv = pl.num_programs(3)
+
+    @pl.when(kj == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = True
+    if causal:
+        live = (qi * block_q + block_q - 1 + off) >= kj * block_k
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)
+        if causal:
+            qpos, kpos = _causal_ids(qi, kj, block_q, block_k, off)
+            p = jnp.where(qpos >= kpos, p, 0.0)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_acc[:] = dq_acc[:] + jnp.dot(ds, k,
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nkv - 1)
+    def _():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_k, off):
+    kj, qi = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = True
+    if causal:
+        live = (qi * block_q + block_q - 1 + off) >= kj * block_k
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)                              # (BQ, BK)
+        if causal:
+            qpos, kpos = _causal_ids(qi, kj, block_q, block_k, off)
+            p = jnp.where(qpos >= kpos, p, 0.0)
+        dv_acc[:] = dv_acc[:] + jnp.dot(p.T, do,
+                                        preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_acc[:] = dk_acc[:] + jnp.dot(ds.T, q,
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call drivers over (B, H, T, D) layout
+# ---------------------------------------------------------------------------
+
+def _block_sizes(seq_q, seq_k):
+    bq = 256 if seq_q % 256 == 0 else 128
+    bk = 256 if seq_k % 256 == 0 else 128
+    return bq, bk
+
+
+def _fwd(q, k, v, causal, scale, interpret):
+    B, H, Tq, D = q.shape
+    K, Tk = k.shape[1], k.shape[2]
+    G = H // K
+    bq, bk = _block_sizes(Tq, Tk)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk, off=Tk - Tq)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, Tq // bq, Tk // bk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D),
+                         lambda b, h, i, j, G=G: (b, h // G, j, 0)),
+            pl.BlockSpec((1, 1, bk, D),
+                         lambda b, h, i, j, G=G: (b, h // G, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Tq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+def _bwd(q, k, v, o, lse, do, causal, scale, interpret):
+    B, H, Tq, D = q.shape
+    K, Tk = k.shape[1], k.shape[2]
+    G = H // K
+    bq, bk = _block_sizes(Tq, Tk)
+    off = Tk - Tq
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, off=off),
+        grid=(B, H, Tq // bq, Tk // bk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D),
+                         lambda b, h, i, j, G=G: (b, h // G, j, 0)),
+            pl.BlockSpec((1, 1, bk, D),
+                         lambda b, h, i, j, G=G: (b, h // G, j, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv per *query* head (grid over H), reduced over each GQA group
+    # outside the kernel — avoids cross-program accumulation
+    dk_p, dv_p = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, off=off),
+        grid=(B, H, Tk // bk, Tq // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D),
+                         lambda b, h, j, i, G=G: (b, h // G, j, 0)),
+            pl.BlockSpec((1, 1, bk, D),
+                         lambda b, h, j, i, G=G: (b, h // G, j, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, j, i: (b, h, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, j, i: (b, h, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tk, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Tk, D), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    if G > 1:
+        dk = dk_p.reshape(B, K, G, Tk, D).sum(axis=2).astype(k.dtype)
+        dv = dv_p.reshape(B, K, G, Tk, D).sum(axis=2).astype(v.dtype)
+    else:
+        dk, dv = dk_p, dv_p
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp core in (B, H, T, D) layout
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_core(q, k, v, causal, scale, interpret):
+    o, _ = _fwd(q, k, v, causal, scale, interpret)
+    return o
+
+
+def _flash_core_fwd(q, k, v, causal, scale, interpret):
+    o, lse = _fwd(q, k, v, causal, scale, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_core_bwd(causal, scale, interpret, res, do):
+    q, k, v, o, lse = res
+    return _bwd(q, k, v, o, lse, do, causal, scale, interpret)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def _tileable(Tq, Tk, D) -> bool:
+    return Tq % 128 == 0 and Tk % 128 == 0 and D >= 32 and D % 8 == 0
+
+
+def flash_attention(q, k, v, causal: bool = False, scale: float = None,
+                    interpret: bool = None):
+    """(B, T, H, D) attention; k/v may have fewer heads (GQA, H % K == 0)
+    or a longer sequence (KV cache; causal is bottom-right aligned).
+
+    Uses the Pallas kernel when shapes tile onto the hardware, else the
+    XLA-fused reference (same math, O(T^2) logits)."""
     from .attention import _sdpa_reference
+
     scale = scale or (1.0 / math.sqrt(q.shape[-1]))
-    return _sdpa_reference(q, k, v, causal, None, scale)
+    B, Tq, H, D = q.shape
+    Tk, K = k.shape[1], k.shape[2]
+    if not _tileable(Tq, Tk, D) or H % K != 0:
+        return _sdpa_reference(q, k, v, causal, None, scale)
+    if interpret is None:
+        interpret = not _on_tpu()
+    # (B, T, H, D) -> (B, H, T, D) for contiguous per-head tiles
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    o = _flash_core(qh, kh, vh, causal, float(scale), bool(interpret))
+    return jnp.swapaxes(o, 1, 2)
