@@ -1,53 +1,46 @@
-"""Headline benchmark: Llama training throughput + MFU on one chip.
+"""Benchmark suite against BASELINE.json's named metrics.
 
-Trains the flagship decoder (models.Llama, ~110M-param `small` config on
-TPU; a tiny config on CPU so the script always completes) through the
-compiled-graph path — forward + backward + SGD update in ONE XLA module
-with donated buffers — and reports model FLOPs utilization against the
-45% target (BASELINE.json:2,5).
+Headline (the ONE stdout JSON line the driver parses): Llama training
+throughput + MFU on one chip through the compiled-graph path — forward +
+backward + update in ONE XLA module with donated buffers, MFU computed
+from the compiled module's XLA cost analysis (true compiled FLOPs), 6ND
+reported alongside on stderr as a cross-check (BASELINE.json:2,5).
 
-Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Secondary metrics (BASELINE.json:2, emitted as `#`-prefixed stderr
+lines after the headline so a driver timeout can never eat the JSON):
+  * ResNet-50 images/sec/chip (examples/cnn workload)
+  * BERT-base samples/sec through the sonnx import path
+  * DistOpt allreduce achieved bandwidth (in-graph psum; on a 1-device
+    host this runs on an 8-device virtual CPU mesh in a subprocess so
+    the code path is still exercised and measured)
+
+Never dies before printing the JSON line: the parent process runs the
+suite in a subprocess with a hard timeout (the TPU plugin has been seen
+both to *raise* at init — BENCH_r01 — and to *hang* indefinitely), and
+falls back to a CPU subprocess, so a wedged backend can never eat the
+stdout contract.
+
+Usage: python bench.py                 # orchestrator; one stdout JSON line
+       python bench.py --sub tpu|cpu   # internal: run the suite in-process
+       python bench.py --allreduce-sub # internal subprocess mode
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
-import jax
-import numpy as np
-
-
-# bf16 peak TFLOP/s per chip by PJRT device_kind substring.
-_PEAK_TFLOPS = [
-    ("v6", 918.0),       # Trillium
-    ("v5p", 459.0),
-    ("v5 lite", 197.0),  # v5e
-    ("v5e", 197.0),
-    ("v4", 275.0),
-    ("v3", 123.0),
-    ("v2", 45.0),
-]
-
-
-def _peak_flops(dev) -> float:
-    kind = getattr(dev, "device_kind", "").lower()
-    for key, tf in _PEAK_TFLOPS:
-        if key in kind:
-            return tf * 1e12
-    if dev.platform == "cpu":
-        return 1e11  # nominal; CPU MFU is not the headline
-    return 275e12  # assume v4 class
+_T0 = time.time()
+_BUDGET_S = float(os.environ.get("SINGA_BENCH_BUDGET_S", "420"))
 
 
 def _probe_flash(seqlen: int) -> None:
     """Compile-check the Pallas flash kernel on this backend; if Mosaic
     isn't supported here, fall back to the XLA-fused attention path
     rather than dying mid-benchmark."""
-    import os
-
+    import jax
     import jax.numpy as jnp
 
     try:
@@ -61,20 +54,36 @@ def _probe_flash(seqlen: int) -> None:
         os.environ["SINGA_DISABLE_FLASH"] = "1"
 
 
-def main() -> None:
-    from singa_tpu import device, models, opt, parallel, tensor
+def _timed_steps(m, batch, steps: int, warmup: int):
+    """Mean step time over `steps` compiled train steps."""
+    import jax
 
-    parallel.set_mesh(None)
-    dev = jax.devices()[0]
-    on_tpu = dev.platform != "cpu"
+    out = None
+    for _ in range(warmup):
+        out = m.train_step(*batch)
+    jax.block_until_ready(out[-1].data)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = m.train_step(*batch)
+    jax.block_until_ready(out[-1].data)
+    return (time.perf_counter() - t0) / steps, out
+
+
+def _detail(name: str, payload: dict) -> None:
+    print("# " + json.dumps({"bench": name, **payload}), file=sys.stderr)
+
+
+def bench_llama(dev, on_tpu: bool) -> dict:
+    """Headline: flagship decoder, tokens/s + MFU (cost-analysis FLOPs)."""
+    import numpy as np
+
+    from singa_tpu import models, opt, tensor
+    from singa_tpu.utils.metrics import peak_flops
+
     if on_tpu:
-        _probe_flash(1024)
-    if on_tpu:
-        device.set_default_device(device.create_tpu_device())
         cfg = models.LlamaConfig.small()
         batch, seqlen, steps, warmup = 8, 1024, 20, 3
     else:
-        device.set_default_device(device.create_cpu_device())
         cfg = models.LlamaConfig.tiny()
         batch, seqlen, steps, warmup = 4, 64, 5, 1
         cfg.max_position = max(cfg.max_position, seqlen)
@@ -86,36 +95,316 @@ def main() -> None:
     ids = tensor.from_numpy(
         np.random.randint(0, cfg.vocab_size, (batch, seqlen)).astype(np.int32))
     m.compile([ids], is_train=True, use_graph=True)
+    n_params = m.num_params()
 
-    n_params = sum(int(np.prod(t.shape)) for t in m.get_params().values())
+    dt, out = _timed_steps(m, (ids,), steps, warmup)
+    tok_per_s = batch * seqlen / dt
+    peak = peak_flops(getattr(dev, "device_kind", None) or dev.platform)
 
-    for _ in range(warmup):
-        _, loss = m.train_step(ids)
-    jax.block_until_ready(loss.data)
+    # MFU from the compiled module's XLA cost analysis (true FLOPs of
+    # fwd+bwd+update as XLA counts them), with the model's analytic
+    # estimate (6N + attention terms) as fallback and cross-check.
+    flops_analytic = m.flops_per_token(seqlen) * batch * seqlen
+    g = m.graph
+    flops_ca = g.flops() if g is not None else 0.0
+    flops = flops_ca if flops_ca else flops_analytic
+    mfu = flops / dt / peak
+    loss = float(out[-1].to_numpy())
+    _detail("llama_train", {
+        "device": getattr(dev, "device_kind", "") or dev.platform,
+        "params_m": round(n_params / 1e6, 1), "batch": batch, "seq": seqlen,
+        "step_ms": round(dt * 1e3, 1), "tokens_per_s": round(tok_per_s, 1),
+        "mfu_cost_analysis": round(mfu, 4),
+        "mfu_analytic": round(flops_analytic / dt / peak, 4),
+        "loss": round(loss, 4)})
+    return {"metric": "llama_train_tokens_per_sec",
+            "value": round(tok_per_s, 2), "unit": "tokens/s",
+            "vs_baseline": round(mfu / 0.45, 4)}
 
+
+def bench_resnet50(dev, on_tpu: bool) -> None:
+    """BASELINE.json:2: ResNet-50 training images/sec/chip."""
+    import numpy as np
+
+    from singa_tpu import models, opt, tensor
+    from singa_tpu.utils.metrics import peak_flops
+
+    tensor.set_seed(0)
+    np.random.seed(0)
+    if on_tpu:
+        m = models.resnet50(num_classes=1000, cifar_stem=False)
+        batch, hw, steps, warmup, name = 32, 224, 10, 2, "resnet50"
+    else:
+        m = models.resnet18(num_classes=10, cifar_stem=True)
+        batch, hw, steps, warmup, name = 4, 32, 3, 1, "resnet18-cifar(cpu)"
+    m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9, weight_decay=1e-4))
+    x = tensor.from_numpy(
+        np.random.randn(batch, 3, hw, hw).astype(np.float32))
+    y = tensor.from_numpy(
+        np.random.randint(0, 10, (batch,)).astype(np.int32))
+    m.compile([x], is_train=True, use_graph=True)
+    dt, out = _timed_steps(m, (x, y), steps, warmup)
+    g = m.graph
+    peak = peak_flops(getattr(dev, "device_kind", None) or dev.platform)
+    mfu = (g.flops() / dt / peak) if (g is not None and g.flops()) else 0.0
+    _detail("resnet50_train", {
+        "model": name, "batch": batch, "image": hw,
+        "step_ms": round(dt * 1e3, 1),
+        "images_per_s": round(batch / dt, 1),
+        "mfu_cost_analysis": round(mfu, 4),
+        "loss": round(float(out[-1].to_numpy()), 4)})
+
+
+def bench_bert_sonnx(dev, on_tpu: bool) -> None:
+    """BASELINE.json:2: BERT-base samples/sec, through the sonnx import
+    path (export native zoo BERT → reimport → compiled train step)."""
+    import numpy as np
+
+    from singa_tpu import autograd, models, opt, sonnx, tensor
+
+    tensor.set_seed(0)
+    np.random.seed(0)
+    if on_tpu:
+        cfg = models.BERTConfig(num_labels=2)
+        batch, seq, steps, warmup = 16, 128, 10, 2
+    else:
+        cfg = models.BERTConfig.tiny(num_labels=2)
+        batch, seq, steps, warmup = 2, 16, 3, 1
+    native = models.BERT(cfg)
+    ids = tensor.from_numpy(np.random.randint(
+        0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    proto = sonnx.to_onnx(native, [ids])
+    rep = sonnx.prepare(proto)
+    rep.set_optimizer(opt.SGD(lr=0.01, momentum=0.9))
+    rep.set_loss(lambda outs, y: autograd.softmax_cross_entropy(
+        outs[0] if isinstance(outs, (list, tuple)) else outs, y))
+    labels = tensor.from_numpy(
+        np.random.randint(0, 2, (batch,)).astype(np.int32))
+    rep.compile([ids], is_train=True, use_graph=True)
+    dt, out = _timed_steps(rep, (ids, labels), steps, warmup)
+    _detail("bert_sonnx_train", {
+        "layers": cfg.num_layers, "dim": cfg.dim, "batch": batch, "seq": seq,
+        "step_ms": round(dt * 1e3, 1),
+        "samples_per_s": round(batch / dt, 1),
+        "loss": round(float(out[-1].to_numpy()), 4)})
+
+
+def _allreduce_bw(n: int, mib: float = 32.0, iters: int = 20) -> dict:
+    """In-graph psum over an n-device 'data' mesh; returns achieved
+    per-device algorithmic bandwidth (ring allreduce moves
+    2(n-1)/n * bytes per device)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from singa_tpu import parallel
+
+    mesh = parallel.make_mesh({"data": n})
+    nelem = int(mib * 2 ** 20 / 4)
+    x = jnp.ones((n, nelem), jnp.float32)
+    f = jax.jit(shard_map(lambda v: jax.lax.psum(v, "data"), mesh=mesh,
+                          in_specs=P("data"), out_specs=P("data")))
+    jax.block_until_ready(f(x))
     t0 = time.perf_counter()
-    for _ in range(steps):
-        _, loss = m.train_step(ids)
-    jax.block_until_ready(loss.data)
-    dt = time.perf_counter() - t0
+    for _ in range(iters):
+        out = f(x)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    bytes_payload = nelem * 4
+    return {"devices": n, "payload_mib": mib,
+            "time_ms": round(dt * 1e3, 3),
+            # algbw = payload/time; busbw applies the ring 2(n-1)/n factor
+            # (NCCL-tests convention) for comparison with link peak
+            "algbw_gb_s": round(bytes_payload / dt / 1e9, 2),
+            "busbw_gb_s": round(2.0 * (n - 1) / n * bytes_payload / dt / 1e9, 2),
+            "platform": jax.devices()[0].platform}
 
-    tokens = batch * seqlen * steps
-    tok_per_s = tokens / dt
-    # standard transformer training cost: ~6 * N FLOPs per token
-    flops_per_step = 6.0 * n_params * batch * seqlen
-    mfu = (flops_per_step * steps / dt) / _peak_flops(dev)
 
-    print(json.dumps({
-        "metric": "llama_train_tokens_per_sec",
-        "value": round(tok_per_s, 2),
-        "unit": "tokens/s",
-        "vs_baseline": round(mfu / 0.45, 4),
-    }))
-    print(f"# device={dev.device_kind or dev.platform} params={n_params/1e6:.1f}M "
-          f"batch={batch} seq={seqlen} step={dt/steps*1e3:.1f}ms "
-          f"MFU={mfu*100:.1f}% loss={float(loss.to_numpy()):.4f}",
-          file=sys.stderr)
+def bench_allreduce() -> None:
+    """BASELINE.json:2: DistOpt allreduce achieved bandwidth. With >1
+    real devices measures ICI; on a 1-device host the same code path is
+    measured on an 8-device virtual CPU mesh in a subprocess."""
+    import subprocess
+
+    import jax
+
+    n = len(jax.devices())
+    if n > 1:
+        _detail("allreduce_bw", _allreduce_bw(n))
+        return
+    from __graft_entry__ import _with_device_count_flag
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = _with_device_count_flag(env.get("XLA_FLAGS", ""), 8)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--allreduce-sub"],
+        env=env, capture_output=True, text=True, timeout=240,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    if r.returncode == 0 and r.stdout.strip():
+        _detail("allreduce_bw", json.loads(r.stdout.strip().splitlines()[-1]))
+    else:
+        _detail("allreduce_bw", {"error": (r.stderr or "")[-300:]})
+
+
+def _allreduce_sub_main() -> None:
+    from __graft_entry__ import _pin_virtual_cpu
+
+    if not _pin_virtual_cpu(8):
+        raise SystemExit("could not pin an 8-device virtual CPU platform")
+    print(json.dumps(_allreduce_bw(8, mib=8.0, iters=10)))
+
+
+def _sub_main(platform: str) -> None:
+    """Run the whole suite in-process on `platform` (called in a child)."""
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    if platform == "tpu" and not on_tpu:
+        raise SystemExit("tpu requested but backend resolved to cpu")
+
+    from singa_tpu import device, parallel
+
+    parallel.set_mesh(None)
+    if on_tpu:
+        _probe_flash(1024)
+        device.set_default_device(device.create_tpu_device())
+    else:
+        device.set_default_device(device.create_cpu_device())
+
+    # Headline first: the stdout JSON line must survive any later crash
+    # or timeout.
+    headline = bench_llama(dev, on_tpu)
+    print(json.dumps(headline), flush=True)
+
+    for fn, args in ((bench_resnet50, (dev, on_tpu)),
+                     (bench_bert_sonnx, (dev, on_tpu)),
+                     (bench_allreduce, ())):
+        if time.time() - _T0 > _BUDGET_S:
+            print(f"# budget exceeded; skipping {fn.__name__}",
+                  file=sys.stderr)
+            continue
+        try:
+            fn(*args)
+        except Exception as e:
+            print(f"# {fn.__name__} failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
+
+def _run_sub(platform: str, timeout_s: float) -> bool:
+    """Spawn `bench.py --sub <platform>` and STREAM its output: the
+    child's headline JSON line is forwarded to our stdout the moment it
+    appears (so a later hang in a secondary bench can't eat it); its
+    stderr detail lines are forwarded to our stderr.  Returns True once
+    a headline was emitted."""
+    import subprocess
+    import threading
+
+    env = dict(os.environ)
+    if platform == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--sub", platform],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        bufsize=1, cwd=os.path.dirname(os.path.abspath(__file__)))
+    emitted = [False]
+
+    def _pump_stdout():
+        for line in p.stdout:
+            line = line.strip()
+            if not line:
+                continue
+            if not emitted[0] and line.startswith("{"):
+                try:
+                    if "metric" in json.loads(line):
+                        print(line, flush=True)
+                        emitted[0] = True
+                        continue
+                except json.JSONDecodeError:
+                    pass
+            print("# [sub stdout] " + line, file=sys.stderr)
+
+    def _pump_stderr():
+        for line in p.stderr:
+            sys.stderr.write(line)
+            sys.stderr.flush()
+
+    ts = [threading.Thread(target=_pump_stdout, daemon=True),
+          threading.Thread(target=_pump_stderr, daemon=True)]
+    for t in ts:
+        t.start()
+    try:
+        p.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        p.wait()
+        print(f"# {platform} sub-bench timed out after {timeout_s:.0f}s "
+              f"and was killed", file=sys.stderr)
+    for t in ts:
+        t.join(timeout=10)
+    return emitted[0]
+
+
+def _tpu_usable(timeout_s: float) -> bool:
+    """Probe in a subprocess: can the TPU backend init AND run a tiny
+    jitted matmul within the timeout?  Protects against both failure
+    modes seen under axon: a fast RuntimeError and an indefinite hang."""
+    import subprocess
+
+    code = ("import jax, jax.numpy as jnp;"
+            "d = jax.devices();"
+            "assert d[0].platform != 'cpu', d;"
+            "x = jnp.ones((256, 256), jnp.bfloat16);"
+            "jax.block_until_ready(jax.jit(lambda a: a @ a)(x));"
+            "print('TPU_PROBE_OK', d[0].device_kind)")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print(f"# TPU probe hung >{timeout_s:.0f}s; using CPU",
+              file=sys.stderr)
+        return False
+    ok = r.returncode == 0 and "TPU_PROBE_OK" in (r.stdout or "")
+    if not ok:
+        tail = ((r.stderr or "").strip().splitlines() or [""])[-1]
+        print(f"# TPU probe failed (rc={r.returncode}): {tail[:200]}",
+              file=sys.stderr)
+    return ok
+
+
+def main() -> None:
+    # Budgets: the recorded driver invocation ("python bench.py", no
+    # wrapper timeout in BENCH_r01.json) sets no hard deadline, so
+    # these bound our own worst case (~10.5 min: hung probe 90s +
+    # wedged-after-probe TPU suite 360s + CPU suite 180s).  In the
+    # common failure mode (TPU wedged at init) the probe catches it and
+    # the CPU headline streams at ~2min; a healthy TPU streams its
+    # headline right after the llama bench.
+    probe_timeout = float(os.environ.get("SINGA_BENCH_PROBE_TIMEOUT_S", "90"))
+    tpu_timeout = float(os.environ.get("SINGA_BENCH_TPU_TIMEOUT_S", "360"))
+    cpu_timeout = float(os.environ.get("SINGA_BENCH_CPU_TIMEOUT_S", "180"))
+
+    emitted = False
+    if _tpu_usable(probe_timeout):
+        emitted = _run_sub("tpu", tpu_timeout)
+    if not emitted:
+        print("# no TPU headline; running the suite on CPU",
+              file=sys.stderr)
+        emitted = _run_sub("cpu", cpu_timeout)
+    if not emitted:
+        print(json.dumps({"metric": "llama_train_tokens_per_sec",
+                          "value": 0.0, "unit": "tokens/s",
+                          "vs_baseline": 0.0}), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if "--allreduce-sub" in sys.argv:
+        _allreduce_sub_main()
+    elif "--sub" in sys.argv:
+        _sub_main(sys.argv[sys.argv.index("--sub") + 1])
+    else:
+        main()

@@ -72,24 +72,34 @@ class Throughput:
         return self.items / max(1e-9, dt)
 
 
-# peak dense bf16 FLOPs per chip (for MFU accounting, BASELINE.json:5)
-_PEAK_FLOPS = {
-    "tpu v4": 275e12,
-    "tpu v5 lite": 197e12,   # v5e bf16
-    "tpu v5e": 197e12,
-    "tpu v5p": 459e12,
-    "tpu v6e": 918e12,
-    "cpu": 1e12,
-}
+# peak dense bf16 FLOPs per chip (for MFU accounting, BASELINE.json:5).
+# Ordered most-specific-first: matched as substrings of the PJRT
+# device_kind (e.g. "TPU v5 lite", "TPU v6 lite", "TPU v4").
+_PEAK_FLOPS = (
+    ("v5 lite", 197e12),   # v5e bf16
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6 lite", 918e12),   # Trillium / v6e
+    ("v6e", 918e12),
+    ("v6", 918e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+    ("cpu", 1e12),
+)
 
 
 def peak_flops(device_kind: Optional[str] = None) -> float:
     import jax
     kind = (device_kind or getattr(jax.devices()[0], "device_kind", "cpu")).lower()
-    for k, v in _PEAK_FLOPS.items():
+    for k, v in _PEAK_FLOPS:
         if k in kind:
             return v
-    return _PEAK_FLOPS["cpu"]
+    # Unknown accelerator kind (e.g. an experimental PJRT plugin that
+    # doesn't embed the vN generation): assume v4-class peak rather than
+    # the CPU nominal, which would inflate MFU ~275x.
+    return 275e12
 
 
 def mfu(model_flops_per_step: float, step_time_s: float,
