@@ -233,10 +233,10 @@ def bench_allreduce() -> None:
     if n > 1:
         _detail("allreduce_bw", _allreduce_bw(n))
         return
-    from __graft_entry__ import _with_device_count_flag
+    from singa_tpu.utils.virtcpu import with_device_count_flag
 
     env = dict(os.environ)
-    env["XLA_FLAGS"] = _with_device_count_flag(env.get("XLA_FLAGS", ""), 8)
+    env["XLA_FLAGS"] = with_device_count_flag(env.get("XLA_FLAGS", ""), 8)
     env["JAX_PLATFORMS"] = "cpu"
     r = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--allreduce-sub"],
@@ -249,9 +249,9 @@ def bench_allreduce() -> None:
 
 
 def _allreduce_sub_main() -> None:
-    from __graft_entry__ import _pin_virtual_cpu
+    from singa_tpu.utils.virtcpu import pin_virtual_cpu
 
-    if not _pin_virtual_cpu(8):
+    if not pin_virtual_cpu(8):
         raise SystemExit("could not pin an 8-device virtual CPU platform")
     print(json.dumps(_allreduce_bw(8, mib=8.0, iters=10)))
 
@@ -307,10 +307,14 @@ def _run_sub(platform: str, timeout_s: float) -> bool:
     env = dict(os.environ)
     if platform == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
+    # soft budget below our hard timeout so the child can skip remaining
+    # benches gracefully instead of being killed mid-bench
+    env.setdefault("SINGA_BENCH_BUDGET_S", str(max(60, int(timeout_s) - 60)))
     p = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--sub", platform],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        bufsize=1, cwd=os.path.dirname(os.path.abspath(__file__)))
+        bufsize=1, start_new_session=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
     emitted = [False]
 
     def _pump_stdout():
@@ -340,7 +344,13 @@ def _run_sub(platform: str, timeout_s: float) -> bool:
     try:
         p.wait(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        p.kill()
+        # kill the whole process group: the child may have grandchildren
+        # (e.g. the --allreduce-sub worker) that a bare kill() would orphan
+        import signal
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            p.kill()
         p.wait()
         print(f"# {platform} sub-bench timed out after {timeout_s:.0f}s "
               f"and was killed", file=sys.stderr)

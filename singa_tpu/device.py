@@ -123,10 +123,15 @@ class CppCPU(Device):
     """
 
     def __init__(self, use_native: bool = False):
-        cpus = [d for d in jax.devices("cpu")] if _has_platform("cpu") else []
+        # process-LOCAL devices: under multi-host (init_distributed),
+        # jax.devices() is the global list and other hosts' devices are
+        # not addressable for eager placement
+        cpus = [d for d in jax.local_devices() if d.platform == "cpu"]
+        if not cpus and _has_platform("cpu"):
+            cpus = [d for d in jax.devices("cpu")
+                    if d.process_index == jax.process_index()]
         if not cpus:
-            # CPU platform always exists in JAX; defensive fallback.
-            cpus = [jax.devices()[0]]
+            cpus = [jax.local_devices()[0]]
         super().__init__("CppCPU", cpus[:1], backend="cpp" if use_native else "xla",
                          default_dtype=np.float32)
         self.use_native = use_native
@@ -156,9 +161,8 @@ def _has_platform(name: str) -> bool:
 
 
 def _accelerator_devices():
-    devs = jax.devices()
-    acc = [d for d in devs if d.platform not in ("cpu",)]
-    return acc
+    # process-local: a host may only place eager buffers on its own chips
+    return [d for d in jax.local_devices() if d.platform not in ("cpu",)]
 
 
 class Platform:

@@ -173,6 +173,23 @@ class Model(Layer):
 Module = Model
 
 
+def _place(a, s):
+    """Put `a` onto sharding `s` (no-op when already placed).
+
+    Multi-host: `s` may span devices of other processes, where
+    `device_put` is illegal — every process holds the same host-global
+    value (executor contract), so each assembles its addressable shards
+    from its own copy via make_array_from_callback."""
+    if hasattr(a, "sharding") and a.sharding == s:
+        return a
+    if s.is_fully_addressable:
+        return jax.device_put(a, s)
+    import numpy as np
+    host = np.asarray(a)
+    return jax.make_array_from_callback(host.shape, s,
+                                        lambda idx: host[idx])
+
+
 class _StepExecutor:
     """Traces the model's imperative step into one jitted XLA module.
 
@@ -377,8 +394,7 @@ class _StepExecutor:
             self.opt.step_counter if self.opt is not None else m._step_count,
             jnp.int32)
         rng = jax.random.fold_in(m._base_key, m._step_count)
-        place = lambda a, s: a if (hasattr(a, "sharding") and a.sharding == s) \
-            else jax.device_put(a, s)
+        place = _place
         if self.dist:
             # place state replicated / batch data-sharded over the mesh the
             # step was compiled against; no-op after the first step

@@ -1,11 +1,16 @@
-"""singa_tpu.parallel — device meshes, collectives, and parallelism
-strategies (DP today; TP/FSDP/SP via mesh-axis changes — SURVEY.md §2.3).
-"""
+"""singa_tpu.parallel — device meshes, collectives, parallelism
+strategies (DP today; TP/FSDP/SP via mesh-axis changes — SURVEY.md §2.3)
+and the multi-host bootstrap (SURVEY.md §2.4)."""
 
 from . import mesh
 from . import communicator
+from . import distributed
 from .mesh import (make_mesh, set_mesh, current_mesh, data_parallel_mesh,
                    mesh_shape)
+from .distributed import (init_distributed, finalize_distributed,
+                          global_mesh, local_batch)
 
-__all__ = ["mesh", "communicator", "make_mesh", "set_mesh", "current_mesh",
-           "data_parallel_mesh", "mesh_shape"]
+__all__ = ["mesh", "communicator", "distributed", "make_mesh", "set_mesh",
+           "current_mesh", "data_parallel_mesh", "mesh_shape",
+           "init_distributed", "finalize_distributed", "global_mesh",
+           "local_batch"]

@@ -1,0 +1,163 @@
+"""Multi-host bootstrap — the reference Communicator's MPI rank setup,
+TPU-native (SURVEY.md §2.4, §3.3: "process bootstrap via JAX/PJRT
+distributed runtime (coordinator + process_index) instead of MPI").
+
+One process per host; `init_distributed()` connects the process to the
+coordination service, after which `jax.devices()` is the GLOBAL device
+list and every mesh built from it spans the pod.  On CPU the collective
+backend is Gloo (selected automatically) so the same N-process path is
+testable with no TPU: tests/test_multiproc.py launches N local
+processes and asserts DP-allreduce ≡ single-process big-batch.
+
+Environment-driven (reference: `mpirun` env), explicit args win:
+
+    SINGA_COORDINATOR   host:port of process 0   (or COORDINATOR_ADDRESS)
+    SINGA_NUM_PROCS     world size               (or num_processes arg)
+    SINGA_PROC_ID       this process's rank      (or process_id arg)
+
+On Cloud TPU pods all three are discovered automatically by JAX and
+`init_distributed()` can be called with no arguments at all.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+__all__ = ["init_distributed", "finalize_distributed", "is_initialized",
+           "global_mesh", "local_batch", "assert_same_across_processes"]
+
+_initialized = False
+
+
+def _env(name: str, *alts: str) -> Optional[str]:
+    for k in (name,) + alts:
+        v = os.environ.get(k)
+        if v:
+            return v
+    return None
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     local_device_ids: Optional[Sequence[int]] = None) -> int:
+    """Connect this process to the JAX distributed runtime.
+
+    Returns the process index.  Safe to call when already initialized
+    (returns the current index).  Single-process fallback: with no
+    coordinator configured anywhere, this is a no-op returning 0 — so
+    example scripts can call it unconditionally.
+    """
+    global _initialized
+    import jax
+
+    if _initialized:
+        return jax.process_index()
+
+    coordinator_address = coordinator_address or _env(
+        "SINGA_COORDINATOR", "COORDINATOR_ADDRESS")
+    if num_processes is None:
+        v = _env("SINGA_NUM_PROCS", "NUM_PROCESSES")
+        num_processes = int(v) if v else None
+    if process_id is None:
+        v = _env("SINGA_PROC_ID", "PROCESS_ID")
+        process_id = int(v) if v else None
+
+    # TPU pod auto-detect: only a real multi-worker topology counts
+    # (single-host images may export TPU_WORKER_HOSTNAMES=localhost)
+    hostnames = _env("TPU_WORKER_HOSTNAMES") or ""
+    tpu_pod = ("," in hostnames) or _env("MEGASCALE_COORDINATOR_ADDRESS")
+    if coordinator_address is None and num_processes is None and not tpu_pod:
+        return 0  # single-process mode
+
+    if jax._src.xla_bridge.backends_are_initialized():
+        import warnings
+        warnings.warn(
+            "init_distributed() called after the JAX backend was already "
+            "initialized; multi-process bootstrap skipped. Call it before "
+            "any jax.devices()/computation.", stacklevel=2)
+        return jax.process_index()
+
+    # CPU multi-process collectives need the Gloo backend; harmless to
+    # request before backend init, ignored by the TPU plugin.
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") or \
+            jax.config.jax_platforms == "cpu":
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
+
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id,
+                               local_device_ids=local_device_ids)
+    _initialized = True
+    return jax.process_index()
+
+
+def finalize_distributed() -> None:
+    """Disconnect from the coordination service (reference:
+    Communicator destructor / MPI_Finalize)."""
+    global _initialized
+    if not _initialized:
+        return
+    import jax
+
+    jax.distributed.shutdown()
+    _initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def global_mesh(axes: Dict[str, int]):
+    """Mesh over the GLOBAL device list (all processes). Axis sizes as
+    in `make_mesh`; the product must not exceed the global device
+    count."""
+    import jax
+
+    from . import mesh as mesh_mod
+    return mesh_mod.make_mesh(axes, jax.devices())
+
+
+def local_batch(global_batch, axis_size: Optional[int] = None):
+    """Slice this process's contiguous shard of a host-global batch
+    (axis 0).  The reference's per-rank data partitioning; use when each
+    host loads the full batch and must feed only its share."""
+    import jax
+    import numpy as np
+
+    n = axis_size or jax.process_count()
+    b = np.asarray(global_batch)
+    if b.shape[0] % n:
+        raise ValueError(f"batch {b.shape[0]} not divisible by {n} processes")
+    per = b.shape[0] // n
+    i = jax.process_index()
+    return b[i * per:(i + 1) * per]
+
+
+def assert_same_across_processes(value: float, tol: float = 0.0) -> None:
+    """Debug guard: every process must see the same scalar (e.g. the
+    replicated loss).  Uses an in-graph collective so it works under
+    any backend."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("_chk",))
+    mx = jax.jit(shard_map(lambda x: jax.lax.pmax(x, "_chk"), mesh=mesh,
+                           in_specs=P(), out_specs=P()))(
+        jnp.float32(value))
+    mn = jax.jit(shard_map(lambda x: jax.lax.pmin(x, "_chk"), mesh=mesh,
+                           in_specs=P(), out_specs=P()))(
+        jnp.float32(value))
+    if float(mx) - float(mn) > tol:
+        raise AssertionError(
+            f"cross-process divergence: max={float(mx)} min={float(mn)}")

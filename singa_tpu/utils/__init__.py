@@ -6,5 +6,6 @@ from . import data
 from . import failure
 from . import metrics
 from . import profiler
+from . import virtcpu
 
-__all__ = ["checkpoint", "data", "failure", "metrics", "profiler"]
+__all__ = ["checkpoint", "data", "failure", "metrics", "profiler", "virtcpu"]

@@ -76,6 +76,8 @@ class Throughput:
 # Ordered most-specific-first: matched as substrings of the PJRT
 # device_kind (e.g. "TPU v5 lite", "TPU v6 lite", "TPU v4").
 _PEAK_FLOPS = (
+    ("7x", 197e12),        # this image's tunneled chip reports "TPU7x";
+                           # PALLAS_AXON_TPU_GEN=v5e ⇒ v5e-class peak
     ("v5 lite", 197e12),   # v5e bf16
     ("v5e", 197e12),
     ("v5p", 459e12),
@@ -91,7 +93,12 @@ _PEAK_FLOPS = (
 
 
 def peak_flops(device_kind: Optional[str] = None) -> float:
+    import os
+
     import jax
+    override = os.environ.get("SINGA_PEAK_TFLOPS")
+    if override:
+        return float(override) * 1e12
     kind = (device_kind or getattr(jax.devices()[0], "device_kind", "cpu")).lower()
     for k, v in _PEAK_FLOPS:
         if k in kind:

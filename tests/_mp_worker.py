@@ -1,0 +1,69 @@
+"""Worker process for tests/test_multiproc.py — NOT a test module.
+
+Runs `steps` DP training steps of the MLP workload as one rank of an
+N-process world (SURVEY.md §4 item 3: N local processes with loopback
+collectives stand in for a cluster).  Every rank feeds the same
+host-global batch; the executor shards it over the global 'data' mesh,
+DistOpt pmeans gradients in-graph, and the final (replicated) params +
+per-step losses are dumped to an .npz for the parent to compare.
+
+argv: rank world port outdir steps
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from singa_tpu.utils.virtcpu import with_device_count_flag  # noqa: E402
+
+# one local CPU device per process: drop any inherited virtual-device flag
+os.environ["XLA_FLAGS"] = with_device_count_flag(
+    os.environ.get("XLA_FLAGS", ""), None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+
+from singa_tpu import models, opt, parallel, tensor  # noqa: E402
+
+
+def main() -> None:
+    rank, world = int(sys.argv[1]), int(sys.argv[2])
+    port, outdir, steps = sys.argv[3], sys.argv[4], int(sys.argv[5])
+
+    idx = parallel.init_distributed(f"127.0.0.1:{port}", world, rank)
+    assert idx == rank and jax.process_count() == world
+    mesh = parallel.global_mesh({"data": world})
+    parallel.set_mesh(mesh)
+
+    tensor.set_seed(0)
+    np.random.seed(0)
+    m = models.MLP(perceptron_size=(32,), num_classes=4)
+    m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.1, momentum=0.9)))
+
+    rng = np.random.RandomState(123)
+    X = rng.randn(8, 16).astype(np.float32)
+    Y = rng.randint(0, 4, (8,)).astype(np.int32)
+    xt, yt = tensor.from_numpy(X), tensor.from_numpy(Y)
+    m.compile([xt], is_train=True, use_graph=True)
+
+    losses = []
+    for _ in range(steps):
+        _, loss = m.train_step(xt, yt)
+        val = float(loss.to_numpy())
+        losses.append(val)
+    parallel.distributed.assert_same_across_processes(losses[-1])
+
+    params = {n: np.asarray(t.data) for n, t in m.get_params().items()}
+    np.savez(os.path.join(outdir, f"rank{rank}.npz"),
+             losses=np.asarray(losses), **params)
+    parallel.finalize_distributed()
+    print(f"rank {rank}/{world} done losses={losses}")
+
+
+if __name__ == "__main__":
+    main()

@@ -8,14 +8,15 @@ enough — we must set XLA_FLAGS before the CPU client exists AND override
 jax_platforms via jax.config."""
 
 import os
+import sys
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from singa_tpu.utils.virtcpu import pin_virtual_cpu  # noqa: E402
+
+assert pin_virtual_cpu(8), "could not pin the 8-device virtual CPU platform"
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
 # exact f32 matmuls for numeric checks (TPU runs keep the fast default)
 jax.config.update("jax_default_matmul_precision", "highest")
 
