@@ -7,13 +7,13 @@ import sys as _sys
 
 import singa_tpu as _impl
 from singa_tpu import (autograd, device, graph, layer, model, opt,  # noqa: F401
-                       ops, parallel, tensor, utils)
+                       ops, parallel, proto, tensor, utils)
 
 __version__ = _impl.__version__
 
 # make `import singa.tensor` style imports resolve to the impl modules
-for _name in ("device", "tensor", "autograd", "layer", "model", "opt",
-              "graph", "ops", "parallel", "utils"):
+for _name in ("device", "proto", "tensor", "autograd", "layer", "model",
+              "opt", "graph", "ops", "parallel", "utils"):
     _sys.modules[f"singa.{_name}"] = getattr(_impl, _name)
 
 

@@ -14,6 +14,7 @@ scripts run with only the device line changed.
 __version__ = "0.1.0"
 
 from . import device
+from . import proto
 from . import tensor
 from . import autograd
 from . import layer
@@ -24,7 +25,7 @@ from . import ops
 from . import parallel
 from . import utils
 
-__all__ = ["device", "tensor", "autograd", "layer", "model", "opt",
+__all__ = ["device", "proto", "tensor", "autograd", "layer", "model", "opt",
            "graph", "ops", "parallel", "utils", "sonnx", "models"]
 
 

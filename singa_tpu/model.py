@@ -223,6 +223,23 @@ class _StepExecutor:
         if opt is not None:
             p_arrays = {n: t.data for n, t in self.param_tensors.items()}
             self.slots = opt.init(p_arrays)
+            # resume: a restored checkpoint leaves moment arrays in the
+            # optimizer's eager store — seed the compiled-step slots from
+            # it so resuming reproduces the uninterrupted trajectory.
+            # Copy (not alias): this executor donates its slots, and the
+            # source arrays may be another live executor's buffers.
+            est = getattr(opt, "_eager_state", None) or {}
+            if isinstance(opt, DistOpt) and not est:
+                est = getattr(opt.opt, "_eager_state", None) or {}
+            for n, restored in est.items():
+                if n not in self.slots:
+                    continue
+                if not _slot_compatible(restored, self.slots[n]):
+                    raise ValueError(
+                        f"restored optimizer state for {n!r} does not fit "
+                        f"this optimizer/model (structure or shape mismatch) "
+                        f"— refusing to silently reinitialize moments")
+                self.slots[n] = jax.tree.map(jnp.copy, restored)
         else:
             self.slots = {}
 
@@ -419,6 +436,22 @@ class _StepExecutor:
             rng = place(rng, self._rep_sh)
             batch_arrays = tuple(place(a, s)
                                  for a, s in zip(batch_arrays, self._batch_sh))
+        else:
+            # plain single-device step, but state may still live on a
+            # multi-device mesh from an earlier dist/gspmd executor (e.g.
+            # eval compiled after set_mesh(None)) — normalize onto the
+            # model's device so jit sees consistent placements
+            dev = model_device(m).jax_devices[0]
+
+            def _unshard(a):
+                if isinstance(a, jax.Array) and len(a.sharding.device_set) > 1:
+                    from .utils.checkpoint import _to_host
+                    return jax.device_put(_to_host(a), dev)
+                return a
+
+            params = {n: _unshard(a) for n, a in params.items()}
+            buffers = {n: _unshard(a) for n, a in buffers.items()}
+            self.slots = jax.tree.map(_unshard, self.slots)
         if self.captured is None:
             lowered = self._jitted.lower(params, buffers, self.slots, step,
                                          rng, *batch_arrays)
@@ -448,9 +481,26 @@ class _StepExecutor:
         m._step_count += 1
         if self.opt is not None:
             self.opt.step_counter = int(step) + 1
+            # mirror compiled-step slots into the optimizer's eager store
+            # (reference assignment, no copy) so save_states always sees
+            # the live moments regardless of execution mode
+            self.opt._eager_state = dict(new_slots)
             if isinstance(self.opt, DistOpt):
                 self.opt.opt.step_counter = self.opt.step_counter
+                self.opt.opt._eager_state = self.opt._eager_state
         return _unflatten_outs(outs, self._out_treedef, m)
+
+
+def _slot_compatible(restored, fresh) -> bool:
+    """True when a restored slot has the same pytree structure and leaf
+    shapes as the freshly initialized one (guards shape/arch mismatch)."""
+    if fresh is None:
+        return restored is None
+    ls_r, td_r = jax.tree.flatten(restored)
+    ls_f, td_f = jax.tree.flatten(fresh)
+    if td_r != td_f or len(ls_r) != len(ls_f):
+        return False
+    return all(tuple(a.shape) == tuple(b.shape) for a, b in zip(ls_r, ls_f))
 
 
 def model_device(model: Model):

@@ -94,7 +94,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     def _():
         l = jnp.maximum(l_ref[:], 1e-30)
         o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_ref[:] + jnp.log(l))[:, 0]
+        # lse carried as (bq, 1): Mosaic requires the trailing two block
+        # dims be (mult-of-8, mult-of-128 | full-dim), which (bq, 1) over
+        # a (B, H, Tq, 1) array satisfies and (1, bq) over (B, H, Tq)
+        # does not (the v5e ValueError from BENCH_r02).
+        lse_ref[0, 0] = m_ref[:] + jnp.log(l)
 
 
 # ---------------------------------------------------------------------------
@@ -120,8 +124,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]
-        delta = delta_ref[0, 0][:, None]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse)
         if causal:
@@ -158,8 +162,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]
-        delta = delta_ref[0, 0][:, None]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse)                              # (BQ, BK)
         if causal:
@@ -207,11 +211,11 @@ def _fwd(q, k, v, causal, scale, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Tq), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Tq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, D), jnp.float32),
@@ -229,7 +233,8 @@ def _bwd(q, k, v, o, lse, do, causal, scale, interpret):
     G = H // K
     bq, bk = _block_sizes(Tq, Tk)
     off = Tk - Tq
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                    keepdims=True)                        # (B, H, Tq, 1)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -242,8 +247,8 @@ def _bwd(q, k, v, o, lse, do, causal, scale, interpret):
             pl.BlockSpec((1, 1, bk, D),
                          lambda b, h, i, j, G=G: (b, h // G, j, 0)),
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
@@ -264,8 +269,8 @@ def _bwd(q, k, v, o, lse, do, causal, scale, interpret):
             pl.BlockSpec((1, 1, bk, D),
                          lambda b, h, j, i, G=G: (b, h // G, j, 0)),
             pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, j, i: (b, h, i)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, j, i: (b, h, i)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),

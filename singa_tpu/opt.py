@@ -173,6 +173,34 @@ class Optimizer:
     def set_states(self, s: Dict) -> None:
         self.step_counter = int(s.get("step", 0))
 
+    # -- moment persistence (checkpoint/resume correctness) -------------------
+    # The graph executor mirrors its compiled-step slots into _eager_state
+    # after every step, so _eager_state is the canonical host-visible store
+    # in both eager and graph mode.
+    def slot_arrays(self) -> Dict[str, List]:
+        """Per-param optimizer moment leaves (momentum buf, Adam m/v, ...)
+        as {name: [leaf, ...]}; empty lists for stateless slots."""
+        out = {}
+        for name, slot in (getattr(self, "_eager_state", None) or {}).items():
+            leaves = [l for l in jax.tree.leaves(slot)]
+            out[name] = leaves
+        return out
+
+    def load_slot_arrays(self, slots: Dict[str, List]) -> None:
+        """Rebuild _eager_state from serialized leaves (inverse of
+        slot_arrays). Slot structure is reconstructed generically: 0
+        leaves -> None, 1 leaf -> the array, N leaves -> tuple."""
+        est = {}
+        for name, leaves in slots.items():
+            arrs = [jnp.asarray(l) for l in leaves]
+            if not arrs:
+                est[name] = None
+            elif len(arrs) == 1:
+                est[name] = arrs[0]
+            else:
+                est[name] = tuple(arrs)
+        self._eager_state = est
+
 
 class SGD(Optimizer):
     """SGD with momentum / nesterov / L2 weight decay (reference parity)."""
@@ -368,3 +396,18 @@ class DistOpt(Optimizer):
     def step(self) -> None:
         self.opt.step()
         self.step_counter = self.opt.step_counter
+
+    def set_states(self, s: Dict) -> None:
+        super().set_states(s)
+        self.opt.set_states(s)
+
+    def slot_arrays(self) -> Dict[str, List]:
+        # eager updates fill the inner opt's store; the graph executor
+        # mirrors into both — prefer whichever is populated
+        if getattr(self.opt, "_eager_state", None):
+            return self.opt.slot_arrays()
+        return super().slot_arrays()
+
+    def load_slot_arrays(self, slots: Dict[str, List]) -> None:
+        self.opt.load_slot_arrays(slots)
+        self._eager_state = self.opt._eager_state

@@ -1,0 +1,80 @@
+"""Proto-enum parity module (reference: core.proto's DataType/DeviceType
+messages; SURVEY.md section 2.2 row 10 — "keep minimal proto for
+dtype/device enums only; Python dataclasses elsewhere").
+
+The reference lineage serializes dtype/device kinds as protobuf enums;
+the TPU-native equivalent keeps the *numbering contract* (so serialized
+configs interoperate) without a protoc dependency: plain IntEnums plus
+converters to the framework's neutral currency (numpy dtypes / jax
+dtypes).  sonnx's wire codec (sonnx/proto.py) carries ONNX's own enum
+space; this module is the singa-side one.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["DataType", "DeviceType", "LangType",
+           "to_np_dtype", "from_np_dtype"]
+
+
+class DataType(enum.IntEnum):
+    """Mirrors the lineage's core.proto DataType numbering; kBfloat16 is
+    the TPU-native addition (appended, so existing numbers are stable)."""
+
+    kFloat32 = 0
+    kFloat16 = 1
+    kInt = 2
+    kChar = 3
+    kDouble = 4
+    kUChar = 5
+    kBfloat16 = 6
+    kInt64 = 7
+    kUnknown = 10
+
+
+class DeviceType(enum.IntEnum):
+    """Lineage device kinds; kTpu is the north-star addition
+    (BASELINE.json:5 — "add a singa::TpuDevice alongside CppCPU/CudaGPU")."""
+
+    kCpp = 0
+    kCuda = 1
+    kOpencl = 2
+    kTpu = 3
+
+
+class LangType(enum.IntEnum):
+    """Kernel-language tag the lineage attaches to device ops; kXla is the
+    TPU-native addition (math dispatches to XLA instead of hand kernels)."""
+
+    kCpp = 0
+    kCuda = 1
+    kOpencl = 2
+    kXla = 3
+
+
+_TO_NP = {
+    DataType.kFloat32: np.dtype(np.float32),
+    DataType.kFloat16: np.dtype(np.float16),
+    DataType.kInt: np.dtype(np.int32),
+    DataType.kChar: np.dtype(np.int8),
+    DataType.kDouble: np.dtype(np.float64),
+    DataType.kUChar: np.dtype(np.uint8),
+    DataType.kBfloat16: np.dtype(jnp.bfloat16),
+    DataType.kInt64: np.dtype(np.int64),
+}
+_FROM_NP = {v: k for k, v in _TO_NP.items()}
+
+
+def to_np_dtype(dt: DataType) -> np.dtype:
+    try:
+        return _TO_NP[DataType(dt)]
+    except KeyError:
+        raise ValueError(f"no numpy dtype for {dt!r}") from None
+
+
+def from_np_dtype(dtype) -> DataType:
+    return _FROM_NP.get(np.dtype(dtype), DataType.kUnknown)

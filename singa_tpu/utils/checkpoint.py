@@ -1,13 +1,26 @@
 """Checkpoint / resume (SURVEY.md §5: reference lineage
 save_states/load_states writing a zip of tensors; we keep the same API
-with atomic writes — host-side .npz plus a json manifest)."""
+with atomic writes — host-side .npz plus a json manifest).
+
+Resume correctness: optimizer moment arrays (momentum buffers, Adam
+m/v) are serialized alongside the params under ``__opt__:<i>`` keys with
+their {param-name, leaf-count} manifest in the json aux, so a restored
+run reproduces the uninterrupted trajectory — the step counter alone is
+not enough (a zeroed momentum silently changes the dynamics).
+
+Multi-host: every process participates in gathering sharded arrays to
+host (a collective under GSPMD), then only process 0 writes the files;
+``CheckpointManager.save`` barriers afterwards so no process races ahead
+and reads a half-written checkpoint. All processes read the same path on
+restore (shared-filesystem convention, as in the reference lineage).
+"""
 
 from __future__ import annotations
 
 import json
 import os
 import tempfile
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -15,6 +28,29 @@ __all__ = ["save_states", "load_states", "save_arrays", "load_arrays",
            "CheckpointManager"]
 
 _AUX_KEY = "__aux__"
+_OPT_PREFIX = "__opt__:"
+
+
+def _process_index() -> int:
+    import jax
+    return jax.process_index()
+
+
+def _process_count() -> int:
+    import jax
+    return jax.process_count()
+
+
+def _to_host(a) -> np.ndarray:
+    """Device -> host copy that works for GSPMD-sharded jax.Arrays.
+
+    Fully-addressable arrays copy directly; multi-host shardings gather
+    via process_allgather (a collective — every process must call it)."""
+    import jax
+    if isinstance(a, jax.Array) and not a.is_fully_addressable:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(a, tiled=True))
+    return np.asarray(a)
 
 
 def save_arrays(arrays: Dict[str, np.ndarray], fpath: str,
@@ -42,23 +78,83 @@ def load_arrays(fpath: str):
     return arrays, aux
 
 
-def save_states(model, fpath: str, aux_states: Optional[Dict] = None) -> None:
-    """Reference API: model.save_states(fpath, aux_states)."""
+def _collect(model, aux_states: Optional[Dict]):
+    """Gather params + optimizer moments to host. Every process must
+    call this: the gather of non-addressable arrays is a collective.
+    Fully-addressable arrays skip the device->host copy on processes
+    that will not write."""
+    writer = _process_index() == 0
+
+    def fetch(a):
+        import jax
+        if isinstance(a, jax.Array) and not a.is_fully_addressable:
+            return _to_host(a)          # collective: all processes join
+        return _to_host(a) if writer else None
+
     states = model.get_states()
     arrays = {}
     for name, t in states.items():
-        arrays[name] = np.asarray(t.data, dtype=np.asarray(t.data).dtype)
+        arrays[name] = fetch(t.data)
     aux = dict(aux_states or {})
-    if getattr(model, "optimizer", None) is not None:
-        aux["optimizer"] = model.optimizer.get_states()
-    save_arrays(arrays, fpath, aux)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None:
+        aux["optimizer"] = opt.get_states()
+        slot_arrays = opt.slot_arrays()
+        manifest: List = []
+        i = 0
+        for name in sorted(slot_arrays):
+            leaves = slot_arrays[name]
+            manifest.append([name, len(leaves)])
+            for leaf in leaves:
+                arrays[f"{_OPT_PREFIX}{i}"] = fetch(leaf)
+                i += 1
+        aux["opt_slots"] = manifest
+    return arrays, aux
+
+
+def _barrier(tag: str) -> None:
+    if _process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(tag)
+
+
+def save_states(model, fpath: str, aux_states: Optional[Dict] = None) -> None:
+    """Reference API: model.save_states(fpath, aux_states).
+
+    Multi-host: collective gather on every process, write on process 0,
+    barrier so no process reads the path before the write lands."""
+    arrays, aux = _collect(model, aux_states)
+    if _process_index() == 0:
+        save_arrays(arrays, fpath, aux)
+    _barrier(f"singa_save_states_{os.path.basename(fpath)}")
+
+
+def _apply(model, arrays: Dict, aux: Dict) -> None:
+    opt_arrays = {k: v for k, v in arrays.items() if k.startswith(_OPT_PREFIX)}
+    model.set_states({k: v for k, v in arrays.items()
+                      if not k.startswith(_OPT_PREFIX)})
+    opt = getattr(model, "optimizer", None)
+    if opt is None:
+        return
+    if "optimizer" in aux:
+        opt.set_states(aux["optimizer"])
+    manifest = aux.get("opt_slots")
+    if manifest is not None:
+        slots, i = {}, 0
+        for name, n_leaves in manifest:
+            slots[name] = [opt_arrays[f"{_OPT_PREFIX}{i + j}"]
+                           for j in range(n_leaves)]
+            i += n_leaves
+        opt.load_slot_arrays(slots)
+        # compiled executors cache their own slot pytrees: drop them so
+        # the next step re-seeds from the restored moments
+        if hasattr(model, "_executors"):
+            model._executors.clear()
 
 
 def load_states(model, fpath: str) -> Dict:
     arrays, aux = load_arrays(fpath)
-    model.set_states(arrays)
-    if "optimizer" in aux and getattr(model, "optimizer", None) is not None:
-        model.optimizer.set_states(aux["optimizer"])
+    _apply(model, arrays, aux)
     return aux
 
 
@@ -101,12 +197,16 @@ class CheckpointManager:
         path = self._path(step)
         a = dict(aux or {})
         a["step"] = int(step)
-        save_states(model, path, a)
-        for old in self.steps()[:-self.keep]:
-            try:
-                os.unlink(self._path(old))
-            except OSError:
-                pass
+        # collective gather on every process; file IO on process 0 only
+        arrays, full_aux = _collect(model, a)
+        if _process_index() == 0:
+            save_arrays(arrays, path, full_aux)
+            for old in self.steps()[:-self.keep]:
+                try:
+                    os.unlink(self._path(old))
+                except OSError:
+                    pass
+        _barrier(f"singa_ckpt_{step}")
         return path
 
     def restore_latest(self, model) -> int:
@@ -120,8 +220,6 @@ class CheckpointManager:
                 arrays, aux = load_arrays(self._path(step))
             except Exception:
                 continue  # torn/corrupt file: fall back to the previous
-            model.set_states(arrays)
-            if "optimizer" in aux and getattr(model, "optimizer", None) is not None:
-                model.optimizer.set_states(aux["optimizer"])
+            _apply(model, arrays, aux)
             return step + 1
         return 0

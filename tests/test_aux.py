@@ -134,3 +134,103 @@ class TestProfiler:
         time.sleep(0.6)
         hb.stop()
         assert hb.fired and fired == [7]
+
+
+class TestProtoEnums:
+    """singa_tpu/proto — lineage enum numbering parity (SURVEY §2.2 row 10)."""
+
+    def test_datatype_numbering_is_lineage_stable(self):
+        from singa_tpu import proto
+        assert proto.DataType.kFloat32 == 0
+        assert proto.DataType.kFloat16 == 1
+        assert proto.DataType.kInt == 2
+        assert proto.DeviceType.kCpp == 0
+        assert proto.DeviceType.kTpu == 3
+
+    def test_dtype_roundtrip(self):
+        import jax.numpy as jnp
+        from singa_tpu import proto
+        for dt in proto.DataType:
+            if dt is proto.DataType.kUnknown:
+                continue
+            np_dt = proto.to_np_dtype(dt)
+            assert proto.from_np_dtype(np_dt) is dt
+        assert proto.from_np_dtype(jnp.bfloat16) is proto.DataType.kBfloat16
+        assert proto.from_np_dtype(np.complex64) is proto.DataType.kUnknown
+
+    def test_singa_alias_exports_proto(self):
+        import singa
+        assert singa.proto.DataType.kBfloat16 == 6
+
+
+class TestResumeCorrectness:
+    """Restored runs must reproduce the uninterrupted trajectory
+    *including optimizer moments* (VERDICT r2 item 3: a resume that
+    silently zeroes momentum changes the dynamics)."""
+
+    @pytest.mark.parametrize("make_opt", [
+        lambda: opt.SGD(lr=0.05, momentum=0.9),
+        lambda: opt.Adam(lr=0.01),
+        lambda: opt.AdamW(lr=0.01),
+    ], ids=["sgd-momentum", "adam", "adamw"])
+    def test_resume_equals_uninterrupted(self, tmp_path, cpu_dev, make_opt):
+        def make():
+            st.tensor.set_seed(0)
+            np.random.seed(0)
+            m = models.MLP(perceptron_size=16, num_classes=4)
+            m.set_optimizer(make_opt())
+            x = Tensor(data=np.random.RandomState(1).randn(8, 10).astype(np.float32),
+                       device=cpu_dev)
+            y = Tensor(data=np.random.RandomState(2).randint(0, 4, 8).astype(np.int32),
+                       device=cpu_dev)
+            m.compile([x], is_train=True, use_graph=True)
+            return m, x, y
+
+        m, x, y = make()
+        for _ in range(6):
+            m.train_step(x, y)
+        ref = {n: np.asarray(t.data) for n, t in m.get_params().items()}
+
+        m1, x, y = make()
+        for _ in range(3):
+            m1.train_step(x, y)
+        ck = checkpoint.CheckpointManager(str(tmp_path), keep=2)
+        ck.save(2, m1, force=True)
+
+        m2, x, y = make()
+        assert ck.restore_latest(m2) == 3
+        assert m2.optimizer.step_counter == 3
+        for _ in range(3):
+            m2.train_step(x, y)
+        got = {n: np.asarray(t.data) for n, t in m2.get_params().items()}
+        for n in ref:
+            np.testing.assert_allclose(got[n], ref[n], rtol=1e-5, atol=1e-6,
+                                       err_msg=f"param {n} diverged on resume")
+
+        # teeth: a continuation with zeroed moments must NOT match —
+        # proves the assertion above actually depends on restored moments
+        m3, x, y = make()
+        ck2 = checkpoint.CheckpointManager(str(tmp_path))
+        assert ck2.restore_latest(m3) == 3
+        m3.optimizer._eager_state = {}          # simulate the r2 bug
+        m3._executors.clear()
+        for _ in range(3):
+            m3.train_step(x, y)
+        diffs = [np.max(np.abs(np.asarray(t.data) - ref[n]))
+                 for n, t in m3.get_params().items()]
+        assert max(diffs) > 1e-6, "moment restore is not load-bearing"
+
+    def test_moments_roundtrip_through_npz(self, tmp_path, cpu_dev):
+        m, x, y = _mlp_and_batch(cpu_dev)
+        m.set_optimizer(opt.Adam(lr=0.01))
+        m.compile([x], is_train=True, use_graph=True)
+        for _ in range(2):
+            m.train_step(x, y)
+        p = str(tmp_path / "ck.npz")
+        checkpoint.save_states(m, p)
+        arrays, aux = checkpoint.load_arrays(p)
+        n_moments = sum(1 for k in arrays if k.startswith("__opt__:"))
+        n_params = len(m.get_params())
+        assert n_moments == 2 * n_params, "Adam m and v must both persist"
+        assert aux["optimizer"]["step"] == 2
+        assert len(aux["opt_slots"]) == n_params

@@ -202,3 +202,149 @@ def test_int8_dtype_object_routes_to_quantized_path():
         out_specs=parallel.mesh.P("data"), check_vma=False)
     out = np.asarray(f(jnp.asarray(g)))
     np.testing.assert_allclose(out, 0.01, rtol=0.05)
+
+
+def test_dp8_checkpoint_resume_with_momentum(tmp_path):
+    """Restored DP run reproduces the uninterrupted DP trajectory
+    including momentum, on the 8-device mesh (VERDICT r2 item 3)."""
+    from singa_tpu.utils import checkpoint
+
+    m_ref, _ = _run(n_steps=6, dist=True)
+    ref = {n: np.asarray(t.data) for n, t in m_ref.get_params().items()}
+
+    m1, _ = _run(n_steps=3, dist=True)
+    ck = checkpoint.CheckpointManager(str(tmp_path))
+    ck.save(2, m1, force=True)
+
+    parallel.set_mesh(parallel.data_parallel_mesh(8))
+    tensor.set_seed(0)
+    np.random.seed(0)
+    x, y = _data()
+    m2 = MLP()
+    m2.set_optimizer(opt.DistOpt(opt.SGD(lr=0.1, momentum=0.9)))
+    tx, ty = tensor.from_numpy(x), tensor.from_numpy(y)
+    m2.compile([tx], is_train=True, use_graph=True)
+    assert ck.restore_latest(m2) == 3
+    for _ in range(3):
+        m2.train_step(tx, ty)
+    for n, t in m2.get_params().items():
+        np.testing.assert_allclose(np.asarray(t.data), ref[n],
+                                   rtol=1e-4, atol=1e-6,
+                                   err_msg=f"param {n} diverged on DP resume")
+
+
+def test_ring_int8_allreduce_correctness():
+    """wire='int8' ring variant: true int8 payloads, result within the
+    widened-grid error bound of the exact mean."""
+    mesh = parallel.data_parallel_mesh(8)
+    from singa_tpu.parallel import communicator as comm
+
+    rng = np.random.RandomState(0)
+    g = rng.randn(8, 300).astype(np.float32)
+
+    f = jax.shard_map(
+        lambda x: comm.quantized_allreduce(x, "data", block=64, wire="int8"),
+        mesh=mesh, in_specs=parallel.mesh.P("data"),
+        out_specs=parallel.mesh.P("data"), check_vma=False)
+    out = np.asarray(f(jnp.asarray(g)))
+    exact = g.mean(axis=0, keepdims=True)
+    # worst-case: per-hop requantize error accumulates O(W) on the sum
+    s = np.abs(g).max() / 127.0
+    W = 8
+    bound = s * (sum(t + 1 for t in range(W - 1)) / 2 + W / 2) / W + s / 2
+    assert np.max(np.abs(out - exact)) <= bound * 1.01
+    # and it still carries real signal
+    assert np.corrcoef(out[0], exact[0])[0, 1] > 0.99
+    # replicated result: every shard row identical
+    np.testing.assert_array_equal(out, np.tile(out[:1], (8, 1)))
+
+
+def test_ring_int8_wire_is_int8():
+    """The compiled HLO's collective-permute and all-gather payloads
+    must be s8 — the whole point of the ring variant."""
+    mesh = parallel.data_parallel_mesh(8)
+    from singa_tpu.parallel import communicator as comm
+
+    f = jax.jit(jax.shard_map(
+        lambda x: comm.quantized_allreduce(x, "data", block=64, wire="int8"),
+        mesh=mesh, in_specs=parallel.mesh.P("data"),
+        out_specs=parallel.mesh.P("data"), check_vma=False))
+    x = jnp.ones((8, 512), jnp.float32)
+    hlo = f.lower(x).compile().as_text()
+    assert "collective-permute" in hlo
+    import re
+    perm_types = re.findall(r"= (\w+)\[[\d,]*\][^\n]*? collective-permute\(", hlo)
+    assert perm_types and all(t == "s8" for t in perm_types), perm_types
+    ag_types = re.findall(r"= (\w+)\[[\d,]*\][^\n]*? all-gather\(", hlo)
+    assert ag_types and all(t == "s8" for t in ag_types), ag_types
+
+
+def test_int8_ring_in_distopt_training():
+    """compress_dtype='int8_ring' (true byte-reduction wire) trains."""
+    from singa_tpu import models
+    mesh = parallel.data_parallel_mesh(8)
+    parallel.set_mesh(mesh)
+    try:
+        tensor.set_seed(0)
+        m = models.MLP(perceptron_size=16, num_classes=4)
+        m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.1),
+                                    compress_dtype="int8_ring"))
+        x = tensor.from_numpy(np.random.RandomState(0).randn(16, 8).astype(np.float32))
+        y = tensor.from_numpy(np.random.RandomState(1).randint(0, 4, 16).astype(np.int32))
+        m.compile([x], is_train=True, use_graph=True)
+        losses = [float(np.asarray(m.train_step(x, y)[1].data))
+                  for _ in range(8)]
+        assert losses[-1] < losses[0], losses
+        assert "collective-permute" in m.graph.compiled_hlo()
+    finally:
+        parallel.set_mesh(None)
+
+
+def test_quantized_allreduce_rejects_bad_wire():
+    from singa_tpu.parallel import communicator as comm
+    with pytest.raises(ValueError):
+        comm.quantized_allreduce(jnp.ones(8), "data", wire="Int8")
+
+
+def test_restore_mismatched_optimizer_state_raises(tmp_path):
+    """A checkpoint that loads but does not fit must raise, not silently
+    zero the moments (contract: restore_latest docstring)."""
+    from singa_tpu import models
+    from singa_tpu.utils import checkpoint
+
+    tensor.set_seed(0)
+    m = models.MLP(perceptron_size=16, num_classes=4)
+    m.set_optimizer(opt.Adam(lr=0.01))
+    x = tensor.from_numpy(np.random.RandomState(0).randn(8, 8).astype(np.float32))
+    y = tensor.from_numpy(np.random.RandomState(1).randint(0, 4, 8).astype(np.int32))
+    m.compile([x], is_train=True, use_graph=True)
+    m.train_step(x, y)
+    ck = checkpoint.CheckpointManager(str(tmp_path))
+    ck.save(0, m, force=True)
+
+    tensor.set_seed(0)
+    m2 = models.MLP(perceptron_size=16, num_classes=4)
+    m2.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))  # different slot shape
+    m2.compile([x], is_train=True, use_graph=True)
+    ck.restore_latest(m2)
+    with pytest.raises(ValueError, match="does not fit"):
+        m2.train_step(x, y)
+
+
+def test_two_batch_shapes_no_donated_slot_aliasing():
+    """Two executors (two batch shapes) must not alias donated slot
+    buffers through the optimizer's eager mirror (regression: r3 review)."""
+    from singa_tpu import models
+    tensor.set_seed(0)
+    m = models.MLP(perceptron_size=16, num_classes=4)
+    m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+    xa = tensor.from_numpy(np.random.RandomState(0).randn(8, 8).astype(np.float32))
+    ya = tensor.from_numpy(np.random.RandomState(1).randint(0, 4, 8).astype(np.int32))
+    xb = tensor.from_numpy(np.random.RandomState(2).randn(4, 8).astype(np.float32))
+    yb = tensor.from_numpy(np.random.RandomState(3).randint(0, 4, 4).astype(np.int32))
+    m.compile([xa], is_train=True, use_graph=True)
+    m.train_step(xa, ya)
+    m.train_step(xb, yb)   # second executor seeds from the mirror
+    m.train_step(xb, yb)   # donates its slots
+    out, loss = m.train_step(xa, ya)   # must not hit deleted buffers
+    assert np.isfinite(float(loss.to_numpy()))
