@@ -1030,6 +1030,8 @@ def log_softmax(a, axis=-1):
 
 
 def dropout(a, p=0.5, key=None):
+    if not is_training() or p <= 0.0:
+        return a          # identity in eval: don't burn (or trace) a key
     if key is None:
         key = tensor_mod._next_key()
     return Dropout(p, key)(a)

@@ -597,7 +597,8 @@ class MultiHeadAttention(Layer):
         self.v_proj = Linear(d, d, bias=True)
         self.out_proj = Linear(d, d, bias=True)
 
-    def forward(self, x: Tensor, mask: Optional[Tensor] = None) -> Tensor:
+    def forward(self, x: Tensor, mask: Optional[Tensor] = None,
+                cache=None, pos=0):
         from .ops import attention as attn_ops
         B, T, D = x.shape
         H = self.num_heads
@@ -605,6 +606,18 @@ class MultiHeadAttention(Layer):
         q = self.q_proj(x).reshape((B, T, H, hd))
         k = self.k_proj(x).reshape((B, T, H, hd))
         v = self.v_proj(x).reshape((B, T, H, hd))
+        if cache is not None:
+            from .ops import kv_cache as kv_ops
+            ck, cv = kv_ops.update_cache(cache[0], cache[1],
+                                         k.data, v.data, pos)
+            if isinstance(pos, int) and pos == 0:
+                o = attn_ops.attention(q, k, v, causal=self.causal, mask=mask)
+            else:
+                m_arr = mask.data if isinstance(mask, Tensor) else mask
+                o_arr = kv_ops.cached_sdpa(q.data, ck, cv, limit=pos + T,
+                                           mask=m_arr)
+                o = Tensor(data=o_arr, device=x.device, requires_grad=False)
+            return self.out_proj(o.reshape((B, T, D))), (ck, cv)
         o = attn_ops.attention(q, k, v, causal=self.causal, mask=mask)
         return self.out_proj(o.reshape((B, T, D)))
 

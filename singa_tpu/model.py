@@ -205,6 +205,27 @@ class _StepExecutor:
     pmean'ed in-graph by DistOpt.reduce_gradients.
     """
 
+    @classmethod
+    def for_planning(cls, model: Model, optimizer, slots_abstract,
+                     example_sds) -> "_StepExecutor":
+        """Abstract executor for shape-only lowering (parallel.planner):
+        same field contract as __init__, but slots come in pre-computed
+        (eval_shape'd — opt.init on real zeros would allocate) and no
+        placement/compile ever happens."""
+        ex = cls.__new__(cls)
+        ex.model = model
+        ex.tag = "train"
+        ex.body = model._train_body
+        ex.captured = None
+        ex.is_train = True
+        ex.param_tensors = dict(model.get_params())
+        ex.buffer_tensors = dict(model._get_buffers())
+        ex.opt = optimizer
+        ex.slots = slots_abstract
+        ex._out_treedef = None
+        ex._build(example_sds)
+        return ex
+
     def __init__(self, model: Model, tag: str, body, example_arrays):
         self.model = model
         self.tag = tag

@@ -22,9 +22,11 @@ from dataclasses import dataclass
 from typing import Optional
 
 from .. import autograd, layer, model
+from ..ops import kv_cache as kv_ops
 from ..ops import rope as rope_ops
 from ..ops.ring_attention import ring_attention
 from ..tensor import Tensor
+from ._generate import GenerateMixin
 from .transformer import next_token_loss
 
 __all__ = ["LlamaConfig", "Llama", "LLAMA_SHARD_RULES"]
@@ -83,15 +85,27 @@ class _LlamaAttention(layer.Layer):
         self._rope = rope_ops.rope_frequencies(c.head_dim, c.max_position,
                                                c.rope_theta)
 
-    def forward(self, x: Tensor) -> Tensor:
+    def forward(self, x: Tensor, cache=None, pos=0):
         c = self.cfg
         B, T, _ = x.shape
         cos, sin = self._rope
         q = self.q_proj(x).reshape((B, T, c.num_heads, c.head_dim))
         k = self.k_proj(x).reshape((B, T, c.num_kv_heads, c.head_dim))
         v = self.v_proj(x).reshape((B, T, c.num_kv_heads, c.head_dim))
-        q = rope_ops.apply_rope(q, cos, sin)
-        k = rope_ops.apply_rope(k, cos, sin)
+        q = rope_ops.apply_rope(q, cos, sin, offset=pos)
+        k = rope_ops.apply_rope(k, cos, sin, offset=pos)
+        if cache is not None:
+            ck, cv = kv_ops.update_cache(cache[0], cache[1],
+                                         k.data, v.data, pos)
+            if isinstance(pos, int) and pos == 0:
+                # prefill: attend within the prompt through the regular
+                # stack (flash kernel when the shape tiles)
+                o = ring_attention(q, k, v, causal=True)
+            else:
+                o_arr = kv_ops.cached_sdpa(q.data, ck, cv, limit=pos + T)
+                o = Tensor(data=o_arr, device=x.device, requires_grad=False)
+            out = self.o_proj(o.reshape((B, T, c.num_heads * c.head_dim)))
+            return out, (ck, cv)
         # ring attention when a 'seq' mesh axis is installed (cross-chip
         # context parallelism); fused SDPA otherwise
         o = ring_attention(q, k, v, causal=True)
@@ -117,13 +131,18 @@ class _LlamaBlock(layer.Layer):
         self.ffn_norm = layer.RMSNorm(cfg.dim, eps=cfg.eps)
         self.ffn = _SwiGLU(cfg)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=0):
+        if cache is not None:
+            a, new_cache = self.attn(self.attn_norm(x), cache, pos)
+            x = x + a
+            x = x + self.ffn(self.ffn_norm(x))
+            return x, new_cache
         x = x + self.attn(self.attn_norm(x))
         x = x + self.ffn(self.ffn_norm(x))
         return x
 
 
-class Llama(model.Model):
+class Llama(GenerateMixin, model.Model):
     SHARD_RULES = LLAMA_SHARD_RULES
 
     def __init__(self, cfg: Optional[LlamaConfig] = None, **kw):
@@ -140,6 +159,23 @@ class Llama(model.Model):
         for blk in self.blocks:
             x = blk(x)
         return self.lm_head(self.norm_f(x))
+
+    # -- KV-cached decoding (ops/kv_cache.py; VERDICT r2 item 4) ------------
+    def init_caches(self, batch: int, max_len: int):
+        c = self.cfg
+        import jax.numpy as jnp
+        dtype = jnp.bfloat16 if self.tok_emb.table.dtype == jnp.bfloat16 \
+            else jnp.float32
+        return kv_ops.init_cache(c.num_layers, batch, max_len,
+                                 c.num_kv_heads, c.head_dim, dtype)
+
+    def forward_cached(self, ids: Tensor, caches, pos):
+        x = self.tok_emb(ids)
+        new_caches = []
+        for blk, cache in zip(self.blocks, caches):
+            x, nc = blk(x, cache, pos)
+            new_caches.append(nc)
+        return self.lm_head(self.norm_f(x)), new_caches
 
     def train_one_batch(self, ids: Tensor, labels: Optional[Tensor] = None):
         logits = self.forward(ids)

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from .. import autograd, layer, model
 from ..tensor import Tensor
+from ._generate import GenerateMixin
 
 __all__ = ["GPT2Config", "GPT2", "BERTConfig", "BERT",
            "TRANSFORMER_SHARD_RULES"]
@@ -100,13 +101,18 @@ class _GPT2Block(layer.Layer):
         self.mlp = _MLP(4 * cfg.dim, "gelu")
         self.drop = layer.Dropout(cfg.dropout)
 
-    def forward(self, x, mask=None):
+    def forward(self, x, mask=None, cache=None, pos=0):
+        if cache is not None:
+            a, new_cache = self.attn(self.ln_1(x), mask, cache, pos)
+            x = x + self.drop(a)
+            x = x + self.drop(self.mlp(self.ln_2(x)))
+            return x, new_cache
         x = x + self.drop(self.attn(self.ln_1(x), mask))
         x = x + self.drop(self.mlp(self.ln_2(x)))
         return x
 
 
-class GPT2(model.Model):
+class GPT2(GenerateMixin, model.Model):
     """GPT-2 causal LM with tied embeddings (reference ONNX GPT-2,
     BASELINE.json:9)."""
 
@@ -143,6 +149,37 @@ class GPT2(model.Model):
         loss = next_token_loss(logits, labels if labels is not None else ids)
         self.optimizer(loss)
         return logits, loss
+
+    # -- KV-cached decoding (ops/kv_cache.py; VERDICT r2 item 4) ------------
+    def init_caches(self, batch: int, max_len: int):
+        c = self.cfg
+        hd = c.dim // c.num_heads
+        dtype = self.wte.table.dtype
+        if dtype not in (jnp.float32, jnp.bfloat16):
+            dtype = jnp.float32
+        from ..ops import kv_cache as kv_ops
+        return kv_ops.init_cache(c.num_layers, batch, max_len,
+                                 c.num_heads, hd, dtype)
+
+    def forward_cached(self, ids: Tensor, caches, pos):
+        T = ids.shape[-1]
+        if isinstance(pos, int):
+            positions = jnp.arange(pos, pos + T, dtype=jnp.int32)
+        else:
+            positions = pos + jnp.arange(T, dtype=jnp.int32)
+        pos_t = Tensor(data=jnp.broadcast_to(positions[None, :], ids.shape),
+                       device=ids.device, requires_grad=False)
+        x = self.wte(ids) + self.wpe(pos_t)
+        x = self.drop(x)
+        new_caches = []
+        for blk, cache in zip(self.blocks, caches):
+            x, nc = blk(x, None, cache, pos)
+            new_caches.append(nc)
+        x = self.ln_f(x)
+        w = self.wte.table
+        if w.dtype != x.dtype:
+            w = autograd.cast(w, x.dtype)
+        return autograd.matmul(x, autograd.transpose(w)), new_caches
 
 
 def next_token_loss(logits: Tensor, ids: Tensor) -> Tensor:

@@ -1,0 +1,61 @@
+"""KV-cache primitives for autoregressive decoding (VERDICT r2 item 4;
+SURVEY.md §7.3.5 — GPT-2 generation with dynamic shapes is hostile to
+XLA, so the TPU-native formulation is a *static* cache: preallocated
+(B, S_max, K, D) buffers updated in place with dynamic_update_slice and
+an explicit validity mask, so every decode step reuses ONE compiled
+module regardless of how many tokens have been generated).
+
+Prefill attends within the prompt via the regular attention stack (the
+Pallas flash kernel when the shape tiles); decode steps (Tq=1) are
+bandwidth-bound matvecs where flash has nothing to win, so they run the
+masked-reference path against the full cache.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["init_cache", "update_cache", "cached_sdpa"]
+
+
+def init_cache(num_layers: int, batch: int, max_len: int, num_kv_heads: int,
+               head_dim: int, dtype=jnp.float32) -> List[Tuple]:
+    """Per-layer (k, v) buffers of shape (B, S_max, K, D)."""
+    shape = (batch, max_len, num_kv_heads, head_dim)
+    return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(num_layers)]
+
+
+def update_cache(ck, cv, k_new, v_new, pos):
+    """Write k/v for positions [pos, pos+T) into the cache (functional).
+
+    `pos` may be a traced scalar — decode steps compile once and slide."""
+    ck = jax.lax.dynamic_update_slice_in_dim(ck, k_new.astype(ck.dtype),
+                                             pos, axis=1)
+    cv = jax.lax.dynamic_update_slice_in_dim(cv, v_new.astype(cv.dtype),
+                                             pos, axis=1)
+    return ck, cv
+
+
+def cached_sdpa(q, ck, cv, limit, scale: float = None, mask=None):
+    """Attention of q (B, T, H, D) against the full cache (B, S, K, D),
+    masked to cache positions < `limit` plus bottom-right-aligned
+    causality inside the query block (query t attends cache positions
+    <= limit - T + t).  GQA (H % K == 0) and the grouped einsums are
+    delegated to attention._sdpa_reference — one attention math, two
+    entry points.  `mask`: optional (B, 1|H, 1|T, S) boolean padding
+    mask ANDed with the validity window."""
+    from .attention import _sdpa_reference
+    T = q.shape[1]
+    S = ck.shape[1]
+    scale = scale or (1.0 / math.sqrt(q.shape[-1]))
+    kpos = jnp.arange(S)[None, :]                       # (1, S)
+    qpos = limit - T + jnp.arange(T)[:, None]           # (T, 1)
+    valid = (kpos <= qpos)[None, None]                  # (1, 1, T, S)
+    if mask is not None:
+        valid = jnp.logical_and(valid, mask)
+    return _sdpa_reference(q, ck, cv, False, valid, scale)

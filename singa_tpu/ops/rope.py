@@ -25,26 +25,34 @@ def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0):
     return jnp.cos(freqs), jnp.sin(freqs)
 
 
-def _rope_fn(x, cos, sin):
-    # x: (B, T, H, D); tables sliced to T
+def _rope_fn(x, cos, sin, offset=0):
+    # x: (B, T, H, D); tables sliced to [offset, offset+T).  `offset` may
+    # be a traced scalar (KV-cached decoding) — dynamic_slice keeps the
+    # compiled decode step position-independent.
+    import jax
     T = x.shape[1]
-    c = cos[:T][None, :, None, :]
-    s = sin[:T][None, :, None, :]
+    if isinstance(offset, int) and offset == 0:
+        c, s = cos[:T], sin[:T]
+    else:
+        c = jax.lax.dynamic_slice_in_dim(cos, offset, T, axis=0)
+        s = jax.lax.dynamic_slice_in_dim(sin, offset, T, axis=0)
+    c = c[None, :, None, :]
+    s = s[None, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
     return out.astype(x.dtype)
 
 
 class Rope(autograd.Operator):
-    def __init__(self, cos, sin):
+    def __init__(self, cos, sin, offset=0):
         super().__init__()
-        self.cos, self.sin = cos, sin
+        self.cos, self.sin, self.offset = cos, sin, offset
 
     def fwd(self, x):
-        return _rope_fn(x, self.cos, self.sin)
+        return _rope_fn(x, self.cos, self.sin, self.offset)
 
 
-def apply_rope(x, cos, sin):
+def apply_rope(x, cos, sin, offset=0):
     if isinstance(x, Tensor):
-        return Rope(cos, sin)(x)
-    return _rope_fn(x, cos, sin)
+        return Rope(cos, sin, offset)(x)
+    return _rope_fn(x, cos, sin, offset)

@@ -1,0 +1,181 @@
+"""Shape-only memory/sharding planner (the TPU-native analog of the
+reference Graph/Scheduler's memory planning — SURVEY.md §1.2 L2 "memory
+planning"; exercised against the Llama-3-8B stretch config,
+BASELINE.json:11).
+
+Everything here is abstract: parameters are initialized under
+``jax.eval_shape`` (no 16 GB of real weights), optimizer slots likewise,
+and the FULL training step — forward, backward, collectives, update —
+is ``jit.lower``-ed against a target mesh with the model's SHARD_RULES,
+WITHOUT compiling or allocating.  The result reports exact per-device
+parameter/optimizer/gradient bytes so "does this model fit a v4 chip's
+HBM under this mesh?" is answerable before touching hardware.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import mesh as mesh_mod
+from . import spmd
+
+__all__ = ["abstract_init", "plan_train_step", "MemoryPlan", "HBM_BYTES"]
+
+# per-chip HBM of the generations the metrics table knows about
+HBM_BYTES = {
+    "v2": 8 << 30, "v3": 16 << 30, "v4": 32 << 30,
+    "v5e": 16 << 30, "v5p": 95 << 30, "v6e": 32 << 30,
+}
+
+
+def abstract_init(model, example_sds) -> None:
+    """Materialize the model's parameter *shapes* without allocating:
+    run the lazy-init forward under eval_shape, then rebind every param
+    Tensor's data to a ShapeDtypeStruct."""
+    from .. import autograd
+    from .. import tensor as tensor_mod
+    from ..model import model_device
+    from ..tensor import Tensor
+
+    dev = model_device(model)
+
+    def fwd(*arrs):
+        prev = autograd.is_training()
+        autograd.set_training(False)
+        try:
+            ts = tuple(Tensor(data=a, device=dev, requires_grad=False)
+                       for a in arrs)
+            out = model.forward(*ts)
+            leaf = out[0] if isinstance(out, (tuple, list)) else out
+            return leaf.data
+        finally:
+            autograd.set_training(prev)
+
+    saved_key = tensor_mod._rng_key    # init draws keys under the trace;
+    try:                               # the global must not keep a tracer
+        jax.eval_shape(fwd, *example_sds)
+    finally:
+        tensor_mod._rng_key = saved_key
+    # params now hold leaked tracers; shape/dtype are safe to read —
+    # swap them for honest abstract values
+    for t in list(model.get_params().values()) + \
+            list(model._get_buffers().values()):
+        t.data = jax.ShapeDtypeStruct(tuple(t.data.shape), t.data.dtype)
+
+
+def _reset_lazy(layer) -> None:
+    """Recursively clear lazy-init state so the next forward re-creates
+    concrete parameters (planner leaves abstract data behind)."""
+    layer._initialized = False
+    layer._params.clear()
+    layer._states.clear()
+    for sub in layer._sublayers.values():
+        _reset_lazy(sub)
+
+
+@dataclass
+class MemoryPlan:
+    mesh_shape: Dict[str, int]
+    param_bytes_global: int
+    param_bytes_per_device: int
+    slot_bytes_per_device: int
+    grad_bytes_per_device: int
+    per_device_state_bytes: int = field(init=False)
+    lowered: object = None
+
+    def __post_init__(self):
+        self.per_device_state_bytes = (self.param_bytes_per_device
+                                       + self.slot_bytes_per_device
+                                       + self.grad_bytes_per_device)
+
+    def fits(self, chip: str = "v4", headroom: float = 0.75) -> bool:
+        """True when params + moments + one gradient set leave
+        `1-headroom` of the chip's HBM for activations/workspace."""
+        return self.per_device_state_bytes <= HBM_BYTES[chip] * headroom
+
+
+def _sharded_bytes(shape, dtype, sharding) -> int:
+    """Exact per-device bytes of an array under a NamedSharding."""
+    spec = sharding.spec
+    mesh = sharding.mesh
+    elems = int(np.prod(shape)) if shape else 1
+    denom = 1
+    for ax in spec:
+        if ax is None:
+            continue
+        for a in (ax if isinstance(ax, tuple) else (ax,)):
+            denom *= mesh.shape[a]
+    return math.ceil(elems / denom) * np.dtype(dtype).itemsize
+
+
+def plan_train_step(model, optimizer, batch_sds,
+                    mesh: Optional[mesh_mod.Mesh] = None,
+                    lower: bool = True) -> MemoryPlan:
+    """Abstract-init `model`, derive SHARD_RULES shardings over `mesh`,
+    optionally jit.lower the full train step (no compile), and return
+    the per-device memory accounting.
+
+    `batch_sds`: tuple of jax.ShapeDtypeStruct for train_one_batch args."""
+    from ..model import _StepExecutor
+    from ..opt import DistOpt
+
+    mesh = mesh or mesh_mod.current_mesh()
+    if mesh is None:
+        raise ValueError("plan_train_step needs a mesh")
+    abstract_init(model, batch_sds[:1])
+
+    params = {n: t.data for n, t in model.get_params().items()}
+    rules = getattr(model, "SHARD_RULES", None)
+    shardings = spmd.param_shardings(params, rules, mesh)
+    slots_abs = jax.eval_shape(optimizer.init, params)
+    slot_sh = spmd.tree_shardings(slots_abs, shardings, mesh,
+                                  {n: p.shape for n, p in params.items()})
+
+    pb_global = sum(int(np.prod(p.shape)) * np.dtype(p.dtype).itemsize
+                    for p in params.values())
+    pb_dev = sum(_sharded_bytes(p.shape, p.dtype, shardings[n])
+                 for n, p in params.items())
+    sb_dev = 0
+    for n, sub in slots_abs.items():
+        for leaf, sh in zip(jax.tree.leaves(sub),
+                            jax.tree.leaves(slot_sh[n],
+                                            is_leaf=lambda x: hasattr(x, "spec"))):
+            sb_dev += _sharded_bytes(leaf.shape, leaf.dtype, sh)
+    # gradients live at param shardings for one step
+    gb_dev = pb_dev
+
+    lowered = None
+    if lower:
+        saved_opt = model.optimizer
+        model.set_optimizer(optimizer)
+        saved = mesh_mod.current_mesh()
+        mesh_mod.set_mesh(mesh)
+        try:
+            ex = _StepExecutor.for_planning(model, optimizer, slots_abs,
+                                            batch_sds)
+            step_sds = jax.ShapeDtypeStruct((), jnp.int32)
+            rng_sds = jax.ShapeDtypeStruct((2,), jnp.uint32)
+            buffers = {n: t.data for n, t in ex.buffer_tensors.items()}
+            lowered = ex._jitted.lower(params, buffers, slots_abs,
+                                       step_sds, rng_sds, *batch_sds)
+        finally:
+            mesh_mod.set_mesh(saved)
+            model.optimizer = saved_opt
+
+    # planning consumed the lazy params (they are ShapeDtypeStructs now):
+    # clear lazy-init state so the model re-initializes real weights on
+    # its next compile/forward instead of crashing on abstract data
+    _reset_lazy(model)
+
+    return MemoryPlan(mesh_shape=dict(mesh.shape),
+                      param_bytes_global=pb_global,
+                      param_bytes_per_device=pb_dev,
+                      slot_bytes_per_device=sb_dev,
+                      grad_bytes_per_device=gb_dev,
+                      lowered=lowered)

@@ -227,3 +227,71 @@ class TestBF16ComputePath:
         assert logits.dtype == jnp.bfloat16, "llama logits must be bf16"
         for name, p in m.get_params().items():
             assert p.dtype == np.float32, f"master weight {name} not f32"
+
+
+class TestKVCacheGeneration:
+    """generate() with the static KV cache (ops/kv_cache.py): cached
+    decoding must equal re-running the full forward per token, and the
+    decode step must compile exactly once (per-token cost independent of
+    generated length — VERDICT r2 item 4)."""
+
+    def _uncached_greedy(self, m, prompt, steps):
+        ids = prompt.copy()
+        for _ in range(steps):
+            logits = np.asarray(m.eval()(tensor.from_numpy(ids)).data)
+            nxt = logits[:, -1, :].argmax(-1).astype(np.int32)
+            ids = np.concatenate([ids, nxt[:, None]], axis=1)
+        return ids
+
+    @pytest.mark.parametrize("family", ["llama", "gpt2"])
+    def test_cached_equals_uncached(self, family):
+        tensor.set_seed(0)
+        np.random.seed(0)
+        if family == "llama":
+            m = models.Llama(models.LlamaConfig.tiny())
+        else:
+            m = models.GPT2(models.GPT2Config.tiny())
+        prompt = np.random.RandomState(1).randint(0, 256, (2, 8)).astype(np.int32)
+        m.compile([tensor.from_numpy(prompt)], is_train=False, use_graph=False)
+        out = m.generate(prompt, max_new_tokens=6)
+        ref = self._uncached_greedy(m, prompt, 6)
+        np.testing.assert_array_equal(out, ref)
+
+    def test_decode_compiles_once(self):
+        tensor.set_seed(0)
+        m = models.Llama(models.LlamaConfig.tiny())
+        prompt = np.random.RandomState(2).randint(0, 256, (1, 8)).astype(np.int32)
+        m.compile([tensor.from_numpy(prompt)], is_train=False, use_graph=False)
+        m.generate(prompt, max_new_tokens=10)
+        sess = next(iter(m._gen_sessions.values()))
+        assert sess.decode._cache_size() == 1, \
+            "decode re-compiled: per-token cost depends on position"
+
+    def test_sampled_generation_shape_and_determinism(self):
+        tensor.set_seed(0)
+        m = models.GPT2(models.GPT2Config.tiny())
+        prompt = np.random.RandomState(3).randint(0, 256, (2, 4)).astype(np.int32)
+        m.compile([tensor.from_numpy(prompt)], is_train=False, use_graph=False)
+        a = m.generate(prompt, max_new_tokens=5, temperature=0.8, seed=7)
+        b = m.generate(prompt, max_new_tokens=5, temperature=0.8, seed=7)
+        assert a.shape == (2, 9)
+        np.testing.assert_array_equal(a, b)
+        assert (a[:, :4] == prompt).all()
+
+    def test_generate_rejects_context_overflow(self):
+        tensor.set_seed(0)
+        m = models.GPT2(models.GPT2Config.tiny())      # max_position=64
+        prompt = np.zeros((1, 60), np.int32)
+        m.compile([tensor.from_numpy(prompt)], is_train=False, use_graph=False)
+        with pytest.raises(ValueError, match="max_position"):
+            m.generate(prompt, max_new_tokens=10)
+
+    def test_generate_eos_keeps_static_shape(self):
+        tensor.set_seed(0)
+        m = models.GPT2(models.GPT2Config.tiny())
+        prompt = np.random.RandomState(5).randint(0, 256, (2, 4)).astype(np.int32)
+        m.compile([tensor.from_numpy(prompt)], is_train=False, use_graph=False)
+        ref = m.generate(prompt, max_new_tokens=6)
+        eos = int(ref[0, 4])                 # force eos on the 1st new token
+        out = m.generate(prompt, max_new_tokens=6, eos_id=eos)
+        assert out.shape == (2, 10), "eos must not change the static shape"

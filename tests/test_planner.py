@@ -1,0 +1,121 @@
+"""Shape-only memory/sharding planner tests — the Llama-3-8B stretch
+config exercised end-to-end abstractly (VERDICT r2 item 5;
+BASELINE.json:11): eval_shape param init, SHARD_RULES shardings over a
+32-device mesh, full-train-step lowering, per-device HBM-fit assertion.
+No real weights are ever allocated."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from singa_tpu import models, opt, parallel
+from singa_tpu.parallel import planner
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+class TestPlannerInProcess:
+    def test_tiny_llama_plan_math(self):
+        """Per-device byte accounting matches hand computation."""
+        mesh = parallel.make_mesh({"data": 2, "model": 4})
+        m = models.Llama(models.LlamaConfig.tiny())
+        batch = (jax.ShapeDtypeStruct((2, 16), jnp.int32),)
+        plan = planner.plan_train_step(m, opt.SGD(lr=0.1, momentum=0.9),
+                                       batch, mesh=mesh, lower=True)
+        # expected bytes from an independently abstract-inited twin
+        twin = models.Llama(models.LlamaConfig.tiny())
+        planner.abstract_init(twin, batch[:1])
+        expect_global = sum(int(np.prod(t.data.shape)) * 4
+                            for t in twin.get_params().values())
+        assert plan.param_bytes_global == expect_global
+        # momentum slots mirror param shardings -> same per-device bytes
+        assert plan.slot_bytes_per_device == plan.param_bytes_per_device
+        # TP must actually shard: per-device < global / data_axis_only
+        assert plan.param_bytes_per_device < expect_global
+        assert plan.lowered is not None
+        assert len(plan.lowered.as_text()) > 1000
+
+    def test_abstract_init_allocates_nothing(self):
+        m = models.Llama(models.LlamaConfig.tiny())
+        planner.abstract_init(m, (jax.ShapeDtypeStruct((1, 8), jnp.int32),))
+        for t in m.get_params().values():
+            assert isinstance(t.data, jax.ShapeDtypeStruct)
+
+    def test_model_usable_after_planning(self):
+        """Planning must not consume the model: a subsequent compile +
+        train step re-initializes real weights (r3 review finding)."""
+        from singa_tpu import tensor
+        mesh = parallel.make_mesh({"data": 2, "model": 4})
+        m = models.Llama(models.LlamaConfig.tiny())
+        planner.plan_train_step(m, opt.SGD(lr=0.1, momentum=0.9),
+                                (jax.ShapeDtypeStruct((2, 16), jnp.int32),),
+                                mesh=mesh, lower=False)
+        assert m.optimizer is None        # planner's optimizer not leaked
+        ids = tensor.from_numpy(
+            np.random.RandomState(0).randint(0, 256, (2, 16)).astype(np.int32))
+        m.set_optimizer(opt.SGD(lr=0.1))
+        m.compile([ids], is_train=True, use_graph=True)
+        _, loss = m.train_step(ids, ids)
+        assert np.isfinite(float(loss.to_numpy()))
+
+    def test_sharded_bytes_exact(self):
+        mesh = parallel.make_mesh({"data": 2, "model": 4})
+        sh = parallel.mesh.NamedSharding(mesh, parallel.mesh.P(None, "model"))
+        assert planner._sharded_bytes((8, 16), jnp.float32, sh) == 8 * 4 * 4
+        rep = parallel.mesh.NamedSharding(mesh, parallel.mesh.P())
+        assert planner._sharded_bytes((8, 16), jnp.bfloat16, rep) == 8 * 16 * 2
+
+
+_SUB = r"""
+import os
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=32").strip()
+import json
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from singa_tpu import models, opt, parallel, device
+from singa_tpu.parallel import planner
+
+device.set_default_device(device.create_cpu_device())
+mesh = parallel.make_mesh({"data": 4, "model": 8})
+m = models.Llama(models.LlamaConfig.llama3_8b())
+batch = (jax.ShapeDtypeStruct((4, 1024), jnp.int32),)
+plan = planner.plan_train_step(m, opt.SGD(lr=1e-3, momentum=0.9), batch,
+                               mesh=mesh, lower=True)
+print(json.dumps({
+    "param_bytes_global": plan.param_bytes_global,
+    "param_bytes_per_device": plan.param_bytes_per_device,
+    "slot_bytes_per_device": plan.slot_bytes_per_device,
+    "state_per_device": plan.per_device_state_bytes,
+    "fits_v4": plan.fits("v4"),
+    "lowered_chars": len(plan.lowered.as_text()),
+}))
+"""
+
+
+def test_llama3_8b_plans_on_32_device_mesh():
+    """The stretch config (BASELINE.json:11) lowers its FULL train step
+    over a 4x8 DPxTP virtual mesh and fits a v4 chip's HBM per device.
+    Runs in a subprocess for the 32-device platform flag."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, "-c", _SUB], env=env,
+                       capture_output=True, text=True, timeout=420,
+                       cwd=os.path.join(_HERE, ".."))
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    n_params = out["param_bytes_global"] / 4       # f32 masters
+    assert 7.5e9 < n_params < 8.5e9, "llama3_8b should be ~8B params"
+    assert out["fits_v4"], out
+    assert out["state_per_device"] < planner.HBM_BYTES["v4"] * 0.75
+    # TP sharding is real: per-device params well under global/4 (DP alone)
+    assert out["param_bytes_per_device"] < out["param_bytes_global"] / 4
+    assert out["lowered_chars"] > 10000
