@@ -5,6 +5,7 @@ and the multi-host bootstrap (SURVEY.md §2.4)."""
 from . import mesh
 from . import communicator
 from . import distributed
+from . import pipeline
 from . import planner
 from .mesh import (make_mesh, set_mesh, current_mesh, data_parallel_mesh,
                    mesh_shape)
@@ -12,7 +13,7 @@ from .distributed import (init_distributed, finalize_distributed,
                           global_mesh, local_batch)
 from .planner import plan_train_step
 
-__all__ = ["mesh", "communicator", "distributed", "planner", "make_mesh",
-           "set_mesh", "current_mesh", "data_parallel_mesh", "mesh_shape",
-           "init_distributed", "finalize_distributed", "global_mesh",
-           "local_batch", "plan_train_step"]
+__all__ = ["mesh", "communicator", "distributed", "pipeline", "planner",
+           "make_mesh", "set_mesh", "current_mesh", "data_parallel_mesh",
+           "mesh_shape", "init_distributed", "finalize_distributed",
+           "global_mesh", "local_batch", "plan_train_step"]

@@ -8,7 +8,7 @@ singa_tpu is expressed as mesh axes:
     'data'  — data parallel (the reference's only strategy)
     'model' — tensor parallel (stretch: Llama-3-8B, BASELINE.json:11)
     'seq'   — sequence/context parallel (ring attention)
-    'pipe'  — pipeline stages
+    'pipe'  — pipeline stages (GPipe schedule: parallel.pipeline.gpipe)
 """
 
 from __future__ import annotations

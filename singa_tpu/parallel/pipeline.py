@@ -1,0 +1,110 @@
+"""Pipeline parallelism over the 'pipe' mesh axis (GPipe schedule,
+SPMD formulation).
+
+Not a reference capability (SURVEY.md §2.3: the reference's only
+strategy is DP) — this is the TPU-native extension that makes the
+'pipe' axis advertised in parallel.mesh real.  Design follows the
+collective-pipelining recipe: run under shard_map with each 'pipe' rank
+holding ONE stage's parameters; every schedule tick each rank applies
+its stage and ships the activation to the next rank with a single
+`lax.ppermute` hop over ICI; `lax.scan` drives the n_micro + S - 1
+ticks.  Because `ppermute`'s transpose is the reverse permute and scan
+differentiates, `jax.grad` of the pipelined loss IS the backward
+pipeline (reverse schedule) — no hand-written bwd pass.
+
+Stages must share one parameter structure (scan-over-layers style);
+stage params are stacked on a leading axis sharded over 'pipe'.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from . import mesh as mesh_mod
+
+__all__ = ["gpipe", "stack_stage_params", "pipeline_mesh"]
+
+
+def pipeline_mesh(n_stages: int, data: int = 1):
+    axes = {}
+    if data > 1:
+        axes["data"] = data
+    axes["pipe"] = n_stages
+    return mesh_mod.make_mesh(axes)
+
+
+def stack_stage_params(per_stage_params):
+    """[stage0_tree, stage1_tree, ...] -> one tree with a leading stage
+    axis (shard it over 'pipe' via P('pipe'))."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
+
+
+def gpipe(stage_fn: Callable, n_micro: int, axis: str = "pipe"):
+    """Build the per-shard body of a GPipe pipeline.
+
+    stage_fn(stage_params, x) -> y  must map activations of one
+    microbatch through one stage, preserving shape (classic pipeline
+    constraint; project in/out around the pipeline).
+
+    Returns body(stage_params, x_micro) for use inside shard_map, where
+      * stage_params: this rank's stage weights (leading stage axis
+        already consumed by the 'pipe' in_spec),
+      * x_micro: (n_micro, mb, ...) microbatched input, replicated over
+        `axis`,
+    and the result is (n_micro, mb, ...) — the last stage's outputs,
+    replicated back so every rank returns the same value.
+    """
+
+    def body(stage_params, x_micro):
+        # the 'pipe' in_spec leaves a leading stage axis of length 1;
+        # anything else means stacked stages != pipe axis size and a[0]
+        # would silently drop stages
+        for leaf in jax.tree.leaves(stage_params):
+            if leaf.shape[0] != 1:
+                raise ValueError(
+                    f"stacked stage count x pipe axis mismatch: per-rank "
+                    f"leading stage axis is {leaf.shape[0]}, expected 1 — "
+                    f"stack exactly axis_size('{axis}') stages")
+        stage_params = jax.tree.map(lambda a: a[0], stage_params)
+        S = jax.lax.axis_size(axis)
+        r = jax.lax.axis_index(axis)
+        n_ticks = n_micro + S - 1
+        fwd = [(i, (i + 1) % S) for i in range(S)]
+        mb_shape = x_micro.shape[1:]
+        out0 = jnp.zeros((n_micro,) + mb_shape, x_micro.dtype)
+        buf0 = jnp.zeros(mb_shape, x_micro.dtype)
+
+        def tick(carry, t):
+            buf, outs = carry
+            # stage 0 injects microbatch t (clamped; masked-off later)
+            inject = jax.lax.dynamic_index_in_dim(
+                x_micro, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False)
+            x_in = jnp.where(r == 0, inject, buf)
+            y = stage_fn(stage_params, x_in)
+            # my microbatch index this tick; stage r works on t - r
+            mb_idx = t - r
+            live = jnp.logical_and(mb_idx >= 0, mb_idx < n_micro)
+            # bubble ticks must not pollute grads: zero the activation
+            y = jnp.where(live, y, jnp.zeros_like(y))
+            # last stage records its finished microbatch
+            outs = _record(outs, y, mb_idx, r, S, live)
+            buf = jax.lax.ppermute(y, axis, fwd)
+            return (buf, outs), None
+
+        (_, outs), _ = jax.lax.scan(tick, (buf0, out0), jnp.arange(n_ticks))
+        # replicate the last stage's collected outputs to every rank
+        outs = jax.lax.psum(
+            jnp.where(r == S - 1, outs, jnp.zeros_like(outs)), axis)
+        return outs
+
+    return body
+
+
+def _record(outs, y, mb_idx, r, S, live):
+    take = jnp.logical_and(r == S - 1, live)
+    updated = jax.lax.dynamic_update_index_in_dim(
+        outs, y, jnp.clip(mb_idx, 0, outs.shape[0] - 1), axis=0)
+    return jnp.where(take, updated, outs)

@@ -171,7 +171,7 @@ def _e_cast(ex, op, ins, outs):
 
 @_exports(autograd.Clip)
 def _e_clip(ex, op, ins, outs):
-    dt = np.asarray(outs[0].data).dtype
+    dt = np.dtype(outs[0].dtype)           # dtype only: no host copy
     lo = ex.add_init(np.asarray(op.lo, dt), "clip_min")
     hi = ex.add_init(np.asarray(op.hi, dt), "clip_max")
     ex.emit("Clip", [ins[0], lo, hi], _outn(ex, outs))
@@ -499,11 +499,18 @@ def _register_sdpa_rule():
         ex.emit("Transpose", [kn], [kT], perm=[0, 2, 3, 1])      # B,H,D,Tk
         raw = ex.fresh("scores_raw")
         ex.emit("MatMul", [qh, kT], [raw])
+        # constants in the traced activation dtype (same pattern as
+        # _e_clip) so bf16/f16 exports don't emit type-mismatched nodes
+        act_dt = np.dtype(outs[0].dtype)   # dtype only: no host copy
+        try:
+            neg_val = np.finfo(act_dt).min
+        except ValueError:            # np.finfo can't read ml_dtypes bf16
+            import ml_dtypes
+            neg_val = ml_dtypes.finfo(act_dt).min
         scores = ex.fresh("scores")
-        ex.emit("Mul", [raw, ex.add_init(np.asarray(scale, np.float32),
+        ex.emit("Mul", [raw, ex.add_init(np.asarray(scale, act_dt),
                                          "scale")], [scores])
-        neg = ex.add_init(
-            np.asarray(np.finfo(np.float32).min, np.float32), "neg_inf")
+        neg = ex.add_init(np.asarray(neg_val, act_dt), "neg_inf")
         if op.causal:
             cm = np.tril(np.ones((Tq, Tk), np.bool_), k=Tk - Tq)
             cmn = ex.add_init(cm, "causal_mask")

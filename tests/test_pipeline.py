@@ -1,0 +1,128 @@
+"""Pipeline parallelism ('pipe' mesh axis) — GPipe schedule under
+shard_map: forward equals sequential stage application, jax.grad gives
+the reverse-schedule backward, composes with DP on a 2-D mesh, and a
+pipelined model trains. (VERDICT r2 item 9: implement or retract.)"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from singa_tpu import parallel
+from singa_tpu.parallel import pipeline as pp
+from singa_tpu.parallel.mesh import P
+
+
+def _stages(S, d, seed=0):
+    rng = np.random.RandomState(seed)
+    trees = [{"W": jnp.asarray(rng.randn(d, d).astype(np.float32) * 0.3),
+              "b": jnp.asarray(rng.randn(d).astype(np.float32) * 0.1)}
+             for _ in range(S)]
+    return pp.stack_stage_params(trees)
+
+
+def _stage_fn(p, x):
+    return jax.nn.relu(x @ p["W"] + p["b"])
+
+
+def _seq(sp, x, S):
+    y = x
+    for i in range(S):
+        y = jax.nn.relu(y @ sp["W"][i] + sp["b"][i])
+    return y
+
+
+class TestGPipe:
+    S, N_MICRO, MB, D = 4, 8, 4, 16
+
+    def _pipe_fn(self, mesh, in_specs=(P("pipe"), P()), out_specs=P()):
+        return jax.jit(jax.shard_map(
+            pp.gpipe(_stage_fn, self.N_MICRO), mesh=mesh,
+            in_specs=in_specs, out_specs=out_specs, check_vma=False))
+
+    def test_forward_matches_sequential(self):
+        sp = _stages(self.S, self.D)
+        x = np.random.RandomState(1).randn(
+            self.N_MICRO, self.MB, self.D).astype(np.float32)
+        mesh = pp.pipeline_mesh(self.S)
+        out = np.asarray(self._pipe_fn(mesh)(sp, jnp.asarray(x)))
+        ref = np.asarray(_seq(sp, jnp.asarray(x), self.S))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_backward_matches_sequential(self):
+        """grad through scan+ppermute IS the reverse pipeline schedule."""
+        sp = _stages(self.S, self.D, seed=2)
+        x = jnp.asarray(np.random.RandomState(3).randn(
+            self.N_MICRO, self.MB, self.D).astype(np.float32))
+        mesh = pp.pipeline_mesh(self.S)
+        pf = self._pipe_fn(mesh)
+
+        gp = jax.jit(jax.grad(lambda sp: jnp.sum(pf(sp, x) ** 2)))(sp)
+        gs = jax.jit(jax.grad(
+            lambda sp: jnp.sum(_seq(sp, x, self.S) ** 2)))(sp)
+        for k in ("W", "b"):
+            np.testing.assert_allclose(np.asarray(gp[k]), np.asarray(gs[k]),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_collective_permute_in_hlo(self):
+        sp = _stages(self.S, self.D)
+        x = jnp.zeros((self.N_MICRO, self.MB, self.D), jnp.float32)
+        mesh = pp.pipeline_mesh(self.S)
+        hlo = self._pipe_fn(mesh).lower(sp, x).compile().as_text()
+        assert "collective-permute" in hlo
+
+    def test_dp_times_pp_mesh(self):
+        """2-D data x pipe mesh: microbatch dim over 'data', stages over
+        'pipe' — same math as 1-D pipeline on the full batch."""
+        S = 4
+        sp = _stages(S, self.D, seed=4)
+        x = np.random.RandomState(5).randn(
+            self.N_MICRO, 8, self.D).astype(np.float32)
+        mesh = parallel.make_mesh({"data": 2, "pipe": S})
+        # stage axis is dim 0 of each stacked leaf; shard over 'pipe'
+        f = jax.jit(jax.shard_map(
+            pp.gpipe(_stage_fn, self.N_MICRO), mesh=mesh,
+            in_specs=(P("pipe"), P(None, "data")),
+            out_specs=P(None, "data"), check_vma=False))
+        out = np.asarray(f(sp, jnp.asarray(x)))
+        ref = np.asarray(_seq(sp, jnp.asarray(x), S))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_pipelined_training_loss_falls(self):
+        """End-to-end: SGD on pipeline-parallel stages learns a target."""
+        S, d, n_micro, mb = 2, 8, 4, 8
+        mesh = parallel.make_mesh({"pipe": S})
+        sp = _stages(S, d, seed=6)
+        rng = np.random.RandomState(7)
+        x = jnp.asarray(rng.randn(n_micro, mb, d).astype(np.float32))
+        tgt = jnp.asarray(rng.randn(n_micro, mb, d).astype(np.float32) * 0.1)
+
+        pf = jax.shard_map(pp.gpipe(_stage_fn, n_micro), mesh=mesh,
+                           in_specs=(P("pipe"), P()), out_specs=P(),
+                           check_vma=False)
+
+        @jax.jit
+        def step(sp):
+            def loss(sp):
+                return jnp.mean((pf(sp, x) - tgt) ** 2)
+            l, g = jax.value_and_grad(loss)(sp)
+            sp = jax.tree.map(lambda p, gg: p - 0.05 * gg, sp, g)
+            return sp, l
+
+        losses = []
+        for _ in range(20):
+            sp, l = step(sp)
+            losses.append(float(l))
+        assert losses[-1] < losses[0] * 0.7, losses
+
+    def test_stage_count_mismatch_raises(self):
+        """Stacking more stages than the pipe axis size must raise, not
+        silently drop stages (r3 review finding)."""
+        sp = _stages(4, self.D)                 # 4 stages...
+        mesh = pp.pipeline_mesh(2)              # ...on a 2-rank pipe
+        x = jnp.zeros((self.N_MICRO, self.MB, self.D), jnp.float32)
+        f = jax.shard_map(pp.gpipe(_stage_fn, self.N_MICRO), mesh=mesh,
+                          in_specs=(P("pipe"), P()), out_specs=P(),
+                          check_vma=False)
+        with pytest.raises(ValueError, match="stage count"):
+            f(sp, x)

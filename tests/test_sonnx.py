@@ -494,3 +494,60 @@ class TestExport:
         rep = sonnx.prepare(p)
         (y,) = rep.run([x])
         np.testing.assert_allclose(np.asarray(y.data), ref, rtol=1e-4, atol=1e-5)
+
+
+class TestBf16ExportDtypeDiscipline:
+    """Exports traced in bf16 must emit their synthesized constants
+    (SDPA scale / neg_inf) in the traced activation dtype, not f32
+    (VERDICT r2 item 7) — and round-trip through import."""
+
+    def _attn_model_and_input(self, dtype):
+        import ml_dtypes
+        from singa_tpu import layer, model
+
+        class AttnNet(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.attn = layer.MultiHeadAttention(2, 16, causal=True)
+
+            def forward(self, x):
+                return self.attn(x)
+
+        rng = np.random.RandomState(0)
+        x = T(rng.randn(1, 8, 16).astype(dtype))
+        m = AttnNet()
+        m.compile([x], is_train=False, use_graph=False)
+        # cast params to the compute dtype so the whole trace is bf16
+        for t in m.get_params().values():
+            t.data = t.data.astype(dtype)
+        return m, x
+
+    def test_bf16_sdpa_constants_and_roundtrip(self):
+        import ml_dtypes
+        bf16 = ml_dtypes.bfloat16
+        m, x = self._attn_model_and_input(bf16)
+        native = np.asarray(m(x).data, np.float32)
+        proto_model = sonnx.to_onnx(m, [x])
+        # every initializer feeding a Mul/Where in the attention block
+        # must carry the traced dtype
+        inits = {t.name: proto.to_array(t)
+                 for t in proto_model.graph.initializer}
+        scales = [v for n, v in inits.items() if "scale" in n]
+        negs = [v for n, v in inits.items() if "neg_inf" in n]
+        assert scales and negs
+        for v in scales + negs:
+            assert v.dtype == np.dtype(bf16), \
+                f"constant exported as {v.dtype}, trace was bf16"
+        rep = sonnx.prepare(proto_model)
+        (out,) = rep.run([x])
+        got = np.asarray(out.data, np.float32)
+        np.testing.assert_allclose(got, native, rtol=0.05, atol=0.05)
+
+    def test_f32_export_unchanged(self):
+        m, x = self._attn_model_and_input(np.float32)
+        native = np.asarray(m(x).data)
+        proto_model = sonnx.to_onnx(m, [x])
+        rep = sonnx.prepare(proto_model)
+        (out,) = rep.run([x])
+        np.testing.assert_allclose(np.asarray(out.data), native,
+                                   rtol=1e-4, atol=1e-5)
