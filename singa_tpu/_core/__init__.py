@@ -116,6 +116,19 @@ def version() -> str:
 # numpy-level wrappers (tensor_math_cpp dispatch surface)
 # ---------------------------------------------------------------------------
 
+# dispatch instrumentation: counts PUBLIC kernel-wrapper calls (gemm,
+# add, relu, ...) — proof that csrc kernels are exercised
+stats = {"calls": 0}
+
+
+def reset_stats() -> None:
+    stats["calls"] = 0
+
+
+def _count() -> None:
+    stats["calls"] += 1
+
+
 def _c(a):
     return np.ascontiguousarray(a, dtype=np.float32)
 
@@ -123,6 +136,7 @@ def _c(a):
 def gemm(a: np.ndarray, b: np.ndarray, transa=False, transb=False,
          alpha=1.0) -> np.ndarray:
     l = lib()
+    _count()
     a, b = _c(a), _c(b)
     m = a.shape[1] if transa else a.shape[0]
     k = a.shape[0] if transa else a.shape[1]
@@ -135,6 +149,7 @@ def gemm(a: np.ndarray, b: np.ndarray, transa=False, transb=False,
 def _binary(name):
     def fn(a, b):
         l = lib()
+        _count()
         a, b = _c(a), _c(b)
         out = np.empty_like(a)
         getattr(l, name)(a, b, out, a.size)
@@ -151,6 +166,7 @@ div = _binary("sg_div")
 def _unary(name):
     def fn(a):
         l = lib()
+        _count()
         a = _c(a)
         out = np.empty_like(a)
         getattr(l, name)(a, out, a.size)
@@ -166,6 +182,7 @@ exp = _unary("sg_exp")
 
 def relu_grad(a, dy):
     l = lib()
+    _count()
     a, dy = _c(a), _c(dy)
     out = np.empty_like(a)
     l.sg_relu_grad(a, dy, out, a.size)
@@ -174,6 +191,7 @@ def relu_grad(a, dy):
 
 def softmax(a):
     l = lib()
+    _count()
     a = _c(a)
     rows = int(np.prod(a.shape[:-1])) if a.ndim > 1 else 1
     out = np.empty_like(a)
@@ -183,6 +201,7 @@ def softmax(a):
 
 def array_sum(a) -> float:
     l = lib()
+    _count()
     a = _c(a)
     out = np.zeros(1, np.float32)
     l.sg_sum(a.reshape(-1), out, a.size)
@@ -191,6 +210,7 @@ def array_sum(a) -> float:
 
 def conv2d_nhwc(x, w, stride=(1, 1), padding=(0, 0)):
     l = lib()
+    _count()
     x, w = _c(x), _c(w)
     N, H, W_, Cin = x.shape
     KH, KW, _, OC = w.shape
@@ -206,6 +226,7 @@ def conv2d_nhwc(x, w, stride=(1, 1), padding=(0, 0)):
 def sgd_update(param: np.ndarray, grad: np.ndarray,
                mom: Optional[np.ndarray], lr, momentum=0.0, weight_decay=0.0):
     l = lib()
+    _count()
     assert param.dtype == np.float32 and param.flags["C_CONTIGUOUS"]
     mom_p = mom.ctypes.data_as(C.c_void_p) if mom is not None else None
     l.sg_sgd_update(param, _c(grad), mom_p, lr, momentum, weight_decay,

@@ -116,13 +116,14 @@ class Device:
 class CppCPU(Device):
     """Host CPU device — the debug/smoke device (BASELINE.json:7).
 
-    Math runs eagerly; the hot ~20 kernels can dispatch to the native C++
-    library (csrc/tensor_math_cpp.cc) when available, mirroring the
-    reference's tensor_math_cpp dispatch table; everything else runs via
-    XLA:CPU so op coverage is total either way.
+    Math runs eagerly; the hot kernels dispatch to the native C++
+    library (csrc/tensor_math_cpp.cc) BY DEFAULT — mirroring the
+    reference's tensor_math_cpp dispatch table — and degrade to XLA:CPU
+    when the library is unavailable or shapes/dtypes don't qualify, so
+    op coverage is total either way.  use_native=False forces pure XLA.
     """
 
-    def __init__(self, use_native: bool = False):
+    def __init__(self, use_native: bool = True):
         # process-LOCAL devices: under multi-host (init_distributed),
         # jax.devices() is the global list and other hosts' devices are
         # not addressable for eager placement
@@ -191,7 +192,7 @@ class Platform:
 _default_device: Optional[Device] = None
 
 
-def create_cpu_device(use_native: bool = False) -> CppCPU:
+def create_cpu_device(use_native: bool = True) -> CppCPU:
     return CppCPU(use_native=use_native)
 
 

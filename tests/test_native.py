@@ -1,6 +1,8 @@
 """Native runtime tests: tensor_math_cpp kernels vs numpy, scheduler
 topo-sort/memory planning, threaded data loader, staging pool."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -198,3 +200,142 @@ def test_captured_graph_native_schedule():
     assert sched.num_nodes > 5
     assert sched.arena_bytes > 0
     assert len(sched.order) == sched.num_nodes
+
+
+def test_native_default_and_exercised():
+    """use_native defaults on for CppCPU; an eager model step actually
+    hits csrc kernels (counter) and matches the pure-XLA path
+    (VERDICT r2 item 8)."""
+    from singa_tpu import device, models, opt, tensor
+
+    def run(use_native):
+        dev = device.create_cpu_device(use_native=use_native)
+        device.set_default_device(dev)
+        tensor.set_seed(0)
+        np.random.seed(0)
+        m = models.MLP(perceptron_size=16, num_classes=4)
+        m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+        x = tensor.from_numpy(np.random.RandomState(1).randn(8, 10).astype(np.float32))
+        y = tensor.from_numpy(np.random.RandomState(2).randint(0, 4, 8).astype(np.int32))
+        m.compile([x], is_train=True, use_graph=False)   # eager path
+        losses = [float(m.train_step(x, y)[1].to_numpy()) for _ in range(3)]
+        return losses
+
+    assert device.create_cpu_device().use_native is True
+    _core.reset_stats()
+    native_losses = run(True)
+    assert _core.stats["calls"] > 0, "csrc kernels were never dispatched"
+    _core.reset_stats()
+    xla_losses = run(False)
+    assert _core.stats["calls"] == 0
+    np.testing.assert_allclose(native_losses, xla_losses, rtol=1e-4, atol=1e-5)
+
+
+class TestScheduleReplay:
+    """Schedule.replay consumes the native topo order + arena plan
+    (single-threaded deterministic host replay, SURVEY.md §5)."""
+
+    def _jaxpr_graph(self):
+        import jax
+        import jax.numpy as jnp
+        from singa_tpu.graph import CapturedGraph
+
+        def step(w1, b1, w2, x):
+            h = jnp.tanh(x @ w1 + b1)
+            o = jax.nn.sigmoid(h) * h
+            return (o @ w2).sum(), o
+
+        rng = np.random.RandomState(0)
+        args = (rng.randn(16, 32).astype(np.float32),
+                rng.randn(32).astype(np.float32),
+                rng.randn(32, 4).astype(np.float32),
+                rng.randn(8, 16).astype(np.float32))
+        cj = jax.make_jaxpr(step)(*args)
+        return CapturedGraph("t", jaxpr=cj), step, args
+
+    def test_replay_matches_direct(self):
+        g, step, args = self._jaxpr_graph()
+        s = g.schedule()
+        outs = s.replay(*args)
+        for got, ref in zip(outs, step(*args)):
+            np.testing.assert_allclose(got, np.asarray(ref),
+                                       rtol=1e-5, atol=1e-6)
+        assert s.native_hits >= 4, "hot ops should hit csrc kernels"
+
+    def test_replay_without_native_kernels(self):
+        g, step, args = self._jaxpr_graph()
+        s = g.schedule()
+        outs = s.replay(*args, use_native=False)
+        assert s.native_hits == 0
+        for got, ref in zip(outs, step(*args)):
+            np.testing.assert_allclose(got, np.asarray(ref),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_replay_model_train_step_graph(self):
+        """Replay the REAL captured train-step jaxpr of a compiled model
+        and reproduce the jitted loss."""
+        import jax
+        from singa_tpu import autograd, layer, model, opt, tensor
+
+        class M(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.fc = layer.Linear(8)
+
+            def forward(self, x):
+                return self.fc(x)
+
+            def train_one_batch(self, x, y):
+                out = self.forward(x)
+                loss = autograd.mse_loss(out, y)
+                self.optimizer.backward_and_update(loss)
+                return out, loss
+
+        tensor.set_seed(0)
+        m = M()
+        m.set_optimizer(opt.SGD(lr=0.1))
+        x = tensor.from_numpy(np.random.RandomState(3).randn(4, 6).astype(np.float32))
+        y = tensor.from_numpy(np.random.RandomState(4).randn(4, 8).astype(np.float32))
+        m.compile([x], is_train=True, use_graph=True)
+        m.train_step(x, y)                 # create the executor + graph
+        ex = next(iter(m._executors.values()))
+        # numpy snapshots: the jitted step donates its inputs
+        params_np = {n: np.asarray(t.data)
+                     for n, t in ex.param_tensors.items()}
+        slots_np = jax.tree.map(np.asarray, ex.slots)
+        import jax.numpy as jnp
+        step0 = np.zeros((), np.int32)
+        rng = np.asarray(jax.random.fold_in(m._base_key, 1))
+        out_jit, _, _, _ = ex._jitted(
+            jax.tree.map(jnp.array, params_np), {},
+            jax.tree.map(jnp.array, slots_np),
+            jnp.array(step0), jnp.array(rng),
+            jnp.array(x.data), jnp.array(y.data))
+        sched = m.graph.schedule()
+        flat, _ = jax.tree.flatten(
+            (params_np, {}, slots_np, step0, rng,
+             (np.asarray(x.data), np.asarray(y.data))))
+        outs = sched.replay(*flat)
+        # first replay outputs correspond to the step outputs (out, loss)
+        loss_jit = float(np.asarray(out_jit[1]))
+        loss_replay = float(outs[1])
+        np.testing.assert_allclose(loss_replay, loss_jit, rtol=1e-4, atol=1e-5)
+
+
+def test_native_core_under_asan():
+    """Build csrc under ASan+UBSan and run the native test binary
+    (SURVEY.md §5 sanitizer plan; VERDICT r2 item 8)."""
+    import shutil
+    import subprocess
+
+    if shutil.which("g++") is None or shutil.which("make") is None:
+        pytest.skip("native toolchain unavailable")
+    csrc = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "csrc")
+    r = subprocess.run(["make", "-C", csrc, "asan"], capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-1500:]
+    r = subprocess.run([os.path.join(csrc, "test_core_asan")],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-1500:]
+    assert "ALL NATIVE TESTS PASSED" in r.stdout
