@@ -90,6 +90,10 @@ class Operator:
       * override ``forward``/``backward`` for a hand-written rule.
     """
 
+    # comparisons/logical ops set this False: integer/bool outputs take
+    # no gradient and must never enter the tape
+    differentiable = True
+
     def __init__(self):
         self.src: List[Tuple[Tensor, bool]] = []   # (input tensor, needs grad)
         self.requires_grad = False
@@ -138,7 +142,8 @@ class Operator:
             if not isinstance(x, Tensor):
                 raise TypeError(f"{type(self).__name__} got non-Tensor input {type(x)}")
             arrays.append(x.data)
-        self.requires_grad = training and any(x.requires_grad for x in inputs)
+        self.requires_grad = (training and self.differentiable
+                              and any(x.requires_grad for x in inputs))
         out = None
         if self._native_candidate(inputs, arrays):
             out = self.native_fwd(*[np.asarray(a) for a in arrays])
@@ -1387,3 +1392,310 @@ def layernorm(x, gamma, beta, eps=1e-5):
 
 def rmsnorm(x, gamma, eps=1e-6):
     return RMSNorm(eps)(x, gamma)
+
+
+# ---------------------------------------------------------------------------
+# breadth ops toward the reference lineage's ~90-operator surface
+# (SURVEY.md §2.2 row 6; VERDICT r2 item 10).  fwd-only definitions
+# inherit the jax.vjp backward; comparison/logical ops are marked
+# non-differentiable so their integer/bool outputs never enter the tape.
+# ---------------------------------------------------------------------------
+
+class Sin(Operator):
+    def fwd(self, a):
+        return jnp.sin(a)
+
+
+class Cos(Operator):
+    def fwd(self, a):
+        return jnp.cos(a)
+
+
+class Tan(Operator):
+    def fwd(self, a):
+        return jnp.tan(a)
+
+
+class Asin(Operator):
+    def fwd(self, a):
+        return jnp.arcsin(a)
+
+
+class Acos(Operator):
+    def fwd(self, a):
+        return jnp.arccos(a)
+
+
+class Atan(Operator):
+    def fwd(self, a):
+        return jnp.arctan(a)
+
+
+class Sinh(Operator):
+    def fwd(self, a):
+        return jnp.sinh(a)
+
+
+class Cosh(Operator):
+    def fwd(self, a):
+        return jnp.cosh(a)
+
+
+class Asinh(Operator):
+    def fwd(self, a):
+        return jnp.arcsinh(a)
+
+
+class Acosh(Operator):
+    def fwd(self, a):
+        return jnp.arccosh(a)
+
+
+class Atanh(Operator):
+    def fwd(self, a):
+        return jnp.arctanh(a)
+
+
+class Ceil(Operator):
+    def fwd(self, a):
+        return jnp.ceil(a)
+
+
+class Floor(Operator):
+    def fwd(self, a):
+        return jnp.floor(a)
+
+
+class Round(Operator):
+    def fwd(self, a):
+        return jnp.round(a)
+
+
+class Sign(Operator):
+    def fwd(self, a):
+        return jnp.sign(a)
+
+
+class Reciprocal(Operator):
+    def fwd(self, a):
+        return 1.0 / a
+
+
+class Minimum(Operator):
+    def fwd(self, a, b):
+        return jnp.minimum(a, b)
+
+
+class Maximum(Operator):
+    def fwd(self, a, b):
+        return jnp.maximum(a, b)
+
+
+class Mod(Operator):
+    differentiable = False
+
+    def fwd(self, a, b):
+        return jnp.mod(a, b)
+
+
+class Equal(Operator):
+    differentiable = False
+
+    def fwd(self, a, b):
+        return a == b
+
+
+class Greater(Operator):
+    differentiable = False
+
+    def fwd(self, a, b):
+        return a > b
+
+
+class GreaterEqual(Operator):
+    differentiable = False
+
+    def fwd(self, a, b):
+        return a >= b
+
+
+class Less(Operator):
+    differentiable = False
+
+    def fwd(self, a, b):
+        return a < b
+
+
+class LessEqual(Operator):
+    differentiable = False
+
+    def fwd(self, a, b):
+        return a <= b
+
+
+class LogicalAnd(Operator):
+    differentiable = False
+
+    def fwd(self, a, b):
+        return jnp.logical_and(a, b)
+
+
+class LogicalOr(Operator):
+    differentiable = False
+
+    def fwd(self, a, b):
+        return jnp.logical_or(a, b)
+
+
+class LogicalXor(Operator):
+    differentiable = False
+
+    def fwd(self, a, b):
+        return jnp.logical_xor(a, b)
+
+
+class LogicalNot(Operator):
+    differentiable = False
+
+    def fwd(self, a):
+        return jnp.logical_not(a)
+
+
+class PReLU(Operator):
+    """Parametric ReLU: slope is a LEARNED tensor input (second arg)."""
+
+    def fwd(self, a, slope):
+        return jnp.where(a > 0, a, slope * a)
+
+
+class SELU(Operator):
+    def fwd(self, a):
+        return jax.nn.selu(a)
+
+
+class HardSigmoid(Operator):
+    def __init__(self, alpha=0.2, beta=0.5):
+        super().__init__()
+        self.alpha, self.beta = alpha, beta
+
+    def fwd(self, a):
+        return jnp.clip(self.alpha * a + self.beta, 0.0, 1.0)
+
+
+class HardSwish(Operator):
+    def fwd(self, a):
+        return a * jnp.clip(a / 6.0 + 0.5, 0.0, 1.0)
+
+
+class Mish(Operator):
+    def fwd(self, a):
+        return a * jnp.tanh(jax.nn.softplus(a))
+
+
+class Tile(Operator):
+    def __init__(self, reps):
+        super().__init__()
+        self.reps = tuple(reps) if hasattr(reps, "__len__") else (reps,)
+
+    def fwd(self, a):
+        return jnp.tile(a, self.reps)
+
+
+class Expand(Operator):
+    def __init__(self, shape):
+        super().__init__()
+        self.shape = tuple(shape)
+
+    def fwd(self, a):
+        return jnp.broadcast_to(a, self.shape)
+
+
+class OneHot(Operator):
+    differentiable = False
+
+    def __init__(self, depth, axis=-1, dtype=jnp.float32):
+        super().__init__()
+        self.depth, self.axis, self.dtype = depth, axis, dtype
+
+    def fwd(self, ids):
+        return jax.nn.one_hot(ids, self.depth, axis=self.axis,
+                              dtype=self.dtype)
+
+
+class CumSum(Operator):
+    def __init__(self, axis=0):
+        super().__init__()
+        self.axis = axis
+
+    def fwd(self, a):
+        return jnp.cumsum(a, axis=self.axis)
+
+
+class ReduceProd(Operator):
+    def __init__(self, axis=None, keepdims=False):
+        super().__init__()
+        self.axis, self.keepdims = axis, keepdims
+
+    def fwd(self, a):
+        return jnp.prod(a, axis=self.axis, keepdims=self.keepdims)
+
+
+class Shape(Operator):
+    differentiable = False
+
+    def fwd(self, a):
+        # int32: jax truncates int64 (and warns) unless x64 is enabled —
+        # keep the output dtype environment-independent
+        return jnp.asarray(a.shape, jnp.int32)
+
+
+def sin(a): return Sin()(a)
+def cos(a): return Cos()(a)
+def tan(a): return Tan()(a)
+def asin(a): return Asin()(a)
+def acos(a): return Acos()(a)
+def atan(a): return Atan()(a)
+def sinh(a): return Sinh()(a)
+def cosh(a): return Cosh()(a)
+def asinh(a): return Asinh()(a)
+def acosh(a): return Acosh()(a)
+def atanh(a): return Atanh()(a)
+def ceil(a): return Ceil()(a)
+def floor(a): return Floor()(a)
+def round(a): return Round()(a)   # noqa: A001 - reference op name
+def sign(a): return Sign()(a)
+def reciprocal(a): return Reciprocal()(a)
+def minimum(a, b): return Minimum()(a, _as_t(b, a))
+def maximum(a, b): return Maximum()(a, _as_t(b, a))
+def mod(a, b): return Mod()(a, _as_t(b, a))
+def equal(a, b): return Equal()(a, _as_t(b, a))
+def greater(a, b): return Greater()(a, _as_t(b, a))
+def greater_equal(a, b): return GreaterEqual()(a, _as_t(b, a))
+def less(a, b): return Less()(a, _as_t(b, a))
+def less_equal(a, b): return LessEqual()(a, _as_t(b, a))
+def logical_and(a, b): return LogicalAnd()(a, _as_t(b, a))
+def logical_or(a, b): return LogicalOr()(a, _as_t(b, a))
+def logical_xor(a, b): return LogicalXor()(a, _as_t(b, a))
+def logical_not(a): return LogicalNot()(a)
+def prelu(a, slope): return PReLU()(a, slope)
+def selu(a): return SELU()(a)
+def hardsigmoid(a, alpha=0.2, beta=0.5): return HardSigmoid(alpha, beta)(a)
+def hardswish(a): return HardSwish()(a)
+def mish(a): return Mish()(a)
+def tile(a, reps): return Tile(reps)(a)
+def expand(a, shape): return Expand(shape)(a)
+def onehot(ids, depth, axis=-1): return OneHot(depth, axis)(ids)
+def cumsum(a, axis=0): return CumSum(axis)(a)
+def reduce_prod(a, axis=None, keepdims=False):
+    return ReduceProd(axis, keepdims)(a)
+def shape_of(a): return Shape()(a)
+
+
+__all__ += [
+    "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "asinh",
+    "acosh", "atanh", "ceil", "floor", "round", "sign", "reciprocal",
+    "minimum", "maximum", "mod", "equal", "greater", "greater_equal",
+    "less", "less_equal", "logical_and", "logical_or", "logical_xor",
+    "logical_not", "prelu", "selu", "hardsigmoid", "hardswish", "mish",
+    "tile", "expand", "onehot", "cumsum", "reduce_prod", "shape_of",
+]

@@ -221,3 +221,170 @@ def test_softmax_cross_entropy_ignores_out_of_range_labels(cpu_dev):
     np.testing.assert_allclose(g[1], 0.0, atol=1e-7)
     np.testing.assert_allclose(g[3], 0.0, atol=1e-7)
     assert np.abs(g[0]).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# breadth ops (VERDICT r2 item 10: toward the lineage's ~90 operators)
+# ---------------------------------------------------------------------------
+
+BREADTH_UNARY = [
+    ("sin", autograd.sin, np.sin, (-2.0, 2.0)),
+    ("cos", autograd.cos, np.cos, (-2.0, 2.0)),
+    ("tan", autograd.tan, np.tan, (-1.0, 1.0)),
+    ("asin", autograd.asin, np.arcsin, (-0.9, 0.9)),
+    ("acos", autograd.acos, np.arccos, (-0.9, 0.9)),
+    ("atan", autograd.atan, np.arctan, (-2.0, 2.0)),
+    ("sinh", autograd.sinh, np.sinh, (-2.0, 2.0)),
+    ("cosh", autograd.cosh, np.cosh, (-2.0, 2.0)),
+    ("asinh", autograd.asinh, np.arcsinh, (-2.0, 2.0)),
+    ("acosh", autograd.acosh, np.arccosh, (1.1, 3.0)),
+    ("atanh", autograd.atanh, np.arctanh, (-0.9, 0.9)),
+    ("reciprocal", autograd.reciprocal, lambda x: 1.0 / x, (0.5, 2.0)),
+    ("selu", autograd.selu, None, (-2.0, 2.0)),
+    ("hardswish", autograd.hardswish, None, (-2.5, 2.5)),
+    ("mish", autograd.mish, None, (-2.0, 2.0)),
+]
+
+
+@pytest.mark.parametrize("name,op,ref,rng", BREADTH_UNARY,
+                         ids=[c[0] for c in BREADTH_UNARY])
+def test_breadth_unary_fwd_bwd(name, op, ref, rng):
+    x = np.random.RandomState(7).uniform(rng[0], rng[1],
+                                         (3, 4)).astype(np.float32)
+    if name in ("selu", "hardswish"):
+        # derivative kinks at 0 / the clip edges break central differences:
+        # keep samples a margin away, preserving sign
+        x = np.sign(x) * np.clip(np.abs(x), 0.3, None)
+    got = op(tensor.Tensor(data=x)).to_numpy()
+    if ref is not None:
+        np.testing.assert_allclose(got, ref(x.astype(np.float64)),
+                                   rtol=1e-4, atol=1e-5)
+    g = analytic_grad(op, x)
+    gf = fd_grad(lambda xx: float(np.sum(
+        op(tensor.Tensor(data=xx.astype(np.float32))).to_numpy())), x)
+    np.testing.assert_allclose(g, gf, rtol=2e-2, atol=2e-2,
+                               err_msg=f"{name} backward")
+
+
+def test_rounding_and_sign_zero_grad():
+    x = np.random.RandomState(8).uniform(-2, 2, (3, 4)).astype(np.float32)
+    x += 0.25  # stay away from integer/zero kinks for fd sanity
+    for name, op, ref in [("ceil", autograd.ceil, np.ceil),
+                          ("floor", autograd.floor, np.floor),
+                          ("round", autograd.round, np.round),
+                          ("sign", autograd.sign, np.sign)]:
+        got = op(tensor.Tensor(data=x)).to_numpy()
+        np.testing.assert_allclose(got, ref(x), err_msg=name)
+        g = analytic_grad(op, x)
+        np.testing.assert_allclose(g, np.zeros_like(x), err_msg=name)
+
+
+def test_minimum_maximum_fwd_bwd():
+    rng = np.random.RandomState(9)
+    a = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(3, 4).astype(np.float32)
+    ta, tb = tensor.Tensor(data=a), tensor.Tensor(data=b)
+    np.testing.assert_allclose(autograd.minimum(ta, tb).to_numpy(),
+                               np.minimum(a, b))
+    np.testing.assert_allclose(autograd.maximum(ta, tb).to_numpy(),
+                               np.maximum(a, b))
+    # grads route to whichever input won the comparison
+    autograd.set_training(True)
+    ta = tensor.Tensor(data=a, requires_grad=True, stores_grad=True)
+    tb = tensor.Tensor(data=b, requires_grad=True, stores_grad=True)
+    loss = autograd.reduce_sum(autograd.maximum(ta, tb))
+    grads = dict((id(p), g.to_numpy()) for p, g in autograd.backward(loss))
+    autograd.set_training(False)
+    np.testing.assert_allclose(grads[id(ta)], (a >= b).astype(np.float32))
+    np.testing.assert_allclose(grads[id(tb)], (a < b).astype(np.float32))
+
+
+def test_comparisons_and_logical_non_diff():
+    rng = np.random.RandomState(10)
+    a = rng.randn(4).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    ta = tensor.Tensor(data=a, requires_grad=True, stores_grad=True)
+    tb = tensor.Tensor(data=b)
+    autograd.set_training(True)
+    try:
+        for op, ref in [(autograd.equal, a == b),
+                        (autograd.greater, a > b),
+                        (autograd.greater_equal, a >= b),
+                        (autograd.less, a < b),
+                        (autograd.less_equal, a <= b)]:
+            out = op(ta, tb)
+            np.testing.assert_array_equal(out.to_numpy(), ref)
+            assert not out.requires_grad, "comparison entered the tape"
+        m = autograd.greater(ta, tb)
+        n = autograd.less(ta, tb)
+        np.testing.assert_array_equal(
+            autograd.logical_and(m, n).to_numpy(), np.zeros(4, bool))
+        np.testing.assert_array_equal(
+            autograd.logical_or(m, n).to_numpy(),
+            (a > b) | (a < b))
+        np.testing.assert_array_equal(
+            autograd.logical_not(m).to_numpy(), ~(a > b))
+        np.testing.assert_array_equal(
+            autograd.logical_xor(m, n).to_numpy(), (a > b) ^ (a < b))
+    finally:
+        autograd.set_training(False)
+
+
+def test_prelu_learns_slope():
+    rng = np.random.RandomState(11)
+    x = rng.randn(4, 3).astype(np.float32)
+    s = np.full((3,), 0.1, np.float32)
+    autograd.set_training(True)
+    try:
+        tx = tensor.Tensor(data=x, requires_grad=True, stores_grad=True)
+        ts = tensor.Tensor(data=s, requires_grad=True, stores_grad=True)
+        out = autograd.prelu(tx, ts)
+        np.testing.assert_allclose(out.to_numpy(),
+                                   np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+        grads = dict((id(p), g.to_numpy())
+                     for p, g in autograd.backward(
+                         autograd.reduce_sum(out)))
+        # d/ds sum = sum over rows of x where x<=0
+        expect = (np.where(x > 0, 0, x)).sum(axis=0)
+        np.testing.assert_allclose(grads[id(ts)], expect, rtol=1e-5)
+    finally:
+        autograd.set_training(False)
+
+
+def test_shape_misc_ops():
+    rng = np.random.RandomState(12)
+    a = rng.randn(2, 3).astype(np.float32)
+    t = tensor.Tensor(data=a)
+    np.testing.assert_allclose(autograd.tile(t, (2, 1)).to_numpy(),
+                               np.tile(a, (2, 1)))
+    np.testing.assert_allclose(autograd.expand(t, (4, 2, 3)).to_numpy(),
+                               np.broadcast_to(a, (4, 2, 3)))
+    ids = tensor.Tensor(data=np.asarray([0, 2, 1], np.int32))
+    np.testing.assert_allclose(autograd.onehot(ids, 4).to_numpy(),
+                               np.eye(4, dtype=np.float32)[[0, 2, 1]])
+    np.testing.assert_allclose(autograd.cumsum(t, axis=1).to_numpy(),
+                               np.cumsum(a, axis=1), rtol=1e-6)
+    np.testing.assert_allclose(autograd.reduce_prod(t, axis=0).to_numpy(),
+                               np.prod(a, axis=0), rtol=1e-5)
+    np.testing.assert_array_equal(autograd.shape_of(t).to_numpy(), [2, 3])
+    np.testing.assert_allclose(
+        autograd.mod(t, tensor.Tensor(data=np.full((2, 3), 0.7, np.float32))
+                     ).to_numpy(),
+        np.mod(a, 0.7), rtol=1e-4, atol=1e-5)
+    # grads flow through the differentiable shape ops
+    for name, op in [("tile", lambda tt: autograd.tile(tt, (2, 1))),
+                     ("expand", lambda tt: autograd.expand(tt, (4, 2, 3))),
+                     ("cumsum", lambda tt: autograd.cumsum(tt, 1))]:
+        g = analytic_grad(op, a)
+        gf = fd_grad(lambda xx: float(np.sum(
+            op(tensor.Tensor(data=xx.astype(np.float32))).to_numpy())), a)
+        np.testing.assert_allclose(g, gf, rtol=2e-2, atol=2e-2, err_msg=name)
+
+
+def test_operator_class_count_reaches_lineage_parity():
+    """SURVEY §2.2 row 6: the lineage carries ~90 Operator classes."""
+    n = len([name for name in dir(autograd)
+             if isinstance(getattr(autograd, name), type)
+             and issubclass(getattr(autograd, name), autograd.Operator)
+             and getattr(autograd, name) is not autograd.Operator])
+    assert n >= 90, f"only {n} Operator classes"
