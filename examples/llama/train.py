@@ -42,6 +42,12 @@ def main():
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--force-host-devices", type=int, default=0,
                    help="virtual CPU devices for meshes without hardware")
+    p.add_argument("--plan", action="store_true",
+                   help="shape-only capacity plan (no weights allocated): "
+                        "per-device param/moment/grad bytes + HBM fit")
+    p.add_argument("--generate", type=int, default=0, metavar="N",
+                   help="after training, greedy-generate N tokens with "
+                        "the KV cache")
     args = p.parse_args()
 
     if args.force_host_devices:
@@ -68,6 +74,24 @@ def main():
     parallel.set_mesh(mesh)
     print(f"mesh axes: {axes}  devices: {mesh.devices.size}")
 
+    if args.plan:
+        import jax
+        import jax.numpy as jnp
+        plan = parallel.plan_train_step(
+            models.Llama(cfg), opt.AdamW(lr=args.lr),
+            (jax.ShapeDtypeStruct((args.batch, args.seq), jnp.int32),),
+            mesh=mesh)
+        gib = 2.0 ** 30
+        print(f"params (global):     {plan.param_bytes_global / gib:8.2f} GiB")
+        print(f"params / device:     {plan.param_bytes_per_device / gib:8.2f} GiB")
+        print(f"moments / device:    {plan.slot_bytes_per_device / gib:8.2f} GiB")
+        print(f"grads / device:      {plan.grad_bytes_per_device / gib:8.2f} GiB")
+        print(f"state / device:      {plan.per_device_state_bytes / gib:8.2f} GiB")
+        for chip in ("v4", "v5e", "v5p"):
+            print(f"fits {chip:4s} (75% HBM): {plan.fits(chip)}")
+        parallel.set_mesh(None)
+        return
+
     tensor.set_seed(0)
     m = models.Llama(cfg)
     m.set_optimizer(opt.DistOpt(opt.AdamW(lr=args.lr)))
@@ -87,6 +111,18 @@ def main():
         tok_s = args.batch * args.seq / dt
         print(f"step {step}: loss {lv:.4f}  {tok_s:,.0f} tok/s  "
               f"{flops_step / dt / 1e12:.2f} TFLOP/s")
+
+    if args.generate:
+        # KV-cache decoding: compiled prefill + one compiled decode step
+        parallel.set_mesh(None)
+        prompt = ids_np[:1, : min(8, args.seq)]
+        m.generate(prompt, args.generate)     # warm: compile prefill+decode
+        t0 = time.perf_counter()
+        out = m.generate(prompt, args.generate)
+        dt = time.perf_counter() - t0
+        print(f"generated {args.generate} tokens "
+              f"({args.generate / dt:.1f} tok/s, cached decode): "
+              f"{out[0, prompt.shape[1]:].tolist()}")
 
     parallel.set_mesh(None)
 

@@ -10,7 +10,7 @@ from typing import Dict, Optional
 import numpy as np
 
 __all__ = ["Accuracy", "MeanMeter", "Throughput", "MetricsLogger",
-           "accuracy", "peak_flops", "mfu"]
+           "accuracy", "peak_flops", "peak_hbm_bw", "mfu"]
 
 
 def accuracy(logits: np.ndarray, labels: np.ndarray) -> float:
@@ -92,21 +92,50 @@ _PEAK_FLOPS = (
 )
 
 
-def peak_flops(device_kind: Optional[str] = None) -> float:
+def _peak_lookup(table, env_var: str, scale: float, default: float,
+                 device_kind: Optional[str]) -> float:
     import os
 
     import jax
-    override = os.environ.get("SINGA_PEAK_TFLOPS")
+    override = os.environ.get(env_var)
     if override:
-        return float(override) * 1e12
-    kind = (device_kind or getattr(jax.devices()[0], "device_kind", "cpu")).lower()
-    for k, v in _PEAK_FLOPS:
+        return float(override) * scale
+    kind = (device_kind
+            or getattr(jax.devices()[0], "device_kind", "cpu")).lower()
+    for k, v in table:
         if k in kind:
             return v
+    return default
+
+
+def peak_flops(device_kind: Optional[str] = None) -> float:
     # Unknown accelerator kind (e.g. an experimental PJRT plugin that
     # doesn't embed the vN generation): assume v4-class peak rather than
     # the CPU nominal, which would inflate MFU ~275x.
-    return 275e12
+    return _peak_lookup(_PEAK_FLOPS, "SINGA_PEAK_TFLOPS", 1e12, 275e12,
+                        device_kind)
+
+
+# peak HBM bandwidth per chip (bytes/s) — the roofline's memory bound
+_PEAK_BW = (
+    ("7x", 819e9),         # tunneled chip reports "TPU7x"; v5e-class
+    ("v5 lite", 819e9),    # v5e
+    ("v5e", 819e9),
+    ("v5p", 2765e9),
+    ("v6 lite", 1640e9),   # Trillium / v6e
+    ("v6e", 1640e9),
+    ("v6", 1640e9),
+    ("v5", 2765e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+    ("cpu", 50e9),
+)
+
+
+def peak_hbm_bw(device_kind: Optional[str] = None) -> float:
+    return _peak_lookup(_PEAK_BW, "SINGA_PEAK_HBM_GBS", 1e9, 1228e9,
+                        device_kind)
 
 
 def mfu(model_flops_per_step: float, step_time_s: float,

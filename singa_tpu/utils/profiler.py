@@ -91,16 +91,19 @@ def device_trace(logdir: str):
 
 
 def profile_model(model, batch, steps: int = 10, warmup: int = 2,
-                  device_kind: Optional[str] = None) -> Dict:
-    """Run `steps` compiled train steps and return the cost/latency
-    summary (model must be compiled with use_graph=True)."""
+                  device_kind: Optional[str] = None,
+                  train: bool = True) -> Dict:
+    """Run `steps` compiled steps (train_step, or the eval forward with
+    train=False) and return the cost/latency summary (model must be
+    compiled with use_graph=True)."""
     import jax
 
+    run = model.train_step if train else (lambda *b: model.eval()(b[0]))
     prof = StepProfiler(warmup=warmup)
     out = None
-    for _ in range(warmup + steps):
+    for _ in range(warmup + max(1, steps)):
         with prof.step():
-            out = model.train_step(*batch)
+            out = run(*batch)
             jax.block_until_ready(out[-1].data if isinstance(out, tuple)
                                   else out.data)
     s = prof.summary(model, device_kind)
