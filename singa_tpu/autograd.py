@@ -227,6 +227,16 @@ def backward(y: Tensor, dy: Optional[Any] = None):
         g_out = _collect_op_output_grad(op, grads)
         if g_out is None:
             continue
+        # incoming cotangents must match the op's output dtype: mixed-
+        # precision boundaries (e.g. BatchNorm's f32 statistics feeding a
+        # bf16 conv) otherwise hand jax.vjp an f32 dy for a bf16 output
+        dts = getattr(op, "_out_dtypes", None)
+        if dts is not None:
+            if isinstance(g_out, tuple):
+                g_out = tuple(g if g is None or g.dtype == d else g.astype(d)
+                              for g, d in zip(g_out, dts))
+            elif g_out.dtype != dts[0]:
+                g_out = g_out.astype(dts[0])
         gs = op.backward(g_out)
         if not isinstance(gs, (tuple, list)):
             gs = (gs,)
@@ -1282,12 +1292,14 @@ class Conv2d(Operator):
         return y
 
     def fwd(self, x, w, *b):
+        # no preferred_element_type: the MXU already accumulates bf16
+        # convs in f32 internally, and requesting an f32 output makes the
+        # vjp transpose mix bf16 primals with f32 cotangents (TypeError)
         y = jax.lax.conv_general_dilated(
             x, w, window_strides=self.stride, padding=self.padding,
             rhs_dilation=self.dilation,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=self.groups,
-            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
         )
         if b:
             y = y + b[0]

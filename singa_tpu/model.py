@@ -154,8 +154,13 @@ class Model(Layer):
     @property
     def graph(self) -> Optional[CapturedGraph]:
         """Most recently captured step graph."""
+        return self.get_graph()
+
+    def get_graph(self, tag: Optional[str] = None) -> Optional[CapturedGraph]:
+        """Captured graph, optionally filtered by step kind
+        ('train' | 'eval') — a model that ran both has one of each."""
         for ex in self._executors.values():
-            if ex.captured is not None:
+            if ex.captured is not None and (tag is None or ex.tag == tag):
                 return ex.captured
         return None
 

@@ -2,17 +2,39 @@
 
 from __future__ import annotations
 
+import numpy as np
+
 from .. import autograd, model
+from ..tensor import Tensor
 
 __all__ = ["Classifier"]
 
 
+def _cast_to_compute(x: Tensor) -> Tensor:
+    """Cast float inputs to the device compute dtype (bf16 on TPU) so
+    convs/matmuls ride the MXU at full rate — same boundary-cast design
+    as layer.Embedding; params then follow the activation dtype via
+    layer._maybe_cast, and BatchNorm keeps f32 statistics internally."""
+    dt = getattr(x.device, "default_dtype", None)
+    if (dt is not None and np.dtype(dt) != np.dtype(np.float32)
+            and np.issubdtype(np.dtype(x.dtype), np.floating)
+            and np.dtype(x.dtype) != np.dtype(dt)):
+        return autograd.cast(x, dt)
+    return x
+
+
 class Classifier(model.Model):
     """Canonical classification step (reference examples/cnn model.py):
-    forward → softmax-cross-entropy → opt(loss)."""
+    forward → softmax-cross-entropy → opt(loss); float inputs enter at
+    the device compute dtype, logits/loss computed in f32."""
+
+    def __call__(self, *xs):
+        xs = tuple(_cast_to_compute(x) if isinstance(x, Tensor) else x
+                   for x in xs)
+        return super().__call__(*xs)
 
     def train_one_batch(self, x, y):
-        out = self.forward(x)
+        out = self.forward(_cast_to_compute(x))
         loss = autograd.softmax_cross_entropy(out, y)
         self.optimizer(loss)
         return out, loss

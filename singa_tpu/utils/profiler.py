@@ -106,8 +106,16 @@ def profile_model(model, batch, steps: int = 10, warmup: int = 2,
             out = run(*batch)
             jax.block_until_ready(out[-1].data if isinstance(out, tuple)
                                   else out.data)
-    s = prof.summary(model, device_kind)
-    g = model.graph
+    # cost analysis must come from the graph of the mode we timed — a
+    # model that ran train_step earlier also holds the (3x larger) train
+    # graph, which would inflate eval MFU
+    g = model.get_graph("train" if train else "eval")
+    s = prof.summary(None, device_kind)
+    if g is not None and prof.mean_s > 0 and g.flops():
+        achieved = g.flops() / prof.mean_s
+        s["compiled_gflops_per_step"] = round(g.flops() / 1e9, 6)
+        s["achieved_tflops"] = round(achieved / 1e12, 6)
+        s["mfu"] = round(achieved / peak_flops(device_kind), 8)
     if g is not None:
         ca = g.cost_analysis()
         if "bytes accessed" in ca and s.get("step_time_ms"):

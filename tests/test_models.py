@@ -295,3 +295,50 @@ class TestKVCacheGeneration:
         eos = int(ref[0, 4])                 # force eos on the 1st new token
         out = m.generate(prompt, max_new_tokens=6, eos_id=eos)
         assert out.shape == (2, 10), "eos must not change the static shape"
+
+
+class TestBf16ComputePath:
+    """Simulate the TPU compute dtype (device default bf16) on CPU:
+    float inputs enter at bf16, convs/matmuls run bf16 (MXU path),
+    masters stay f32, and the tape's mixed-precision boundaries
+    backward cleanly (regression: BN f32 stats feeding a bf16 conv)."""
+
+    def _bf16_dev(self):
+        import jax.numpy as jnp
+        from singa_tpu import device
+        dev = device.create_cpu_device(use_native=False)
+        dev.default_dtype = jnp.bfloat16
+        device.set_default_device(dev)
+        return dev
+
+    def test_resnet_trains_bf16(self):
+        dev = self._bf16_dev()
+        tensor.set_seed(0)
+        np.random.seed(0)
+        m = models.resnet18(num_classes=10, cifar_stem=True)
+        m.set_optimizer(opt.SGD(lr=0.01, momentum=0.9))
+        x = tensor.Tensor(data=np.random.randn(4, 3, 32, 32).astype(np.float32),
+                          device=dev)
+        y = tensor.Tensor(data=np.random.randint(0, 10, 4).astype(np.int32),
+                          device=dev)
+        m.compile([x], is_train=True, use_graph=True)
+        losses = [float(m.train_step(x, y)[1].to_numpy()) for _ in range(3)]
+        assert all(np.isfinite(losses))
+        hlo = m.graph.compiled_hlo()
+        assert hlo.count("bf16") > 100, "convs did not lower to bf16"
+        for t in m.get_params().values():
+            assert np.dtype(t.dtype) == np.float32, "master weights must stay f32"
+
+    def test_llama_trains_bf16(self):
+        dev = self._bf16_dev()
+        tensor.set_seed(0)
+        np.random.seed(0)
+        m = models.Llama(models.LlamaConfig.tiny())
+        m.set_optimizer(opt.SGD(lr=0.01))
+        ids = tensor.Tensor(
+            data=np.random.randint(0, 256, (2, 16)).astype(np.int32),
+            device=dev)
+        m.compile([ids], is_train=True, use_graph=True)
+        _, loss = m.train_step(ids, ids)
+        assert np.isfinite(float(loss.to_numpy()))
+        assert m.graph.compiled_hlo().count("bf16") > 50
