@@ -399,10 +399,13 @@ def _run_sub(platform: str, timeout_s: float) -> bool:
     return emitted[0]
 
 
-def _tpu_usable(timeout_s: float) -> bool:
+def _tpu_usable(timeout_s: float) -> str:
     """Probe in a subprocess: can the TPU backend init AND run a tiny
     jitted matmul within the timeout?  Protects against both failure
-    modes seen under axon: a fast RuntimeError and an indefinite hang."""
+    modes seen under axon: a fast RuntimeError and an indefinite hang.
+
+    Returns 'ok', 'hang' (worth retrying — wedged tunnels recover), or
+    'fail' (deterministic: no TPU on this host)."""
     import subprocess
 
     code = ("import jax, jax.numpy as jnp;"
@@ -415,31 +418,47 @@ def _tpu_usable(timeout_s: float) -> bool:
         r = subprocess.run([sys.executable, "-c", code],
                            capture_output=True, text=True, timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        print(f"# TPU probe hung >{timeout_s:.0f}s; using CPU",
-              file=sys.stderr)
-        return False
-    ok = r.returncode == 0 and "TPU_PROBE_OK" in (r.stdout or "")
-    if not ok:
-        tail = ((r.stderr or "").strip().splitlines() or [""])[-1]
-        print(f"# TPU probe failed (rc={r.returncode}): {tail[:200]}",
-              file=sys.stderr)
-    return ok
+        print(f"# TPU probe hung >{timeout_s:.0f}s", file=sys.stderr)
+        return "hang"
+    if r.returncode == 0 and "TPU_PROBE_OK" in (r.stdout or ""):
+        return "ok"
+    tail = ((r.stderr or "").strip().splitlines() or [""])[-1]
+    print(f"# TPU probe failed (rc={r.returncode}): {tail[:200]}",
+          file=sys.stderr)
+    return "fail"
 
 
 def main() -> None:
     # Budgets: the recorded driver invocation ("python bench.py", no
     # wrapper timeout in BENCH_r01.json) sets no hard deadline, so
-    # these bound our own worst case (~10.5 min: hung probe 90s +
-    # wedged-after-probe TPU suite 360s + CPU suite 180s).  In the
-    # common failure mode (TPU wedged at init) the probe catches it and
-    # the CPU headline streams at ~2min; a healthy TPU streams its
-    # headline right after the llama bench.
+    # these bound our own worst case (~16 min: 3 hung probes 3x90s +
+    # 2x45s backoff + wedged-after-probe TPU suite 420s + CPU suite
+    # 180s).  A deterministic no-TPU host skips the retries and streams
+    # the CPU headline at ~2min; a healthy TPU streams its headline
+    # right after the llama bench.
     probe_timeout = float(os.environ.get("SINGA_BENCH_PROBE_TIMEOUT_S", "90"))
-    tpu_timeout = float(os.environ.get("SINGA_BENCH_TPU_TIMEOUT_S", "360"))
+    tpu_timeout = float(os.environ.get("SINGA_BENCH_TPU_TIMEOUT_S", "420"))
     cpu_timeout = float(os.environ.get("SINGA_BENCH_CPU_TIMEOUT_S", "180"))
+    probe_tries = int(os.environ.get("SINGA_BENCH_PROBE_TRIES", "3"))
+
+    # the axon tunnel has been observed to wedge for hours and then
+    # recover: retry HUNG probes with a short backoff before giving up
+    # on the chip for the round; deterministic failures (no TPU on this
+    # host) fall through to CPU immediately
+    usable = False
+    for attempt in range(probe_tries):
+        status = _tpu_usable(probe_timeout)
+        if status == "ok":
+            usable = True
+            break
+        if status == "fail" or attempt + 1 >= probe_tries:
+            break
+        print(f"# TPU probe attempt {attempt + 1}/{probe_tries} hung; "
+              f"retrying in 45s", file=sys.stderr)
+        time.sleep(45)
 
     emitted = False
-    if _tpu_usable(probe_timeout):
+    if usable:
         emitted = _run_sub("tpu", tpu_timeout)
     if not emitted:
         print("# no TPU headline; running the suite on CPU",

@@ -108,19 +108,19 @@ def profile_model(model, batch, steps: int = 10, warmup: int = 2,
                                   else out.data)
     # cost analysis must come from the graph of the mode we timed — a
     # model that ran train_step earlier also holds the (3x larger) train
-    # graph, which would inflate eval MFU
+    # graph, which would inflate eval MFU.  Run the XLA analysis once.
     g = model.get_graph("train" if train else "eval")
     s = prof.summary(None, device_kind)
-    if g is not None and prof.mean_s > 0 and g.flops():
-        achieved = g.flops() / prof.mean_s
-        s["compiled_gflops_per_step"] = round(g.flops() / 1e9, 6)
+    ca = g.cost_analysis() if g is not None else {}
+    flops = float(ca.get("flops", 0.0))
+    if flops and prof.mean_s > 0:
+        achieved = flops / prof.mean_s
+        s["compiled_gflops_per_step"] = round(flops / 1e9, 6)
         s["achieved_tflops"] = round(achieved / 1e12, 6)
         s["mfu"] = round(achieved / peak_flops(device_kind), 8)
-    if g is not None:
-        ca = g.cost_analysis()
-        if "bytes accessed" in ca and s.get("step_time_ms"):
-            ba = float(ca["bytes accessed"])
-            s["bytes_accessed_per_step"] = int(ba)
-            if ca.get("flops"):
-                s["arithmetic_intensity"] = round(float(ca["flops"]) / ba, 2)
+    if "bytes accessed" in ca and s.get("step_time_ms"):
+        ba = float(ca["bytes accessed"])
+        s["bytes_accessed_per_step"] = int(ba)
+        if flops:
+            s["arithmetic_intensity"] = round(flops / ba, 2)
     return s
