@@ -7,8 +7,14 @@ host-global batch; the executor shards it over the global 'data' mesh,
 DistOpt pmeans gradients in-graph, and the final (replicated) params +
 per-step losses are dumped to an .npz for the parent to compare.
 
-argv: rank world port outdir steps
-"""
+argv: rank world port outdir steps [mode]
+
+mode 'plain' (default): train `steps` steps straight through.
+mode 'resume': train steps/2, checkpoint (CheckpointManager — process-0
+write + barrier), rebuild a FRESH model+optimizer, restore, and train
+the remaining steps — the multi-process resume-correctness check
+(VERDICT r2 item 3: restored trajectory must equal uninterrupted,
+including optimizer moments)."""
 
 import os
 import sys
@@ -31,20 +37,25 @@ import numpy as np  # noqa: E402
 from singa_tpu import models, opt, parallel, tensor  # noqa: E402
 
 
+def _make_model():
+    tensor.set_seed(0)
+    np.random.seed(0)
+    m = models.MLP(perceptron_size=(32,), num_classes=4)
+    m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.1, momentum=0.9)))
+    return m
+
+
 def main() -> None:
     rank, world = int(sys.argv[1]), int(sys.argv[2])
     port, outdir, steps = sys.argv[3], sys.argv[4], int(sys.argv[5])
+    mode = sys.argv[6] if len(sys.argv) > 6 else "plain"
 
     idx = parallel.init_distributed(f"127.0.0.1:{port}", world, rank)
     assert idx == rank and jax.process_count() == world
     mesh = parallel.global_mesh({"data": world})
     parallel.set_mesh(mesh)
 
-    tensor.set_seed(0)
-    np.random.seed(0)
-    m = models.MLP(perceptron_size=(32,), num_classes=4)
-    m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.1, momentum=0.9)))
-
+    m = _make_model()
     rng = np.random.RandomState(123)
     X = rng.randn(8, 16).astype(np.float32)
     Y = rng.randint(0, 4, (8,)).astype(np.int32)
@@ -52,10 +63,29 @@ def main() -> None:
     m.compile([xt], is_train=True, use_graph=True)
 
     losses = []
-    for _ in range(steps):
-        _, loss = m.train_step(xt, yt)
-        val = float(loss.to_numpy())
-        losses.append(val)
+
+    def train(n, model):
+        for _ in range(n):
+            _, loss = model.train_step(xt, yt)
+            losses.append(float(loss.to_numpy()))
+        return model
+
+    if mode == "resume":
+        from singa_tpu.utils.checkpoint import CheckpointManager
+        half = steps // 2
+        train(half, m)
+        ck = CheckpointManager(os.path.join(outdir, "ckpt"), keep=2)
+        ck.save(half - 1, m, force=True)   # proc-0 write + barrier
+        # fresh model + optimizer: moments must come from the checkpoint
+        m = _make_model()
+        m.compile([xt], is_train=True, use_graph=True)
+        start = ck.restore_latest(m)
+        assert start == half, start
+        train(steps - half, m)
+    elif mode == "plain":
+        train(steps, m)
+    else:
+        raise SystemExit(f"unknown worker mode {mode!r}")
     parallel.distributed.assert_same_across_processes(losses[-1])
 
     params = {n: np.asarray(t.data) for n, t in m.get_params().items()}

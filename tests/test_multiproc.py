@@ -23,13 +23,14 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch_world(world: int, tmpdir: str, steps: int = _STEPS):
+def _launch_world(world: int, tmpdir: str, steps: int = _STEPS,
+                  mode: str = "plain"):
     port = _free_port()
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     procs = [subprocess.Popen(
         [sys.executable, _WORKER, str(r), str(world), str(port),
-         tmpdir, str(steps)],
+         tmpdir, str(steps), mode],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         for r in range(world)]
     outs = []
@@ -69,24 +70,28 @@ def _single_process_reference(steps: int = _STEPS):
     return losses, params
 
 
+def _assert_matches_reference(results, ref_losses, ref_params, what=""):
+    for r, res in enumerate(results):
+        np.testing.assert_allclose(
+            res["losses"], ref_losses, rtol=1e-5, atol=1e-6,
+            err_msg=f"rank {r} loss trajectory diverged {what}")
+        for name, ref in ref_params.items():
+            np.testing.assert_allclose(
+                res[name], ref, rtol=1e-4, atol=1e-5,
+                err_msg=f"rank {r} param {name} diverged {what}")
+    # ranks bitwise-identical to each other (same compiled module,
+    # same collectives)
+    for name in ref_params:
+        np.testing.assert_array_equal(results[0][name], results[1][name])
+
+
 def test_two_process_dp_equals_big_batch(tmp_path):
     """Grad-allreduce across 2 real processes reproduces the big-batch
     single-process trajectory (loss per step and final params)."""
     results = _launch_world(2, str(tmp_path))
     ref_losses, ref_params = _single_process_reference()
-
-    for r, res in enumerate(results):
-        np.testing.assert_allclose(
-            res["losses"], ref_losses, rtol=1e-5, atol=1e-6,
-            err_msg=f"rank {r} loss trajectory diverged from big-batch")
-        for name, ref in ref_params.items():
-            np.testing.assert_allclose(
-                res[name], ref, rtol=1e-4, atol=1e-5,
-                err_msg=f"rank {r} param {name} diverged")
-    # both ranks bitwise-identical to each other (same compiled module,
-    # same collectives)
-    for name in ref_params:
-        np.testing.assert_array_equal(results[0][name], results[1][name])
+    _assert_matches_reference(results, ref_losses, ref_params,
+                              "from big-batch")
 
 
 def test_init_distributed_single_process_noop():
@@ -98,3 +103,13 @@ def test_init_distributed_single_process_noop():
         assert not os.environ.get(k)
     assert parallel.init_distributed() == 0
     assert not parallel.distributed.is_initialized()
+
+
+def test_two_process_resume_equals_uninterrupted(tmp_path):
+    """Checkpoint -> fresh model -> restore across 2 REAL processes
+    (proc-0 write + barrier) reproduces the uninterrupted big-batch
+    trajectory, including optimizer moments (VERDICT r2 item 3)."""
+    results = _launch_world(2, str(tmp_path), steps=6, mode="resume")
+    ref_losses, ref_params = _single_process_reference(steps=6)
+    _assert_matches_reference(results, ref_losses, ref_params,
+                              "after resume")
