@@ -1,0 +1,190 @@
+/* py_ext — CPython C-API binding for the native core (SURVEY.md §2.2
+ * row 5: the reference generates its Python binding from the C++ core;
+ * pybind11 is not in this image, so this is a hand-written extension
+ * using the CPython API + buffer protocol for zero-copy argument
+ * passing).  The ctypes binding in singa_tpu/_core stays as the
+ * fallback; _core routes the hot wrappers through this module when it
+ * imports.
+ *
+ * All functions take contiguous f32 buffers (numpy arrays) and write
+ * into caller-allocated outputs — no copies, no allocation on the hot
+ * path. */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include "singa_core.h"
+
+namespace {
+
+struct Buf {
+  Py_buffer view{};
+  bool ok = false;
+  ~Buf() {
+    if (ok) PyBuffer_Release(&view);
+  }
+};
+
+bool get_f32(PyObject* obj, Buf* b, bool writable, Py_ssize_t* n_out) {
+  int flags = PyBUF_C_CONTIGUOUS | PyBUF_FORMAT
+              | (writable ? PyBUF_WRITABLE : 0);
+  if (PyObject_GetBuffer(obj, &b->view, flags) != 0) return false;
+  b->ok = true;
+  if (b->view.itemsize != 4
+      || (b->view.format && b->view.format[0] != 'f')) {
+    PyErr_SetString(PyExc_TypeError, "expected a contiguous float32 buffer");
+    return false;
+  }
+  if (n_out) *n_out = b->view.len / 4;
+  return true;
+}
+
+PyObject* py_version(PyObject*, PyObject*) {
+  return PyUnicode_FromString(sg_version());
+}
+
+PyObject* py_gemm(PyObject*, PyObject* args) {
+  PyObject *ao, *bo, *co;
+  long long m, k, n;
+  int ta, tb;
+  if (!PyArg_ParseTuple(args, "OOOLLLpp", &ao, &bo, &co, &m, &k, &n,
+                        &ta, &tb))
+    return nullptr;
+  Buf a, b, c;
+  Py_ssize_t na = 0, nb = 0, nc = 0;
+  if (!get_f32(ao, &a, false, &na) || !get_f32(bo, &b, false, &nb)
+      || !get_f32(co, &c, true, &nc))
+    return nullptr;
+  if (na < m * k || nb < k * n || nc < m * n) {
+    PyErr_SetString(PyExc_ValueError, "gemm buffer sizes inconsistent "
+                                      "with (m, k, n)");
+    return nullptr;
+  }
+  Py_BEGIN_ALLOW_THREADS
+  sg_gemm(static_cast<const float*>(a.view.buf),
+          static_cast<const float*>(b.view.buf),
+          static_cast<float*>(c.view.buf), m, k, n, ta, tb, 1.0f, 0.0f);
+  Py_END_ALLOW_THREADS
+  Py_RETURN_NONE;
+}
+
+/* (a, b, out) elementwise */
+template <void (*FN)(const float*, const float*, float*, int64_t)>
+PyObject* py_binary(PyObject*, PyObject* args) {
+  PyObject *ao, *bo, *oo;
+  if (!PyArg_ParseTuple(args, "OOO", &ao, &bo, &oo)) return nullptr;
+  Buf a, b, o;
+  Py_ssize_t n = 0, nb = 0, no = 0;
+  if (!get_f32(ao, &a, false, &n) || !get_f32(bo, &b, false, &nb)
+      || !get_f32(oo, &o, true, &no))
+    return nullptr;
+  if (nb != n || no != n) {
+    PyErr_SetString(PyExc_ValueError, "size mismatch");
+    return nullptr;
+  }
+  Py_BEGIN_ALLOW_THREADS
+  FN(static_cast<const float*>(a.view.buf),
+     static_cast<const float*>(b.view.buf),
+     static_cast<float*>(o.view.buf), n);
+  Py_END_ALLOW_THREADS
+  Py_RETURN_NONE;
+}
+
+/* (a, out) elementwise */
+template <void (*FN)(const float*, float*, int64_t)>
+PyObject* py_unary(PyObject*, PyObject* args) {
+  PyObject *ao, *oo;
+  if (!PyArg_ParseTuple(args, "OO", &ao, &oo)) return nullptr;
+  Buf a, o;
+  Py_ssize_t n = 0, no = 0;
+  if (!get_f32(ao, &a, false, &n) || !get_f32(oo, &o, true, &no))
+    return nullptr;
+  if (no != n) {
+    PyErr_SetString(PyExc_ValueError, "size mismatch");
+    return nullptr;
+  }
+  Py_BEGIN_ALLOW_THREADS
+  FN(static_cast<const float*>(a.view.buf),
+     static_cast<float*>(o.view.buf), n);
+  Py_END_ALLOW_THREADS
+  Py_RETURN_NONE;
+}
+
+PyObject* py_softmax(PyObject*, PyObject* args) {
+  PyObject *ao, *oo;
+  long long rows, cols;
+  if (!PyArg_ParseTuple(args, "OOLL", &ao, &oo, &rows, &cols))
+    return nullptr;
+  Buf a, o;
+  Py_ssize_t n = 0, no = 0;
+  if (!get_f32(ao, &a, false, &n) || !get_f32(oo, &o, true, &no))
+    return nullptr;
+  if (n != rows * cols || no != n) {
+    PyErr_SetString(PyExc_ValueError, "size mismatch");
+    return nullptr;
+  }
+  Py_BEGIN_ALLOW_THREADS
+  sg_softmax(static_cast<const float*>(a.view.buf),
+             static_cast<float*>(o.view.buf), rows, cols);
+  Py_END_ALLOW_THREADS
+  Py_RETURN_NONE;
+}
+
+PyObject* py_sgd_update(PyObject*, PyObject* args) {
+  PyObject *po, *go, *mo;
+  float lr, mom, wd;
+  if (!PyArg_ParseTuple(args, "OOOfff", &po, &go, &mo, &lr, &mom, &wd))
+    return nullptr;
+  Buf p, g, m;
+  Py_ssize_t n = 0, ng = 0;
+  if (!get_f32(po, &p, true, &n) || !get_f32(go, &g, false, &ng))
+    return nullptr;
+  float* momp = nullptr;
+  if (mo != Py_None) {
+    Py_ssize_t nm = 0;
+    if (!get_f32(mo, &m, true, &nm)) return nullptr;
+    if (nm != n) {
+      PyErr_SetString(PyExc_ValueError, "momentum size mismatch");
+      return nullptr;
+    }
+    momp = static_cast<float*>(m.view.buf);
+  }
+  if (ng != n) {
+    PyErr_SetString(PyExc_ValueError, "grad size mismatch");
+    return nullptr;
+  }
+  Py_BEGIN_ALLOW_THREADS
+  sg_sgd_update(static_cast<float*>(p.view.buf),
+                static_cast<const float*>(g.view.buf), momp, lr, mom, wd, n);
+  Py_END_ALLOW_THREADS
+  Py_RETURN_NONE;
+}
+
+PyMethodDef kMethods[] = {
+    {"version", py_version, METH_NOARGS, "native core version"},
+    {"gemm", py_gemm, METH_VARARGS, "gemm(a, b, out, m, k, n, ta, tb)"},
+    {"add", py_binary<sg_add>, METH_VARARGS, "add(a, b, out)"},
+    {"sub", py_binary<sg_sub>, METH_VARARGS, "sub(a, b, out)"},
+    {"mul", py_binary<sg_mul>, METH_VARARGS, "mul(a, b, out)"},
+    {"div", py_binary<sg_div>, METH_VARARGS, "div(a, b, out)"},
+    {"relu", py_unary<sg_relu>, METH_VARARGS, "relu(a, out)"},
+    {"sigmoid", py_unary<sg_sigmoid>, METH_VARARGS, "sigmoid(a, out)"},
+    {"tanh", py_unary<sg_tanh>, METH_VARARGS, "tanh(a, out)"},
+    {"exp", py_unary<sg_exp>, METH_VARARGS, "exp(a, out)"},
+    {"softmax", py_softmax, METH_VARARGS, "softmax(a, out, rows, cols)"},
+    {"sgd_update", py_sgd_update, METH_VARARGS,
+     "sgd_update(p, g, mom|None, lr, momentum, wd) in-place"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef kModule = {
+    PyModuleDef_HEAD_INIT, "singa_core_ext",
+    "CPython C-API binding over the singa native core (zero-copy buffers)",
+    -1, kMethods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_singa_core_ext(void) {
+  return PyModule_Create(&kModule);
+}

@@ -1,9 +1,17 @@
-"""ctypes binding to the native runtime (csrc/ → libsinga_core.so).
+"""Binding layer to the native runtime (csrc/ → libsinga_core.so).
 
 Parity role: the reference's generated binding layer between the Python
-surface and the C++ core (SURVEY.md §2.2 row 5; pybind11 unavailable in
-this image, so the binding is ctypes over a C API).  Builds the library
-on demand with the csrc/Makefile if it's missing.
+surface and the C++ core (SURVEY.md §2.2 row 5).  Two bindings share
+one C API:
+
+  * ``singa_core_ext`` — a CPython C-API extension (csrc/py_ext.cc)
+    using the buffer protocol for zero-copy argument passing; preferred
+    for the hot kernels when built;
+  * ctypes over the shared library — always available as the fallback
+    and the binding for handle-based components (scheduler, loader,
+    pool).
+
+Builds both on demand with the csrc/Makefile if missing.
 """
 
 from __future__ import annotations
@@ -21,6 +29,42 @@ _CSRC = os.path.abspath(os.path.join(_HERE, "..", "..", "csrc"))
 
 _lib: Optional[C.CDLL] = None
 _load_error: Optional[str] = None
+_ext = None          # the CPython extension module, when importable
+
+
+def ext():
+    """The C-API extension binding, or None (ctypes remains)."""
+    global _ext
+    if _ext is None and lib() is not None:   # lib() builds csrc on demand
+        _ext = _load_ext() or False
+    return _ext or None
+
+
+def _load_ext():
+    import glob
+    import importlib.util
+
+    paths = glob.glob(os.path.join(_HERE, "singa_core_ext*.so"))
+    if not paths:
+        # best-effort build; failure (e.g. no Python dev headers) leaves
+        # the ctypes binding in charge
+        try:
+            subprocess.run(["make", "-C", _CSRC, "ext"], check=True,
+                           capture_output=True, timeout=300)
+        except Exception:
+            return None
+        paths = glob.glob(os.path.join(_HERE, "singa_core_ext*.so"))
+        if not paths:
+            return None
+    spec = importlib.util.spec_from_file_location("singa_core_ext", paths[0])
+    if spec is None or spec.loader is None:
+        return None
+    try:
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:
+        return None
 
 
 def _build() -> bool:
@@ -142,17 +186,28 @@ def gemm(a: np.ndarray, b: np.ndarray, transa=False, transb=False,
     k = a.shape[0] if transa else a.shape[1]
     n = b.shape[0] if transb else b.shape[1]
     out = np.zeros((m, n), np.float32)
-    l.sg_gemm(a, b, out, m, k, n, int(transa), int(transb), alpha, 0.0)
+    e = ext()
+    if e is not None and alpha == 1.0:
+        e.gemm(a, b, out, m, k, n, bool(transa), bool(transb))
+    else:
+        l.sg_gemm(a, b, out, m, k, n, int(transa), int(transb), alpha, 0.0)
     return out
 
 
 def _binary(name):
+    ext_name = name[3:]                      # sg_add -> add
+
     def fn(a, b):
         l = lib()
         _count()
         a, b = _c(a), _c(b)
         out = np.empty_like(a)
-        getattr(l, name)(a, b, out, a.size)
+        e = ext()
+        if e is not None:
+            getattr(e, ext_name)(a.reshape(-1), b.reshape(-1),
+                                 out.reshape(-1))
+        else:
+            getattr(l, name)(a, b, out, a.size)
         return out
     return fn
 
@@ -164,12 +219,18 @@ div = _binary("sg_div")
 
 
 def _unary(name):
+    ext_name = name[3:]
+
     def fn(a):
         l = lib()
         _count()
         a = _c(a)
         out = np.empty_like(a)
-        getattr(l, name)(a, out, a.size)
+        e = ext()
+        if e is not None:
+            getattr(e, ext_name)(a.reshape(-1), out.reshape(-1))
+        else:
+            getattr(l, name)(a, out, a.size)
         return out
     return fn
 
