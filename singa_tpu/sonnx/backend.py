@@ -131,19 +131,25 @@ _UNARY = {
     "Abs": (autograd.abs, np.abs),
     "Neg": (autograd.neg, np.negative),
     "Erf": (autograd.erf, None),
-    "Floor": (lambda t: _nyi_grad(jnp.floor, t), np.floor),
-    "Ceil": (lambda t: _nyi_grad(jnp.ceil, t), np.ceil),
-    "Round": (lambda t: _nyi_grad(jnp.round, t), np.round),
-    "Sign": (lambda t: _nyi_grad(jnp.sign, t), np.sign),
-    "Reciprocal": (lambda t: _JnpOp(lambda a: 1.0 / a)(t), lambda a: 1.0 / a),
+    "Floor": (autograd.floor, np.floor),
+    "Ceil": (autograd.ceil, np.ceil),
+    "Round": (autograd.round, np.round),
+    "Sign": (autograd.sign, np.sign),
+    "Reciprocal": (autograd.reciprocal, lambda a: 1.0 / a),
     "Softplus": (autograd.softplus, None),
-    "Not": (lambda t: _JnpOp(jnp.logical_not)(t), np.logical_not),
+    "Not": (autograd.logical_not, np.logical_not),
     "Identity": (lambda t: t, lambda a: a),
+    # trig/hyperbolic family: differentiable native operators (r3)
+    "Sin": (autograd.sin, np.sin), "Cos": (autograd.cos, np.cos),
+    "Tan": (autograd.tan, np.tan), "Asin": (autograd.asin, np.arcsin),
+    "Acos": (autograd.acos, np.arccos), "Atan": (autograd.atan, np.arctan),
+    "Sinh": (autograd.sinh, np.sinh), "Cosh": (autograd.cosh, np.cosh),
+    "Asinh": (autograd.asinh, np.arcsinh),
+    "Acosh": (autograd.acosh, np.arccosh),
+    "Atanh": (autograd.atanh, np.arctanh),
+    "HardSwish": (autograd.hardswish, None),
+    "Mish": (autograd.mish, None),
 }
-
-
-def _nyi_grad(fn, t):
-    return _JnpOp(fn)(t)
 
 
 @handles(*_UNARY)
@@ -171,13 +177,24 @@ _BINARY = {
     "And": (jnp.logical_and, np.logical_and),
     "Or": (jnp.logical_or, np.logical_or),
     "Xor": (jnp.logical_xor, np.logical_xor),
-    "Mod": (jnp.mod, np.mod),
 }
 
 
 @handles(*_BINARY)
 def _h_binary(ctx, node, attrs, ins):
     j_fn, np_fn = _BINARY[node.op_type]
+    a, b = ins
+    if _is_host(a) and _is_host(b):
+        return [np_fn(_host(a), _host(b))]
+    return [_apply(ctx, j_fn, a, b)]
+
+
+@handles("Mod")
+def _h_mod(ctx, node, attrs, ins):
+    # fmod=1 -> C fmod (sign of dividend); fmod=0 -> floor-mod
+    fmod = bool(attrs.get("fmod", 0))
+    j_fn = jnp.fmod if fmod else jnp.mod
+    np_fn = np.fmod if fmod else np.mod
     a, b = ins
     if _is_host(a) and _is_host(b):
         return [np_fn(_host(a), _host(b))]

@@ -116,6 +116,22 @@ _SIMPLE = {
     autograd.Sqrt: "Sqrt", autograd.Erf: "Erf", autograd.Matmul: "MatMul",
     autograd.ReLU: "Relu", autograd.Sigmoid: "Sigmoid",
     autograd.Tanh: "Tanh", autograd.Softplus: "Softplus",
+    # breadth ops (r3): 1:1 ONNX node types
+    autograd.Sin: "Sin", autograd.Cos: "Cos", autograd.Tan: "Tan",
+    autograd.Asin: "Asin", autograd.Acos: "Acos", autograd.Atan: "Atan",
+    autograd.Sinh: "Sinh", autograd.Cosh: "Cosh",
+    autograd.Asinh: "Asinh", autograd.Acosh: "Acosh",
+    autograd.Atanh: "Atanh", autograd.Ceil: "Ceil",
+    autograd.Floor: "Floor", autograd.Round: "Round",
+    autograd.Sign: "Sign", autograd.Reciprocal: "Reciprocal",
+    autograd.Minimum: "Min", autograd.Maximum: "Max",
+    autograd.Equal: "Equal", autograd.Greater: "Greater",
+    autograd.GreaterEqual: "GreaterOrEqual", autograd.Less: "Less",
+    autograd.LessEqual: "LessOrEqual", autograd.LogicalAnd: "And",
+    autograd.LogicalOr: "Or", autograd.LogicalXor: "Xor",
+    autograd.LogicalNot: "Not", autograd.SELU: "Selu",
+    autograd.PReLU: "PRelu", autograd.Mish: "Mish",
+    autograd.HardSwish: "HardSwish",
 }
 
 
@@ -127,6 +143,60 @@ def _e_simple(ex, op, ins, outs):
 @_exports(autograd.Gelu)
 def _e_gelu(ex, op, ins, outs):
     ex.emit("Gelu", ins, _outn(ex, outs))
+
+
+@_exports(autograd.Mod)
+def _e_mod(ex, op, ins, outs):
+    dt = np.dtype(outs[0].dtype)
+    if np.issubdtype(dt, np.integer):
+        # ONNX integer Mod (fmod=0) is floor-mod: matches jnp.mod
+        ex.emit("Mod", ins, _outn(ex, outs), fmod=0)
+        return
+    # float: ONNX Mod only offers C-fmod (sign of dividend), but the
+    # native op is floor-mod (jnp.mod, sign of divisor) — decompose
+    # a - floor(a/b)*b, which is dtype-agnostic and sign-correct
+    a, b = ins
+    q = ex.fresh("mod_div")
+    ex.emit("Div", [a, b], [q])
+    fl = ex.fresh("mod_floor")
+    ex.emit("Floor", [q], [fl])
+    prod = ex.fresh("mod_prod")
+    ex.emit("Mul", [fl, b], [prod])
+    ex.emit("Sub", [a, prod], _outn(ex, outs))
+
+
+@_exports(autograd.HardSigmoid)
+def _e_hardsigmoid(ex, op, ins, outs):
+    ex.emit("HardSigmoid", ins, _outn(ex, outs),
+            alpha=float(op.alpha), beta=float(op.beta))
+
+
+@_exports(autograd.Tile)
+def _e_tile(ex, op, ins, outs):
+    # ONNX Tile requires len(repeats) == input rank. jnp.tile left-pads
+    # short reps with 1s (match that); long reps promote the input's
+    # rank, which ONNX Tile can't express without a reshape.
+    x_rank = len(ex.cur_in_tensors[0].shape)
+    reps = list(op.reps)
+    if len(reps) > x_rank:
+        raise ValueError(
+            "sonnx export: Tile with more reps than input rank has no "
+            "ONNX equivalent; reshape the input first")
+    reps = [1] * (x_rank - len(reps)) + reps
+    r = ex.add_init(np.asarray(reps, np.int64), "repeats")
+    ex.emit("Tile", [ins[0], r], _outn(ex, outs))
+
+
+@_exports(autograd.Expand)
+def _e_expand(ex, op, ins, outs):
+    shp = ex.add_init(np.asarray(op.shape, np.int64), "shape")
+    ex.emit("Expand", [ins[0], shp], _outn(ex, outs))
+
+
+@_exports(autograd.CumSum)
+def _e_cumsum(ex, op, ins, outs):
+    ax = ex.add_init(np.asarray(op.axis, np.int64), "axis")
+    ex.emit("CumSum", [ins[0], ax], _outn(ex, outs))
 
 
 @_exports(autograd.SiLU)

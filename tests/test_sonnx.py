@@ -551,3 +551,70 @@ class TestBf16ExportDtypeDiscipline:
         (out,) = rep.run([x])
         np.testing.assert_allclose(np.asarray(out.data), native,
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestBreadthOpRoundTrips:
+    """New r3 operators export to 1:1 ONNX nodes and reimport (export ->
+    import -> run == native run)."""
+
+    def _roundtrip(self, build, x_np):
+        from singa_tpu import model
+
+        class Net(model.Model):
+            def forward(self, t):
+                return build(t)
+
+        m = Net()
+        xt = T(x_np)
+        m.compile([xt], is_train=False, use_graph=False)
+        native = np.asarray(m(xt).data)
+        proto_model = sonnx.to_onnx(m, [xt])
+        rep = sonnx.prepare(proto_model)
+        (out,) = rep.run([xt])
+        np.testing.assert_allclose(np.asarray(out.data), native,
+                                   rtol=1e-5, atol=1e-6)
+        return proto_model
+
+    def test_trig_chain(self):
+        from singa_tpu import autograd as ag
+        x = np.random.RandomState(0).uniform(-0.8, 0.8, (2, 5)).astype(np.float32)
+        p = self._roundtrip(
+            lambda t: ag.atan(ag.sinh(ag.cos(ag.sin(t)))), x)
+        ops = [n.op_type for n in p.graph.node]
+        assert ops == ["Sin", "Cos", "Sinh", "Atan"]
+
+    def test_activation_chain(self):
+        from singa_tpu import autograd as ag
+        x = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+        self._roundtrip(lambda t: ag.mish(ag.hardswish(ag.selu(t))), x)
+
+    def test_minmax_mod_roundtrip(self):
+        from singa_tpu import autograd as ag
+        # NEGATIVE dividends: the exported decomposition must keep
+        # floor-mod semantics (sign of divisor), not C-fmod
+        x = np.random.RandomState(2).uniform(-2.0, 2.0, (2, 6)).astype(np.float32)
+        p = self._roundtrip(
+            lambda t: ag.mod(ag.maximum(t, ag.reciprocal(t)), 0.7), x)
+        # float mod exports as the Div/Floor/Mul/Sub decomposition
+        ops = [n.op_type for n in p.graph.node]
+        assert "Mod" not in ops and "Floor" in ops
+
+    def test_tile_reps_padded_to_rank(self):
+        from singa_tpu import autograd as ag
+        x = np.random.RandomState(6).randn(2, 3).astype(np.float32)
+        p = self._roundtrip(lambda t: ag.tile(t, 2), x)  # short reps
+        (tile_node,) = [n for n in p.graph.node if n.op_type == "Tile"]
+        reps = [t for t in p.graph.initializer if "repeats" in t.name]
+        assert reps and list(proto.to_array(reps[0])) == [1, 2]
+
+    def test_tile_expand_cumsum_roundtrip(self):
+        from singa_tpu import autograd as ag
+        x = np.random.RandomState(3).randn(2, 3).astype(np.float32)
+        self._roundtrip(lambda t: ag.cumsum(ag.tile(t, (2, 1)), axis=0), x)
+        self._roundtrip(lambda t: ag.expand(t, (4, 2, 3)), x)
+
+    def test_comparison_where_roundtrip(self):
+        from singa_tpu import autograd as ag
+        x = np.random.RandomState(4).randn(3, 3).astype(np.float32)
+        self._roundtrip(
+            lambda t: ag.where(ag.greater(t, ag.floor(t) ), t, ag.neg(t)), x)
