@@ -37,7 +37,7 @@ __all__ = [
     "MaxPool2d", "AvgPool2d", "GlobalAvgPool2d", "Flatten", "ReLU",
     "Sigmoid", "Tanh", "Gelu", "SiLU", "LeakyReLU", "Softmax", "Dropout",
     "Embedding", "LayerNorm", "RMSNorm", "RNN", "LSTM",
-    "MultiHeadAttention", "Sequential", "CrossEntropyLoss", "MSELoss",
+    "MultiHeadAttention", "MoE", "Sequential", "CrossEntropyLoss", "MSELoss",
 ]
 
 _name_counter: Dict[str, int] = {}
@@ -620,6 +620,77 @@ class MultiHeadAttention(Layer):
             return self.out_proj(o.reshape((B, T, D))), (ck, cv)
         o = attn_ops.attention(q, k, v, causal=self.causal, mask=mask)
         return self.out_proj(o.reshape((B, T, D)))
+
+
+class _MoEOp(autograd.Operator):
+    def __init__(self, cf):
+        super().__init__()
+        self.cf = cf
+
+    def fwd(self, xa, rw, wi, wo):
+        from .ops.moe import moe_forward
+        out, aux = moe_forward(xa, rw, wi, wo, self.cf, return_aux=True)
+        return out, aux
+
+
+class MoE(Layer):
+    """Top-1 mixture-of-experts FFN (ops/moe.py — GShard/Switch static
+    dispatch).  Stacked expert weights carry a leading E axis; the
+    layer declares SHARD_RULES sharding it over the 'expert' mesh axis
+    (the executor merges sublayer rules, so models need not repeat
+    them) — with EP the dispatch/combine einsums become all-to-alls.
+
+    The router's load-balance auxiliary losses accumulate across calls;
+    `pop_aux_loss()` returns their sum and resets — add it to the
+    training loss once per step."""
+
+    SHARD_RULES = [
+        (r"\.(w_in|w_out)$", ("expert", None, None)),
+    ]
+
+    def __init__(self, num_experts: int, ffn_dim: int,
+                 capacity_factor: float = 1.25, name=None):
+        super().__init__(name)
+        self.num_experts = num_experts
+        self.ffn_dim = ffn_dim
+        self.capacity_factor = capacity_factor
+        self._aux_losses: List[Tensor] = []
+
+    def initialize(self, x: Tensor):
+        d = x.shape[-1]
+        e, h = self.num_experts, self.ffn_dim
+        dev = x.device
+        self.router = self.register_param(
+            "router", _xavier_uniform((d, e), d, e, dev))
+        self.w_in = self.register_param(
+            "w_in", Tensor((e, d, h), dev, np.float32).gaussian(
+                0.0, (2.0 / (d + h)) ** 0.5))
+        self.w_out = self.register_param(
+            "w_out", Tensor((e, h, d), dev, np.float32).gaussian(
+                0.0, (2.0 / (d + h)) ** 0.5))
+
+    def forward(self, x: Tensor) -> Tensor:
+        # router stays f32 master: moe_forward computes routing in f32
+        out, aux = _MoEOp(self.capacity_factor)(
+            x, self.router, self.w_in, self.w_out)
+        self._aux_losses.append(aux)
+        return out
+
+    @property
+    def aux_loss(self) -> Optional[Tensor]:
+        """Most recent call's balance loss (see pop_aux_loss for the
+        accumulated per-step sum)."""
+        return self._aux_losses[-1] if self._aux_losses else None
+
+    def pop_aux_loss(self) -> Optional[Tensor]:
+        """Sum of balance losses since the last pop; resets the store."""
+        if not self._aux_losses:
+            return None
+        total = self._aux_losses[0]
+        for a in self._aux_losses[1:]:
+            total = total + a
+        self._aux_losses = []
+        return total
 
 
 class Sequential(Layer):

@@ -382,7 +382,7 @@ class _StepExecutor:
                     "1-D data-parallel meshes (explicit in-graph pmean); on "
                     "multi-axis meshes GSPMD chooses the collectives and "
                     "these options are ignored", stacklevel=2)
-            rules = getattr(self.model, "SHARD_RULES", None)
+            rules = spmd.collect_shard_rules(self.model)
             rep = mesh_mod.NamedSharding(mesh, P())
             p_arrays = {n: t.data for n, t in self.param_tensors.items()}
             b_arrays = {n: t.data for n, t in self.buffer_tensors.items()}

@@ -1,0 +1,91 @@
+"""Mixture-of-Experts with expert parallelism (the 'expert' mesh axis).
+
+Not a reference capability (SURVEY.md §2.3: the reference's only
+strategy is DP) — this is the TPU-native extension that completes the
+framework's parallelism axes (dp/tp/sp/pp/ep).  Formulation follows the
+GShard/Switch static-shape recipe, which is what XLA partitions well:
+
+  * router: (N, D) -> (N, E) logits -> top-1 gate with a static expert
+    capacity C = ceil(cf * N / E);
+  * dispatch: a one-hot (N, E, C) combine tensor built with cumsum
+    position indexing — NO dynamic shapes, dropped tokens (over
+    capacity) pass through with zero expert contribution;
+  * expert compute: (E, C, D) batched einsums over stacked expert
+    weights — sharding the leading E axis over the 'expert' mesh axis
+    turns the dispatch/combine einsums into XLA all-to-alls over ICI;
+  * combine: gate-weighted gather back to (N, D).
+
+Everything is pure jnp (fwd differentiates via jax.vjp), so the whole
+MoE layer compiles into the model's single step module like any other
+op; router load-balance auxiliary loss follows Switch (mean fraction *
+mean probability per expert).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["moe_dispatch", "moe_forward", "load_balance_loss"]
+
+
+def moe_dispatch(logits, capacity: int):
+    """Top-1 routing with static capacity.
+
+    logits: (N, E).  Returns (combine (N, E, C) f32, gate (N,), aux
+    tensors for the balance loss).  combine[n, e, c] is the gate weight
+    of token n at slot c of expert e (0 everywhere else; 0 for dropped
+    tokens)."""
+    N, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate = jnp.max(probs, axis=-1)                     # (N,)
+    expert = jnp.argmax(probs, axis=-1)                # (N,)
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)   # (N, E)
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0    # (N, E), -1 elsewhere
+    pos_in_expert = jnp.sum(pos * onehot, axis=-1)     # (N,)
+    keep = pos_in_expert < capacity
+    slot = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), capacity,
+                          dtype=jnp.float32)
+    combine = (onehot * (gate * keep)[:, None])[:, :, None] * slot[:, None, :]
+    return combine, probs, onehot
+
+
+def load_balance_loss(probs, onehot):
+    """Switch aux loss: E * sum_e mean_n(frac_e) * mean_n(prob_e)."""
+    E = probs.shape[-1]
+    frac = jnp.mean(onehot, axis=0)
+    prob = jnp.mean(probs, axis=0)
+    return E * jnp.sum(frac * prob)
+
+
+def moe_forward(x, router_w, w_in, w_out, capacity_factor: float = 1.25,
+                return_aux: bool = False):
+    """Top-1 MoE FFN over flattened tokens.
+
+    x: (..., D); router_w: (D, E); w_in: (E, D, H); w_out: (E, H, D).
+    Expert e computes relu(x @ w_in[e]) @ w_out[e].  Shard w_in/w_out's
+    leading axis over the 'expert' mesh axis (SHARD_RULES) for EP."""
+    orig_shape = x.shape
+    D = orig_shape[-1]
+    xf = x.reshape(-1, D)
+    N = xf.shape[0]
+    E = router_w.shape[-1]
+    capacity = max(1, math.ceil(capacity_factor * N / E))
+
+    logits = xf.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    combine, probs, onehot = moe_dispatch(logits, capacity)
+    dispatch = (combine > 0).astype(xf.dtype)          # (N, E, C)
+    # dispatch tokens into per-expert buffers: (E, C, D)
+    buf = jnp.einsum("nec,nd->ecd", dispatch, xf)
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", buf, w_in.astype(xf.dtype)))
+    y = jnp.einsum("ech,ehd->ecd", h, w_out.astype(xf.dtype))
+    # gate-weighted combine back to tokens
+    out = jnp.einsum("nec,ecd->nd", combine.astype(xf.dtype), y)
+    out = out.reshape(orig_shape)
+    if return_aux:
+        return out, load_balance_loss(probs, onehot)
+    return out

@@ -131,7 +131,7 @@ def plan_train_step(model, optimizer, batch_sds,
     abstract_init(model, batch_sds[:1])
 
     params = {n: t.data for n, t in model.get_params().items()}
-    rules = getattr(model, "SHARD_RULES", None)
+    rules = spmd.collect_shard_rules(model)
     shardings = spmd.param_shardings(params, rules, mesh)
     slots_abs = jax.eval_shape(optimizer.init, params)
     slot_sh = spmd.tree_shardings(slots_abs, shardings, mesh,

@@ -23,7 +23,29 @@ import jax
 
 from .mesh import Mesh, NamedSharding, P
 
-__all__ = ["spec_for", "param_shardings", "batch_spec", "tree_shardings"]
+__all__ = ["spec_for", "param_shardings", "batch_spec", "tree_shardings",
+           "collect_shard_rules"]
+
+
+def collect_shard_rules(model) -> list:
+    """Model-level SHARD_RULES followed by any sublayer-declared rules
+    (e.g. layer.MoE's expert sharding) — first match wins, so model
+    rules override layer defaults."""
+    rules = list(getattr(model, "SHARD_RULES", None) or [])
+    seen = {id(r) for r in rules}
+
+    def walk(l):
+        lr = getattr(type(l), "SHARD_RULES", None)
+        if lr and l is not model:
+            for r in lr:
+                if id(r) not in seen:
+                    rules.append(r)
+                    seen.add(id(r))
+        for sub in getattr(l, "_sublayers", {}).values():
+            walk(sub)
+
+    walk(model)
+    return rules or None
 
 
 def spec_for(name: str, shape: Sequence[int], rules, mesh: Mesh) -> P:

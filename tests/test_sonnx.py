@@ -613,8 +613,14 @@ class TestBreadthOpRoundTrips:
         self._roundtrip(lambda t: ag.cumsum(ag.tile(t, (2, 1)), axis=0), x)
         self._roundtrip(lambda t: ag.expand(t, (4, 2, 3)), x)
 
-    def test_comparison_where_roundtrip(self):
+    def test_comparison_export_emits_nodes(self):
+        """Comparisons export as real graph nodes (the Where path
+        freezes trace-time conditions, so assert node types, not just
+        numerics)."""
         from singa_tpu import autograd as ag
         x = np.random.RandomState(4).randn(3, 3).astype(np.float32)
-        self._roundtrip(
-            lambda t: ag.where(ag.greater(t, ag.floor(t) ), t, ag.neg(t)), x)
+        p = self._roundtrip(
+            lambda t: ag.mul(ag.cast(ag.greater(t, ag.floor(t)),
+                                     np.float32), t), x)
+        ops = [n.op_type for n in p.graph.node]
+        assert "Greater" in ops and "Floor" in ops, ops
