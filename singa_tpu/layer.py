@@ -640,7 +640,9 @@ class MoE(Layer):
     (the executor merges sublayer rules, so models need not repeat
     them) — with EP the dispatch/combine einsums become all-to-alls.
 
-    The router's load-balance auxiliary losses accumulate across calls;
+    The router's load-balance auxiliary losses accumulate across
+    *training-mode* calls (eval and compile-time dry runs don't
+    accumulate — an init-trace entry would leak a dead tracer);
     `pop_aux_loss()` returns their sum and resets — add it to the
     training loss once per step."""
 
@@ -673,13 +675,17 @@ class MoE(Layer):
         # router stays f32 master: moe_forward computes routing in f32
         out, aux = _MoEOp(self.capacity_factor)(
             x, self.router, self.w_in, self.w_out)
-        self._aux_losses.append(aux)
+        # accumulate only in training: eval/compile-time dry runs must
+        # not leave stale entries (an init-trace tracer here would crash
+        # the first real pop_aux_loss)
+        if autograd.is_training():
+            self._aux_losses.append(aux)
         return out
 
     @property
     def aux_loss(self) -> Optional[Tensor]:
-        """Most recent call's balance loss (see pop_aux_loss for the
-        accumulated per-step sum)."""
+        """Most recent *training* call's balance loss (eval forwards do
+        not record; see pop_aux_loss for the accumulated per-step sum)."""
         return self._aux_losses[-1] if self._aux_losses else None
 
     def pop_aux_loss(self) -> Optional[Tensor]:

@@ -152,3 +152,123 @@ def test_batchnorm_model_graph_state_threading():
         m.train_step(x, y)
     rm1 = m.bn.running_mean.to_numpy()
     assert not np.allclose(rm0, rm1), "running stats must update through the graph"
+
+
+def test_jit_init_matches_eager_init(monkeypatch):
+    """SINGA_JIT_INIT=1 materializes params through one compiled init
+    program (the remote-TPU fast path); the PRNG key sequence matches
+    the eager dry-run, so values agree up to XLA fusion (FMA) rounding."""
+    def build(flag):
+        monkeypatch.setenv("SINGA_JIT_INIT", flag)
+        tensor.set_seed(7)
+        m = MLP(hidden=16)
+        m.set_optimizer(opt.SGD(lr=0.1))
+        x = tensor.from_numpy(np.random.RandomState(0).randn(8, 10).astype(np.float32))
+        m.compile([x], is_train=True, use_graph=True)
+        return {n: p.to_numpy() for n, p in m.get_params().items()}
+
+    eager = build("0")
+    jitted = build("1")
+    assert eager.keys() == jitted.keys()
+    for n in eager:
+        np.testing.assert_allclose(eager[n], jitted[n], rtol=1e-6,
+                                   atol=1e-7, err_msg=n)
+
+
+def test_jit_init_trains_same_as_eager(monkeypatch):
+    """A model initialized through the jit-init path must train exactly
+    like the eager-initialized one (same seed, same trajectory)."""
+    def run(flag):
+        monkeypatch.setenv("SINGA_JIT_INIT", flag)
+        tensor.set_seed(11)
+        np.random.seed(11)
+        x, y = make_blobs(n=64)
+        m = MLP()
+        m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+        xt = tensor.from_numpy(x[:16])
+        yt = tensor.from_numpy(y[:16])
+        m.compile([xt], is_train=True, use_graph=True)
+        losses = []
+        for _ in range(5):
+            _, ls = m.train_step(xt, yt)
+            losses.append(float(ls.to_numpy()))
+        return losses
+
+    np.testing.assert_allclose(run("0"), run("1"), rtol=1e-6)
+
+
+def test_jit_init_skips_dry_run_when_initialized(monkeypatch):
+    """compile() on an already-materialized model must not replay the
+    forward on an accelerator (counts forward calls via a probe layer)."""
+    calls = {"n": 0}
+
+    class Probe(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc = layer.Linear(4)
+
+        def forward(self, x):
+            calls["n"] += 1
+            return self.fc(x)
+
+        def train_one_batch(self, x, y):
+            out = self.forward(x)
+            loss = autograd.mse_loss(out, y)
+            self.optimizer(loss)
+            return out, loss
+
+    monkeypatch.setenv("SINGA_JIT_INIT", "0")
+    tensor.set_seed(3)
+    m = Probe()
+    m.set_optimizer(opt.SGD(lr=0.1))
+    x = tensor.from_numpy(np.random.randn(4, 6).astype(np.float32))
+    m.compile([x], is_train=True, use_graph=True)
+    n_after_first = calls["n"]
+    assert n_after_first == 1
+    # second compile: params exist; on CPU the legacy dry-run still runs
+    m.compile([x], is_train=True, use_graph=True)
+    assert calls["n"] == 2
+    # ...but when the device reports accelerator (and jit-init is not
+    # force-disabled), compile skips the replay
+    monkeypatch.setenv("SINGA_JIT_INIT", "auto")
+    monkeypatch.setattr(type(x.device), "is_tpu", property(lambda self: True))
+    m.compile([x], is_train=True, use_graph=True)
+    assert calls["n"] == 2
+
+
+def test_jit_init_falls_back_to_eager_on_untraceable_forward(monkeypatch):
+    """A forward that is not jit-traceable (host-side branching on
+    values) must still compile: jit-init resets lazy state and falls
+    back to the eager dry-run with a warning."""
+    import warnings
+
+    class Hosty(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc = layer.Linear(4)
+
+        def forward(self, x):
+            out = self.fc(x)
+            # data-dependent host branch: fine eagerly, fatal under jit
+            if float(out.to_numpy().sum()) > -1e30:
+                return out
+            return out
+
+        def train_one_batch(self, x, y):
+            out = self.forward(x)
+            loss = autograd.mse_loss(out, y)
+            self.optimizer.backward_and_update(loss)
+            return out, loss
+
+    monkeypatch.setenv("SINGA_JIT_INIT", "1")
+    tensor.set_seed(5)
+    m = Hosty()
+    m.set_optimizer(opt.SGD(lr=0.1))
+    x = tensor.from_numpy(np.random.randn(4, 6).astype(np.float32))
+    y = tensor.from_numpy(np.random.randn(4, 4).astype(np.float32))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        m.compile([x], is_train=True, use_graph=False)
+    assert any("jit-init" in str(x.message) for x in w)
+    _, ls = m.train_step(x, y)
+    assert np.isfinite(float(ls.to_numpy()))
