@@ -467,10 +467,12 @@ def main() -> None:
     cpu_timeout = float(os.environ.get("SINGA_BENCH_CPU_TIMEOUT_S", "180"))
     probe_tries = int(os.environ.get("SINGA_BENCH_PROBE_TRIES", "3"))
 
-    # the axon tunnel has been observed to wedge for hours and then
-    # recover: retry HUNG probes with a short backoff before giving up
-    # on the chip for the round; deterministic failures (no TPU on this
+    # the axon tunnel has been observed to wedge for minutes-to-hours and
+    # then recover — and killing a client mid-handshake can prolong the
+    # wedge, so retries back off progressively (45s -> 2min -> 5min)
+    # rather than hammering it; deterministic failures (no TPU on this
     # host) fall through to CPU immediately
+    backoffs = [45, 120, 300]
     usable = False
     for attempt in range(probe_tries):
         status = _tpu_usable(probe_timeout)
@@ -479,9 +481,10 @@ def main() -> None:
             break
         if status == "fail" or attempt + 1 >= probe_tries:
             break
+        wait = backoffs[min(attempt, len(backoffs) - 1)]
         print(f"# TPU probe attempt {attempt + 1}/{probe_tries} hung; "
-              f"retrying in 45s", file=sys.stderr)
-        time.sleep(45)
+              f"retrying in {wait}s", file=sys.stderr)
+        time.sleep(wait)
 
     emitted = False
     if usable:

@@ -170,6 +170,7 @@ class Model(Layer):
             if isinstance(t, Tensor):
                 dev = t.device
                 break
+        pending = self._lazy_uninitialized()
         saved_key = tensor_mod._rng_key
         if saved_key is None:
             saved_key = jax.random.PRNGKey(0)  # _next_key()'s default
@@ -189,13 +190,16 @@ class Model(Layer):
         except Exception as e:
             tensor_mod._rng_key = saved_key
             # a failed trace leaves half-initialized layers holding
-            # tracers; jit-init only runs when the model had no params
-            # yet, so resetting all lazy state restores a clean slate —
+            # tracers; reset exactly the layers whose initialize() ran
+            # (or could have run) under the trace — not the whole model,
+            # which would wipe states registered outside initialize() —
             # then fall back to the eager dry-run so forwards that are
             # not jit-traceable (host-side control flow, .to_numpy())
             # keep compiling exactly as before
-            from .parallel.planner import _reset_lazy
-            _reset_lazy(self)
+            for l in pending:
+                l._initialized = False
+                l._params.clear()
+                l._states.clear()
             import warnings
             warnings.warn(
                 f"jit-init trace failed ({type(e).__name__}); falling "
@@ -460,7 +464,14 @@ class _StepExecutor:
         # explicit in-graph pmean (the reference Communicator path).
         extra = [a for a, n in (mesh.shape.items() if mesh else [])
                  if a != data_axis and n > 1]
-        gspmd = mesh is not None and bool(extra)
+        # ZeRO-1 weight-update sharding rides the GSPMD path even on a
+        # 1-D data mesh: slot shardings over 'data' make XLA partition
+        # the update (reduce-scatter grads / update shard / all-gather).
+        # Compressed/sparsified allreduce takes precedence (shard_map).
+        from .parallel import spmd as spmd_mod
+        zero1 = (isinstance(self.opt, DistOpt)
+                 and spmd_mod.zero1_axis_for(self.opt, mesh) is not None)
+        gspmd = mesh is not None and (bool(extra) or zero1)
         dist = (not gspmd and isinstance(self.opt, DistOpt)
                 and mesh is not None and data_axis in mesh.shape)
         self.dist = dist
@@ -490,7 +501,8 @@ class _StepExecutor:
             self._buffer_sh = {n: rep for n in b_arrays}
             self._slot_sh = spmd.tree_shardings(
                 self.slots, self._param_sh, mesh,
-                {n: a.shape for n, a in p_arrays.items()})
+                {n: a.shape for n, a in p_arrays.items()},
+                zero1_axis=data_axis if zero1 else None)
             self._rep_sh = rep
             self._batch_sh = tuple(
                 mesh_mod.NamedSharding(

@@ -326,7 +326,8 @@ class DistOpt(Optimizer):
 
     def __init__(self, opt: Optimizer, nccl_id=None, local_rank: int = 0,
                  world_size: Optional[int] = None, data_axis: str = "data",
-                 compress_dtype=None, topk_ratio: float = 0.0):
+                 compress_dtype=None, topk_ratio: float = 0.0,
+                 shard_weight_update: bool = False):
         super().__init__(opt.sched)
         self.opt = opt
         self.data_axis = data_axis
@@ -334,6 +335,12 @@ class DistOpt(Optimizer):
         self.topk_ratio = topk_ratio
         self.local_rank = local_rank
         self._world_size = world_size
+        # ZeRO-1 / cross-replica weight-update sharding (beyond the
+        # reference Communicator; PAPERS.md "Automatic Cross-Replica
+        # Sharding of Weight Update in Data-Parallel Training"): the
+        # graph executor shards optimizer moments over the data axis and
+        # lets GSPMD partition the update, so slot HBM scales 1/N
+        self.shard_weight_update = shard_weight_update
         del nccl_id  # reference-API compat; bootstrap is PJRT-side
 
     @property

@@ -135,7 +135,9 @@ def plan_train_step(model, optimizer, batch_sds,
     shardings = spmd.param_shardings(params, rules, mesh)
     slots_abs = jax.eval_shape(optimizer.init, params)
     slot_sh = spmd.tree_shardings(slots_abs, shardings, mesh,
-                                  {n: p.shape for n, p in params.items()})
+                                  {n: p.shape for n, p in params.items()},
+                                  zero1_axis=spmd.zero1_axis_for(optimizer,
+                                                                 mesh))
 
     pb_global = sum(int(np.prod(p.shape)) * np.dtype(p.dtype).itemsize
                     for p in params.values())

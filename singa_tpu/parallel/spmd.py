@@ -24,7 +24,31 @@ import jax
 from .mesh import Mesh, NamedSharding, P
 
 __all__ = ["spec_for", "param_shardings", "batch_spec", "tree_shardings",
-           "collect_shard_rules"]
+           "collect_shard_rules", "zero1_axis_for"]
+
+
+def zero1_axis_for(optimizer, mesh: Optional[Mesh]) -> Optional[str]:
+    """The data axis to shard optimizer moments over (ZeRO-1), or None.
+
+    Single source of truth for eligibility (used by the graph executor
+    and the planner): DistOpt-style optimizer with shard_weight_update,
+    a mesh whose data axis has >1 devices, and no compressed/sparsified
+    allreduce (those run on the shard_map path, which ZeRO-1 does not)."""
+    if not getattr(optimizer, "shard_weight_update", False):
+        return None
+    axis = getattr(optimizer, "data_axis", None)
+    if axis is None or mesh is None or mesh.shape.get(axis, 0) <= 1:
+        return None
+    if getattr(optimizer, "compress_dtype", None) is not None \
+            or getattr(optimizer, "topk_ratio", 0.0):
+        import warnings
+        warnings.warn(
+            "shard_weight_update is ignored when compressed/sparsified "
+            "allreduce is configured: those variants run on the "
+            "shard_map data-parallel path, which does not shard the "
+            "weight update", stacklevel=3)
+        return None
+    return axis
 
 
 def collect_shard_rules(model) -> list:
@@ -96,11 +120,21 @@ def batch_spec(shape: Sequence[int], dtype, mesh: Mesh,
 
 
 def tree_shardings(tree, name_to_sharding: Dict[str, NamedSharding],
-                   mesh: Mesh, param_shapes: Optional[Dict[str, Tuple]] = None):
+                   mesh: Mesh, param_shapes: Optional[Dict[str, Tuple]] = None,
+                   zero1_axis: Optional[str] = None):
     """Map a {name: slot-pytree} dict (optimizer state) to shardings:
     every leaf under `name` shares the param's sharding when shapes
-    match, else is replicated."""
+    match, else is replicated.
+
+    `zero1_axis`: cross-replica weight-update sharding (ZeRO-1; the
+    "Automatic Cross-Replica Sharding of Weight Update" approach from
+    PAPERS.md, expressed GSPMD-style): slot leaves that would otherwise
+    be fully replicated are sharded over this (data) axis on dim 0 when
+    divisible, so optimizer moments cost 1/N HBM per device and XLA
+    partitions the update math to match (reduce-scatter the grads,
+    update the owned shard, all-gather the params)."""
     rep = NamedSharding(mesh, P())
+    nshard = mesh.shape.get(zero1_axis, 0) if zero1_axis else 0
     out = {}
     for name, sub in tree.items():
         sh = name_to_sharding.get(name, rep)
@@ -109,6 +143,11 @@ def tree_shardings(tree, name_to_sharding: Dict[str, NamedSharding],
         def pick(leaf, sh=sh, pshape=pshape):
             if pshape is not None and tuple(getattr(leaf, "shape", ())) != tuple(pshape):
                 return rep
+            shape = tuple(getattr(leaf, "shape", ()))
+            if (nshard > 1 and all(ax is None for ax in sh.spec)
+                    and shape and shape[0] % nshard == 0
+                    and shape[0] >= nshard):
+                return NamedSharding(mesh, P(zero1_axis))
             return sh
 
         out[name] = jax.tree.map(pick, sub)
