@@ -42,6 +42,9 @@ def main():
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--force-host-devices", type=int, default=0,
                    help="virtual CPU devices for meshes without hardware")
+    p.add_argument("--fused-loss", action="store_true",
+                   help="chunked fused lm-head+CE (no (B*T,V) logits; "
+                        "train_one_batch returns (loss, loss))")
     p.add_argument("--plan", action="store_true",
                    help="shape-only capacity plan (no weights allocated): "
                         "per-device param/moment/grad bytes + HBM fit")
@@ -66,6 +69,8 @@ def main():
         "8b": models.LlamaConfig.llama3_8b,
     }
     cfg = presets[args.preset]()
+    if args.fused_loss:
+        cfg.fused_loss = True
 
     axes = {k: v for k, v in
             (("data", args.dp), ("model", args.tp), ("seq", args.sp))

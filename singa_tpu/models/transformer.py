@@ -191,6 +191,22 @@ def next_token_loss(logits: Tensor, ids: Tensor) -> Tensor:
     return autograd.softmax_cross_entropy(lg, tg)
 
 
+def next_token_loss_fused(x: Tensor, lm_head, ids: Tensor,
+                          chunk_rows: int = 512) -> Tensor:
+    """Causal-LM loss straight from the final hidden states: the lm-head
+    matmul and softmax-CE run fused + row-chunked
+    (autograd.fused_linear_cross_entropy), so the (B*T, V) logits are
+    never materialized — the memory-lean large-vocab loss path."""
+    B, T, d = x.shape
+    if not lm_head._initialized:          # fused path skips lm_head(...)
+        lm_head.initialize(x)
+        lm_head._initialized = True
+    h = autograd.reshape(x[:, :-1, :], (B * (T - 1), d))
+    tg = Tensor(data=ids.data[:, 1:].reshape(-1), device=ids.device,
+                requires_grad=False)
+    return autograd.fused_linear_cross_entropy(h, lm_head.W, tg, chunk_rows)
+
+
 # ---------------------------------------------------------------------------
 # BERT
 # ---------------------------------------------------------------------------

@@ -155,6 +155,18 @@ class Tensor:
     def to_numpy(self) -> np.ndarray:
         return np.asarray(self.data)
 
+    def __array__(self, dtype=None, copy=None) -> np.ndarray:
+        """numpy interop: np.asarray(t) fetches the buffer in one
+        device->host copy.  Without this, numpy falls back to
+        element-wise __getitem__ — thousands of autograd slice dispatches
+        for one conversion (the generate()-with-Tensor-prompt hang)."""
+        if copy is False:
+            raise ValueError(
+                "a device-backed Tensor cannot be converted to numpy "
+                "without a copy (np.asarray(..., copy=False))")
+        a = np.asarray(self.data)
+        return a.astype(dtype, copy=False) if dtype is not None else a
+
     def numpy(self) -> np.ndarray:
         return self.to_numpy()
 

@@ -388,3 +388,38 @@ def test_operator_class_count_reaches_lineage_parity():
              and issubclass(getattr(autograd, name), autograd.Operator)
              and getattr(autograd, name) is not autograd.Operator])
     assert n >= 90, f"only {n} Operator classes"
+
+
+def test_fused_linear_cross_entropy_matches_unfused():
+    """FusedLinearCrossEntropy == softmax_cross_entropy(matmul(h, W)):
+    value and gradients, including -1 padding targets and a row count
+    that does not divide the chunk size (exercises padding)."""
+    autograd.set_training(True)
+    rng = np.random.RandomState(0)
+    n, d, V = 37, 16, 50
+    h = rng.randn(n, d).astype(np.float32)
+    w = (rng.randn(d, V) * 0.1).astype(np.float32)
+    t = rng.randint(0, V, n).astype(np.int32)
+    t[5] = -1          # ignored row: zero loss, zero grad
+
+    def run(fused):
+        ht = tensor.Tensor(data=h.copy(), requires_grad=True, stores_grad=True)
+        wt = tensor.Tensor(data=w.copy(), requires_grad=True, stores_grad=True)
+        tt = tensor.Tensor(data=t, requires_grad=False)
+        if fused:
+            loss = autograd.fused_linear_cross_entropy(ht, wt, tt,
+                                                       chunk_rows=8)
+        else:
+            loss = autograd.softmax_cross_entropy(
+                autograd.matmul(ht, wt), tt)
+        grads = dict((id(p), g) for p, g in autograd.backward(loss))
+        return (float(loss.to_numpy()), grads[id(ht)].to_numpy(),
+                grads[id(wt)].to_numpy())
+
+    l_f, dh_f, dw_f = run(True)
+    l_u, dh_u, dw_u = run(False)
+    np.testing.assert_allclose(l_f, l_u, rtol=1e-5)
+    np.testing.assert_allclose(dh_f, dh_u, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(dw_f, dw_u, rtol=1e-4, atol=1e-6)
+    # the padding row's h-grad must be exactly zero
+    assert np.all(dh_f[5] == 0.0)

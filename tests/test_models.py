@@ -342,3 +342,23 @@ class TestBf16ComputePath:
         _, loss = m.train_step(ids, ids)
         assert np.isfinite(float(loss.to_numpy()))
         assert m.graph.compiled_hlo().count("bf16") > 50
+
+
+def test_llama_fused_loss_matches_unfused_trajectory():
+    """cfg.fused_loss (chunked lm-head+CE, no logits materialization)
+    must reproduce the unfused training trajectory."""
+    import dataclasses
+
+    def run(fused):
+        tensor.set_seed(0)
+        np.random.seed(0)
+        cfg = dataclasses.replace(models.LlamaConfig.tiny(),
+                                  fused_loss=fused)
+        m = models.Llama(cfg)
+        m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+        ids = tensor.from_numpy(np.random.randint(
+            0, cfg.vocab_size, (4, 32)).astype(np.int32))
+        m.compile([ids], is_train=True, use_graph=True)
+        return [float(m.train_step(ids)[1].to_numpy()) for _ in range(4)]
+
+    np.testing.assert_allclose(run(True), run(False), rtol=2e-4)

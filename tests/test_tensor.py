@@ -97,3 +97,15 @@ def test_device_roundtrip(cpu_dev):
     t = tensor.ones((2, 2), dev=cpu_dev)
     t.to_device(cpu_dev)
     assert t.device is cpu_dev
+
+
+def test_numpy_asarray_single_copy():
+    """np.asarray(Tensor) must hit __array__ (one device->host copy),
+    not element-wise __getitem__ — and accept a Tensor prompt-style
+    conversion with dtype."""
+    t = tensor.from_numpy(np.arange(12, dtype=np.int32).reshape(3, 4))
+    a = np.asarray(t)
+    assert a.shape == (3, 4) and a.dtype == np.int32
+    np.testing.assert_array_equal(a, np.arange(12).reshape(3, 4))
+    b = np.asarray(t, dtype=np.float32)
+    assert b.dtype == np.float32
