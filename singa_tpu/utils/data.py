@@ -25,9 +25,28 @@ class DataLoader:
     def __init__(self, x: np.ndarray, y: Optional[np.ndarray] = None,
                  batch_size: int = 32, shuffle: bool = True, seed: int = 0,
                  drop_last: bool = False, workers: int = 2,
-                 prefetch: int = 4, use_native: Optional[bool] = None):
-        self.x = np.asarray(x, np.float32)
-        self.y = np.asarray(y, np.int32) if y is not None else None
+                 prefetch: int = 4, use_native: Optional[bool] = None,
+                 rank: int = 0, world_size: int = 1):
+        """rank/world_size: multi-host data parallelism — each process
+        loads a contiguous shard of exactly floor(n/world_size) samples
+        (equal sizes across ranks, so every rank sees the same batch
+        count and shapes — synchronous collectives can't desync; up to
+        world_size-1 trailing samples are dropped per epoch).  The
+        reference DistOpt workflow partitions input by rank the same
+        way.  Defaults keep single-process behavior bit-identical."""
+        x = np.asarray(x, np.float32)
+        y = np.asarray(y, np.int32) if y is not None else None
+        if world_size < 1:
+            raise ValueError(f"world_size must be >= 1, got {world_size}")
+        if not (0 <= rank < world_size):
+            raise ValueError(f"rank {rank} outside world {world_size}")
+        if world_size > 1:
+            per = len(x) // world_size
+            lo = rank * per
+            x = x[lo:lo + per]
+            y = y[lo:lo + per] if y is not None else None
+        self.x = x
+        self.y = y
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.seed = seed

@@ -234,3 +234,28 @@ class TestResumeCorrectness:
         assert n_moments == 2 * n_params, "Adam m and v must both persist"
         assert aux["optimizer"]["step"] == 2
         assert len(aux["opt_slots"]) == n_params
+
+
+def test_dataloader_rank_sharding():
+    """rank/world_size shards are disjoint, exhaustive, and per-rank
+    deterministic."""
+    from singa_tpu.utils.data import DataLoader
+
+    x = np.arange(100, dtype=np.float32).reshape(100, 1)
+    y = np.arange(100, dtype=np.int32)
+    seen = []
+    for r in range(4):
+        dl = DataLoader(x, y, batch_size=8, shuffle=False, world_size=4,
+                        rank=r, use_native=False)
+        for bx, _ in dl:
+            seen.extend(bx[:, 0].astype(int).tolist())
+    assert sorted(seen) == list(range(100))
+    with pytest.raises(ValueError):
+        DataLoader(x, y, rank=4, world_size=4)
+    with pytest.raises(ValueError):
+        DataLoader(x, y, rank=3, world_size=1)   # bad rank, any world
+    # non-divisible n: every rank gets exactly floor(n/world) samples so
+    # batch counts and shapes agree across ranks (sync training safety)
+    sizes = [len(DataLoader(x[:65], y[:65], batch_size=32, world_size=2,
+                            rank=r, use_native=False).x) for r in range(2)]
+    assert sizes == [32, 32]
