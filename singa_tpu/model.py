@@ -364,6 +364,9 @@ class _StepExecutor:
             for n, restored in est.items():
                 if n not in self.slots:
                     continue
+                # structured slots (GradAccum's {"acc","base"}) are
+                # rebuilt by the optimizer's own load_slot_arrays; here
+                # structure must already match exactly
                 if not _slot_compatible(restored, self.slots[n]):
                     raise ValueError(
                         f"restored optimizer state for {n!r} does not fit "

@@ -33,7 +33,7 @@ from .tensor import Tensor
 
 __all__ = [
     "Optimizer", "SGD", "Adam", "AdamW", "RMSProp", "AdaGrad",
-    "DistOpt", "Constant", "ExponentialDecay", "CosineDecay",
+    "DistOpt", "GradAccum", "Constant", "ExponentialDecay", "CosineDecay",
     "WarmupCosine", "MultiStepLR",
 ]
 
@@ -173,6 +173,14 @@ class Optimizer:
     def set_states(self, s: Dict) -> None:
         self.step_counter = int(s.get("step", 0))
 
+    def state_signature(self) -> str:
+        """Identifies the slot STRUCTURE this optimizer produces.
+        Checkpoints carry it so a restore into a structurally-coincident
+        but different optimizer (e.g. Adam's (m, v) reinterpreted as
+        GradAccum's {acc, base}) is rejected instead of silently
+        corrupting the update."""
+        return type(self).__name__
+
     # -- moment persistence (checkpoint/resume correctness) -------------------
     # The graph executor mirrors its compiled-step slots into _eager_state
     # after every step, so _eager_state is the canonical host-visible store
@@ -220,6 +228,9 @@ class SGD(Optimizer):
 
     def _init_slot(self, p):
         return None if self.momentum == 0.0 else jnp.zeros_like(p)
+
+    def state_signature(self) -> str:
+        return f"SGD(momentum={bool(self.momentum)})"
 
     def apply(self, step, name, p, g, slot):
         lr = self.sched(step)
@@ -305,6 +316,74 @@ class AdaGrad(Optimizer):
             g = g + self.weight_decay * p
         acc = slot + g * g
         return (p - lr * g / (jnp.sqrt(acc) + self.eps)).astype(p.dtype), acc
+
+
+class GradAccum(Optimizer):
+    """Gradient accumulation over `every` microbatches (beyond the
+    reference surface; standard large-batch training on one chip).
+
+    Each train step adds the microbatch gradient into an accumulator
+    slot; every `every`-th step the wrapped optimizer applies the MEAN
+    accumulated gradient and the accumulator resets.  Both paths are
+    computed and `jnp.where`-selected, so the whole thing stays one
+    compiled module with no data-dependent control flow — the
+    accumulate-only steps cost elementwise work, not matmuls.
+
+    The wrapped optimizer's schedule sees the number of *applied*
+    updates (step // every), so LR decay is in optimizer-update units.
+    Composes with DistOpt: DistOpt(GradAccum(SGD(...), 4)) allreduces
+    each microbatch gradient, then accumulates the mean."""
+
+    def __init__(self, opt: Optimizer, every: int):
+        super().__init__(opt.sched)
+        if every < 1:
+            raise ValueError(f"every must be >= 1, got {every}")
+        self.opt = opt
+        self.every = int(every)
+
+    def init(self, params):
+        base = self.opt.init(params)
+        return {n: {"acc": jnp.zeros_like(p).astype(jnp.float32),
+                    "base": base.get(n)}
+                for n, p in params.items()}
+
+    def _init_slot(self, p):
+        return {"acc": jnp.zeros_like(p).astype(jnp.float32),
+                "base": self.opt._init_slot(p)}
+
+    def apply(self, step, name, p, g, slot):
+        k = self.every
+        acc = slot["acc"] + g.astype(jnp.float32)
+        do_upd = (step % k) == (k - 1)
+        upd_p, upd_base = self.opt.apply(step // k, name, p,
+                                         (acc / k).astype(p.dtype),
+                                         slot["base"])
+        sel = lambda a, b: jnp.where(do_upd, a, b)
+        new_p = sel(upd_p, p)
+        new_base = jax.tree.map(sel, upd_base, slot["base"]) \
+            if slot["base"] is not None else None
+        new_acc = jnp.where(do_upd, jnp.zeros_like(acc), acc)
+        return new_p, {"acc": new_acc, "base": new_base}
+
+    def state_signature(self) -> str:
+        return f"GradAccum({self.every})>{self.opt.state_signature()}"
+
+    def load_slot_arrays(self, slots: Dict[str, List]) -> None:
+        """Rebuild {"acc", "base"} dict slots from the checkpoint's flat
+        leaf lists (leaf 0 is the accumulator; the rest reconstruct the
+        wrapped optimizer's slot generically) — both the eager path and
+        the graph executor then see the structure GradAccum.apply needs."""
+        est = {}
+        for name, leaves in slots.items():
+            arrs = [jnp.asarray(l) for l in leaves]
+            if not arrs:
+                raise ValueError(
+                    f"GradAccum slot for {name!r} is empty in checkpoint")
+            rest = arrs[1:]
+            base = (None if not rest
+                    else rest[0] if len(rest) == 1 else tuple(rest))
+            est[name] = {"acc": arrs[0], "base": base}
+        self._eager_state = est
 
 
 # ---------------------------------------------------------------------------
@@ -407,6 +486,10 @@ class DistOpt(Optimizer):
     def set_states(self, s: Dict) -> None:
         super().set_states(s)
         self.opt.set_states(s)
+
+    def state_signature(self) -> str:
+        # DistOpt adds no slot structure of its own
+        return self.opt.state_signature()
 
     def slot_arrays(self) -> Dict[str, List]:
         # eager updates fill the inner opt's store; the graph executor

@@ -99,6 +99,7 @@ def _collect(model, aux_states: Optional[Dict]):
     opt = getattr(model, "optimizer", None)
     if opt is not None:
         aux["optimizer"] = opt.get_states()
+        aux["opt_signature"] = opt.state_signature()
         slot_arrays = opt.slot_arrays()
         manifest: List = []
         i = 0
@@ -130,15 +131,26 @@ def save_states(model, fpath: str, aux_states: Optional[Dict] = None) -> None:
 
 
 def _apply(model, arrays: Dict, aux: Dict) -> None:
+    opt = getattr(model, "optimizer", None)
+    manifest = aux.get("opt_slots")
+    saved_sig = aux.get("opt_signature")
+    if opt is not None and manifest is not None and saved_sig is not None \
+            and saved_sig != opt.state_signature():
+        # leaf counts/shapes can coincide across optimizers (Adam's
+        # (m, v) vs GradAccum's {acc, base}) — structure alone cannot
+        # catch that, the signature can.  Checked BEFORE any mutation so
+        # a rejected restore leaves the model untouched.
+        raise ValueError(
+            f"checkpoint optimizer state is {saved_sig!r} but the model "
+            f"optimizer is {opt.state_signature()!r} — refusing to "
+            f"reinterpret moments across optimizers")
     opt_arrays = {k: v for k, v in arrays.items() if k.startswith(_OPT_PREFIX)}
     model.set_states({k: v for k, v in arrays.items()
                       if not k.startswith(_OPT_PREFIX)})
-    opt = getattr(model, "optimizer", None)
     if opt is None:
         return
     if "optimizer" in aux:
         opt.set_states(aux["optimizer"])
-    manifest = aux.get("opt_slots")
     if manifest is not None:
         slots, i = {}, 0
         for name, n_leaves in manifest:

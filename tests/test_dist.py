@@ -324,11 +324,12 @@ def test_restore_mismatched_optimizer_state_raises(tmp_path):
 
     tensor.set_seed(0)
     m2 = models.MLP(perceptron_size=16, num_classes=4)
-    m2.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))  # different slot shape
+    m2.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))  # different optimizer
     m2.compile([x], is_train=True, use_graph=True)
-    ck.restore_latest(m2)
-    with pytest.raises(ValueError, match="does not fit"):
-        m2.train_step(x, y)
+    # the signature guard now rejects at restore time (earlier and
+    # clearer than the former shape mismatch at the first train_step)
+    with pytest.raises(ValueError, match="refusing to reinterpret"):
+        ck.restore_latest(m2)
 
 
 def test_two_batch_shapes_no_donated_slot_aliasing():
@@ -400,3 +401,41 @@ def test_zero1_checkpoint_resume_natural_shapes(tmp_path):
     _, ls1 = m.train_step(tx, ty)
     np.testing.assert_allclose(float(ls1.to_numpy()), float(ls2.to_numpy()),
                                rtol=2e-4)
+
+
+def test_grad_accum_composes_with_distopt():
+    """DistOpt(GradAccum(sgd, 2)) on the DP8 mesh: 2 accumulated DP
+    steps == 1 single-device step on the doubled batch."""
+    x, y = _data(128, seed=9)
+
+    def big():
+        parallel.set_mesh(None)
+        tensor.set_seed(4)
+        m = MLP()
+        m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+        m.compile([tensor.from_numpy(x)], is_train=True, use_graph=True)
+        m.train_step(tensor.from_numpy(x), tensor.from_numpy(y))
+        return m
+
+    def accum_dp():
+        parallel.set_mesh(parallel.data_parallel_mesh(8))
+        try:
+            tensor.set_seed(4)
+            m = MLP()
+            m.set_optimizer(opt.DistOpt(opt.GradAccum(
+                opt.SGD(lr=0.1, momentum=0.9), 2)))
+            xs, ys = np.split(x, 2), np.split(y, 2)
+            m.compile([tensor.from_numpy(xs[0])], is_train=True,
+                      use_graph=True)
+            for i in range(2):
+                m.train_step(tensor.from_numpy(xs[i]),
+                             tensor.from_numpy(ys[i]))
+            return m
+        finally:
+            parallel.set_mesh(None)
+
+    mb, ma = big(), accum_dp()
+    for (n1, p1), (n2, p2) in zip(sorted(mb.get_params().items()),
+                                  sorted(ma.get_params().items())):
+        np.testing.assert_allclose(p1.to_numpy(), p2.to_numpy(),
+                                   rtol=1e-5, atol=1e-6, err_msg=n1)

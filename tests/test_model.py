@@ -272,3 +272,124 @@ def test_jit_init_falls_back_to_eager_on_untraceable_forward(monkeypatch):
     assert any("jit-init" in str(x.message) for x in w)
     _, ls = m.train_step(x, y)
     assert np.isfinite(float(ls.to_numpy()))
+
+
+def test_grad_accum_equals_big_batch():
+    """GradAccum(base, k) over k microbatches must land on the same
+    params as one base-optimizer step on the concatenated batch."""
+    tensor.set_seed(21)
+    np.random.seed(21)
+    x, y = make_blobs(n=64)
+    k = 4
+
+    def build():
+        tensor.set_seed(5)
+        m = MLP()
+        return m
+
+    # reference: one SGD-momentum step on the full batch
+    m_big = build()
+    m_big.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+    m_big.compile([tensor.from_numpy(x)], is_train=True, use_graph=True)
+    m_big.train_step(tensor.from_numpy(x), tensor.from_numpy(y))
+
+    # accumulated: k steps on k disjoint microbatches
+    m_acc = build()
+    m_acc.set_optimizer(opt.GradAccum(opt.SGD(lr=0.1, momentum=0.9), k))
+    xs = np.split(x, k)
+    ys = np.split(y, k)
+    m_acc.compile([tensor.from_numpy(xs[0])], is_train=True, use_graph=True)
+    for i in range(k):
+        m_acc.train_step(tensor.from_numpy(xs[i]), tensor.from_numpy(ys[i]))
+
+    for (n1, p1), (n2, p2) in zip(sorted(m_big.get_params().items()),
+                                  sorted(m_acc.get_params().items())):
+        np.testing.assert_allclose(p1.to_numpy(), p2.to_numpy(),
+                                   rtol=1e-5, atol=1e-6, err_msg=n1)
+    # params must be untouched on non-boundary steps
+    m_chk = build()
+    m_chk.set_optimizer(opt.GradAccum(opt.SGD(lr=0.1), 3))
+    m_chk.compile([tensor.from_numpy(xs[0])], is_train=True, use_graph=True)
+    before = {n: p.to_numpy().copy() for n, p in m_chk.get_params().items()}
+    m_chk.train_step(tensor.from_numpy(xs[0]), tensor.from_numpy(ys[0]))
+    after = {n: p.to_numpy() for n, p in m_chk.get_params().items()}
+    for n in before:
+        np.testing.assert_array_equal(before[n], after[n], err_msg=n)
+
+
+def test_grad_accum_resume_mid_accumulation(tmp_path):
+    """Checkpointing between microbatches must preserve the gradient
+    accumulator: restored run == uninterrupted run."""
+    tensor.set_seed(31)
+    np.random.seed(31)
+    x, y = make_blobs(n=48)
+    xs, ys = np.split(x, 3), np.split(y, 3)
+
+    def build():
+        tensor.set_seed(8)
+        m = MLP()
+        m.set_optimizer(opt.GradAccum(opt.SGD(lr=0.1, momentum=0.9), 3))
+        m.compile([tensor.from_numpy(xs[0])], is_train=True, use_graph=True)
+        return m
+
+    m1 = build()
+    m1.train_step(tensor.from_numpy(xs[0]), tensor.from_numpy(ys[0]))
+    path = str(tmp_path / "mid.npz")
+    m1.save_states(path)                      # acc holds 1 microbatch
+    for i in (1, 2):
+        m1.train_step(tensor.from_numpy(xs[i]), tensor.from_numpy(ys[i]))
+
+    m2 = build()
+    m2.load_states(path)
+    for i in (1, 2):
+        m2.train_step(tensor.from_numpy(xs[i]), tensor.from_numpy(ys[i]))
+
+    for (n1, p1), (n2, p2) in zip(sorted(m1.get_params().items()),
+                                  sorted(m2.get_params().items())):
+        np.testing.assert_allclose(p1.to_numpy(), p2.to_numpy(),
+                                   rtol=1e-5, atol=1e-7, err_msg=n1)
+
+
+def test_checkpoint_rejects_cross_optimizer_moments(tmp_path):
+    """Adam moments must not be silently reinterpreted as GradAccum
+    state (leaf counts/shapes coincide; the signature catches it)."""
+    tensor.set_seed(41)
+    np.random.seed(41)
+    x, y = make_blobs(n=16)
+    m = MLP()
+    m.set_optimizer(opt.Adam(lr=1e-3))
+    m.compile([tensor.from_numpy(x)], is_train=True, use_graph=True)
+    m.train_step(tensor.from_numpy(x), tensor.from_numpy(y))
+    path = str(tmp_path / "adam.npz")
+    m.save_states(path)
+
+    m2 = MLP()
+    m2.set_optimizer(opt.GradAccum(opt.SGD(lr=0.1, momentum=0.9), 2))
+    m2.compile([tensor.from_numpy(x)], is_train=True, use_graph=True)
+    with pytest.raises(ValueError, match="refusing to reinterpret"):
+        m2.load_states(path)
+
+
+def test_grad_accum_eager_resume(tmp_path):
+    """Eager (use_graph=False) GradAccum training must also resume:
+    load_slot_arrays rebuilds the {'acc','base'} dict structure."""
+    tensor.set_seed(51)
+    np.random.seed(51)
+    x, y = make_blobs(n=16)
+    m = MLP()
+    m.set_optimizer(opt.GradAccum(opt.SGD(lr=0.1, momentum=0.9), 2))
+    m.compile([tensor.from_numpy(x)], is_train=True, use_graph=False)
+    m.train_step(tensor.from_numpy(x), tensor.from_numpy(y))
+    path = str(tmp_path / "ea.npz")
+    m.save_states(path)
+    m.train_step(tensor.from_numpy(x), tensor.from_numpy(y))
+
+    m2 = MLP()
+    m2.set_optimizer(opt.GradAccum(opt.SGD(lr=0.1, momentum=0.9), 2))
+    m2.compile([tensor.from_numpy(x)], is_train=True, use_graph=False)
+    m2.load_states(path)
+    m2.train_step(tensor.from_numpy(x), tensor.from_numpy(y))
+    for (n1, p1), (n2, p2) in zip(sorted(m.get_params().items()),
+                                  sorted(m2.get_params().items())):
+        np.testing.assert_allclose(p1.to_numpy(), p2.to_numpy(),
+                                   rtol=1e-5, atol=1e-7, err_msg=n1)
