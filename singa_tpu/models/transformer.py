@@ -84,6 +84,9 @@ class GPT2Config:
     num_layers: int = 12
     num_heads: int = 12
     dropout: float = 0.1
+    # opt-in chunked fused tied-head+CE loss (no (B*T, V) logits;
+    # train_one_batch then returns (loss, loss) instead of (logits, loss))
+    fused_loss: bool = False
 
     @staticmethod
     def tiny() -> "GPT2Config":
@@ -128,7 +131,9 @@ class GPT2(GenerateMixin, model.Model):
         self.blocks = [_GPT2Block(c) for _ in range(c.num_layers)]
         self.ln_f = layer.LayerNorm(c.dim)
 
-    def forward(self, ids: Tensor, attention_mask: Optional[Tensor] = None):
+    def features(self, ids: Tensor,
+                 attention_mask: Optional[Tensor] = None) -> Tensor:
+        """Final hidden states (B, T, dim) — everything but the tied head."""
         mask = _padding_mask(attention_mask)
         if mask is not None:
             mask = Tensor(data=mask, device=ids.device, requires_grad=False)
@@ -136,17 +141,29 @@ class GPT2(GenerateMixin, model.Model):
         x = self.drop(x)
         for blk in self.blocks:
             x = blk(x, mask)
-        x = self.ln_f(x)
-        # tied LM head: logits = x @ wte.T (table cast to the compute
-        # dtype so bf16 activations don't promote back to f32)
+        return self.ln_f(x)
+
+    def _tied_head_w(self, x: Tensor) -> Tensor:
+        # tied LM head weight: wte.T, cast to the compute dtype so bf16
+        # activations don't promote back to f32
         w = self.wte.table
         if w.dtype != x.dtype:
             w = autograd.cast(w, x.dtype)
-        return autograd.matmul(x, autograd.transpose(w))
+        return autograd.transpose(w)
+
+    def forward(self, ids: Tensor, attention_mask: Optional[Tensor] = None):
+        x = self.features(ids, attention_mask)
+        return autograd.matmul(x, self._tied_head_w(x))
 
     def train_one_batch(self, ids: Tensor, labels: Optional[Tensor] = None):
+        tgt = labels if labels is not None else ids
+        if self.cfg.fused_loss:
+            x = self.features(ids)
+            loss = next_token_loss_fused_w(x, self._tied_head_w(x), tgt)
+            self.optimizer(loss)
+            return loss, loss
         logits = self.forward(ids)
-        loss = next_token_loss(logits, labels if labels is not None else ids)
+        loss = next_token_loss(logits, tgt)
         self.optimizer(loss)
         return logits, loss
 
@@ -176,10 +193,7 @@ class GPT2(GenerateMixin, model.Model):
             x, nc = blk(x, None, cache, pos)
             new_caches.append(nc)
         x = self.ln_f(x)
-        w = self.wte.table
-        if w.dtype != x.dtype:
-            w = autograd.cast(w, x.dtype)
-        return autograd.matmul(x, autograd.transpose(w)), new_caches
+        return autograd.matmul(x, self._tied_head_w(x)), new_caches
 
 
 def next_token_loss(logits: Tensor, ids: Tensor) -> Tensor:
@@ -191,20 +205,29 @@ def next_token_loss(logits: Tensor, ids: Tensor) -> Tensor:
     return autograd.softmax_cross_entropy(lg, tg)
 
 
-def next_token_loss_fused(x: Tensor, lm_head, ids: Tensor,
-                          chunk_rows: int = 512) -> Tensor:
-    """Causal-LM loss straight from the final hidden states: the lm-head
-    matmul and softmax-CE run fused + row-chunked
-    (autograd.fused_linear_cross_entropy), so the (B*T, V) logits are
-    never materialized — the memory-lean large-vocab loss path."""
+def next_token_loss_fused_w(x: Tensor, w: Tensor, ids: Tensor,
+                            chunk_rows: int = 512) -> Tensor:
+    """Causal-LM loss straight from the final hidden states against an
+    explicit (dim, V) head weight: the matmul and softmax-CE run fused +
+    row-chunked (autograd.fused_linear_cross_entropy), so the (B*T, V)
+    logits are never materialized — the memory-lean large-vocab path.
+    `w` may be any differentiable Tensor (e.g. a transposed tied
+    embedding table); gradients flow through it."""
     B, T, d = x.shape
-    if not lm_head._initialized:          # fused path skips lm_head(...)
-        lm_head.initialize(x)
-        lm_head._initialized = True
     h = autograd.reshape(x[:, :-1, :], (B * (T - 1), d))
     tg = Tensor(data=ids.data[:, 1:].reshape(-1), device=ids.device,
                 requires_grad=False)
-    return autograd.fused_linear_cross_entropy(h, lm_head.W, tg, chunk_rows)
+    return autograd.fused_linear_cross_entropy(h, w, tg, chunk_rows)
+
+
+def next_token_loss_fused(x: Tensor, lm_head, ids: Tensor,
+                          chunk_rows: int = 512) -> Tensor:
+    """next_token_loss_fused_w against a (possibly lazily-initialized)
+    Linear lm-head layer."""
+    if not lm_head._initialized:          # fused path skips lm_head(...)
+        lm_head.initialize(x)
+        lm_head._initialized = True
+    return next_token_loss_fused_w(x, lm_head.W, ids, chunk_rows)
 
 
 # ---------------------------------------------------------------------------

@@ -13,7 +13,7 @@ import numpy as np
 
 from .. import _core
 
-__all__ = ["DataLoader", "synthetic_dataset"]
+__all__ = ["DataLoader", "prefetch_to_device", "synthetic_dataset"]
 
 
 class DataLoader:
@@ -91,6 +91,46 @@ class DataLoader:
         if self._native is not None:
             self._native.close()
             self._native = None
+
+
+def prefetch_to_device(it, size: int = 2, device=None):
+    """Overlap host->device transfer with device compute: keep `size`
+    batches in flight as device arrays ahead of the consumer.
+
+    XLA dispatch is async, so `jax.device_put` returns immediately and
+    the DMA proceeds while the previous step computes — the train loop
+    then never stalls on input transfer (the classic TPU input-pipeline
+    pattern).  Works on tuples/lists/dicts of numpy arrays (None
+    passthrough); yields the same structure with jax arrays."""
+    import collections
+
+    import jax
+
+    dev = device
+    if dev is None:
+        from .. import device as device_mod
+        dev = device_mod.get_default_device()
+    jdev = dev.jax_devices[0] if hasattr(dev, "jax_devices") else dev
+
+    def put(batch):
+        return jax.tree.map(
+            lambda a: a if a is None else jax.device_put(a, jdev), batch,
+            is_leaf=lambda a: a is None)
+
+    q = collections.deque()
+    it = iter(it)
+    try:
+        for _ in range(max(1, size)):
+            q.append(put(next(it)))
+    except StopIteration:
+        pass
+    while q:
+        out = q.popleft()
+        try:
+            q.append(put(next(it)))
+        except StopIteration:
+            pass
+        yield out
 
 
 def synthetic_dataset(kind: str = "blobs", n: int = 1024, classes: int = 10,

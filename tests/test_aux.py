@@ -259,3 +259,24 @@ def test_dataloader_rank_sharding():
     sizes = [len(DataLoader(x[:65], y[:65], batch_size=32, world_size=2,
                             rank=r, use_native=False).x) for r in range(2)]
     assert sizes == [32, 32]
+
+
+def test_prefetch_to_device():
+    """prefetch_to_device keeps batch order/content and yields device
+    arrays; short iterators (fewer batches than the window) drain."""
+    import jax
+
+    from singa_tpu.utils.data import DataLoader, prefetch_to_device
+
+    x = np.arange(40, dtype=np.float32).reshape(40, 1)
+    y = np.arange(40, dtype=np.int32)
+    dl = DataLoader(x, y, batch_size=8, shuffle=False, use_native=False)
+    seen = []
+    for bx, by in prefetch_to_device(dl, size=3):
+        assert isinstance(bx, jax.Array)
+        seen.extend(np.asarray(bx)[:, 0].astype(int).tolist())
+    assert seen == list(range(40))
+    # shorter than the prefetch window
+    dl2 = DataLoader(x[:8], y[:8], batch_size=8, use_native=False,
+                     shuffle=False)
+    assert len(list(prefetch_to_device(dl2, size=4))) == 1

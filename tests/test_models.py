@@ -362,3 +362,23 @@ def test_llama_fused_loss_matches_unfused_trajectory():
         return [float(m.train_step(ids)[1].to_numpy()) for _ in range(4)]
 
     np.testing.assert_allclose(run(True), run(False), rtol=2e-4)
+
+
+def test_gpt2_fused_loss_matches_unfused_trajectory():
+    """GPT2Config.fused_loss (tied-head chunked CE) must reproduce the
+    unfused trajectory, gradients flowing through the tied embedding."""
+    import dataclasses
+
+    def run(fused):
+        tensor.set_seed(0)
+        np.random.seed(0)
+        cfg = dataclasses.replace(models.GPT2Config.tiny(),
+                                  fused_loss=fused)
+        m = models.GPT2(cfg)
+        m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+        ids = tensor.from_numpy(np.random.randint(
+            0, cfg.vocab_size, (4, 32)).astype(np.int32))
+        m.compile([ids], is_train=True, use_graph=True)
+        return [float(m.train_step(ids)[1].to_numpy()) for _ in range(4)]
+
+    np.testing.assert_allclose(run(True), run(False), rtol=2e-4)
