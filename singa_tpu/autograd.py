@@ -1700,6 +1700,24 @@ class Tile(Operator):
         return jnp.tile(a, self.reps)
 
 
+class Repeat(Operator):
+    def __init__(self, repeats, axis):
+        super().__init__()
+        self.repeats, self.axis = repeats, axis
+
+    def fwd(self, a):
+        return jnp.repeat(a, self.repeats, axis=self.axis)
+
+
+class TensorDot(Operator):
+    def __init__(self, axes):
+        super().__init__()
+        self.axes = axes
+
+    def fwd(self, a, b):
+        return jnp.tensordot(a, b, axes=self.axes)
+
+
 class Expand(Operator):
     def __init__(self, shape):
         super().__init__()
@@ -1782,6 +1800,8 @@ def hardsigmoid(a, alpha=0.2, beta=0.5): return HardSigmoid(alpha, beta)(a)
 def hardswish(a): return HardSwish()(a)
 def mish(a): return Mish()(a)
 def tile(a, reps): return Tile(reps)(a)
+def repeat(a, repeats, axis=None): return Repeat(repeats, axis)(a)
+def tensordot(a, b, axes=2): return TensorDot(axes)(a, _as_t(b, a))
 def expand(a, shape): return Expand(shape)(a)
 def onehot(ids, depth, axis=-1): return OneHot(depth, axis)(ids)
 def cumsum(a, axis=0): return CumSum(axis)(a)
@@ -1797,4 +1817,5 @@ __all__ += [
     "less", "less_equal", "logical_and", "logical_or", "logical_xor",
     "logical_not", "prelu", "selu", "hardsigmoid", "hardswish", "mish",
     "tile", "expand", "onehot", "cumsum", "reduce_prod", "shape_of",
+    "repeat", "tensordot",
 ]

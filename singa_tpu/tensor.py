@@ -35,6 +35,9 @@ __all__ = [
     "mean", "max", "min", "argmax", "argmin", "clip", "einsum",
     "copy_data_to_from", "default_float", "sum_all",
     "softmax", "lt", "le", "gt", "ge", "eq",
+    "eltwise_mult", "axpy", "add_column", "add_row", "sum_columns",
+    "sum_rows", "tensordot", "batchmatmul", "repeat", "ceil", "floor",
+    "round",
 ]
 
 # lazy: creating a PRNGKey initializes the JAX backend, and importing
@@ -434,8 +437,76 @@ def mul(a, b):
     return _ag().mul(a, b)
 
 
-# reference names eltwise_mult `mult` in places
-mult = mul
+def mult(a, b):
+    """Reference semantics: `tensor.mult` is MATRIX multiplication
+    (GEMM/GEMV); the elementwise product is `eltwise_mult`."""
+    return _ag().matmul(a, b)
+
+
+def eltwise_mult(a, b):
+    return _ag().mul(a, b)
+
+
+def axpy(alpha: float, x: Tensor, y: Tensor) -> Tensor:
+    """y += alpha * x in the reference's in-place style (rebinds y's
+    buffer; returns y).  BLAS semantics: shapes must match exactly."""
+    if tuple(x.shape) != tuple(y.shape):
+        raise ValueError(f"axpy shape mismatch: x {x.shape} vs y {y.shape}")
+    y.data = (y.data + alpha * x.data).astype(y.dtype)
+    return y
+
+
+def add_column(v: Tensor, m: Tensor) -> Tensor:
+    """Add column vector v to every column of matrix m (in place)."""
+    if m.ndim != 2 or v.size != m.shape[0]:
+        raise ValueError(
+            f"add_column needs v of length rows(m): v {v.shape}, m {m.shape}")
+    m.data = (m.data + v.data.reshape(-1, 1)).astype(m.dtype)
+    return m
+
+
+def add_row(v: Tensor, m: Tensor) -> Tensor:
+    """Add row vector v to every row of matrix m (in place)."""
+    if m.ndim != 2 or v.size != m.shape[1]:
+        raise ValueError(
+            f"add_row needs v of length cols(m): v {v.shape}, m {m.shape}")
+    m.data = (m.data + v.data.reshape(1, -1)).astype(m.dtype)
+    return m
+
+
+def sum_columns(m: Tensor) -> Tensor:
+    """Sum over columns: (r, c) -> (r,)."""
+    return _ag().reduce_sum(m, axis=1)
+
+
+def sum_rows(m: Tensor) -> Tensor:
+    """Sum over rows: (r, c) -> (c,)."""
+    return _ag().reduce_sum(m, axis=0)
+
+
+def tensordot(a, b, axes=2):
+    return _ag().tensordot(a, b, axes)
+
+
+def batchmatmul(a, b):
+    """Batched matmul over leading dims (reference name)."""
+    return _ag().matmul(a, b)
+
+
+def repeat(t, repeats, axis=None):
+    return _ag().repeat(t, repeats, axis)
+
+
+def ceil(t):
+    return _ag().ceil(t)
+
+
+def floor(t):
+    return _ag().floor(t)
+
+
+def round(t):  # noqa: A001 - reference op name
+    return _ag().round(t)
 
 
 def div(a, b):

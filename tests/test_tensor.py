@@ -109,3 +109,52 @@ def test_numpy_asarray_single_copy():
     np.testing.assert_array_equal(a, np.arange(12).reshape(3, 4))
     b = np.asarray(t, dtype=np.float32)
     assert b.dtype == np.float32
+
+
+def test_reference_module_api_parity():
+    """Lineage `singa.tensor` module functions: mult is MATRIX multiply
+    (eltwise_mult is the elementwise one), axpy/add_column/add_row are
+    in-place, sum_columns/sum_rows reduce the named dimension."""
+    from singa_tpu import autograd
+    autograd.set_training(True)
+    A = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    B = np.random.RandomState(1).randn(4, 5).astype(np.float32)
+    ta, tb = tensor.from_numpy(A), tensor.from_numpy(B)
+    np.testing.assert_allclose(tensor.mult(ta, tb).to_numpy(), A @ B,
+                               rtol=1e-5)
+    np.testing.assert_allclose(tensor.eltwise_mult(ta, ta).to_numpy(),
+                               A * A, rtol=1e-5)
+    y = tensor.from_numpy(A.copy())
+    tensor.axpy(0.5, ta, y)
+    np.testing.assert_allclose(y.to_numpy(), 1.5 * A, rtol=1e-6)
+    m = tensor.from_numpy(A.copy())
+    tensor.add_column(tensor.from_numpy(np.ones(3, np.float32)), m)
+    np.testing.assert_allclose(m.to_numpy(), A + 1.0)
+    m2 = tensor.from_numpy(A.copy())
+    tensor.add_row(tensor.from_numpy(np.ones(4, np.float32)), m2)
+    np.testing.assert_allclose(m2.to_numpy(), A + 1.0)
+    np.testing.assert_allclose(tensor.sum_rows(ta).to_numpy(), A.sum(0),
+                               rtol=1e-5)
+    np.testing.assert_allclose(tensor.sum_columns(ta).to_numpy(), A.sum(1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        tensor.tensordot(ta, tb, axes=1).to_numpy(), A @ B, rtol=1e-5)
+    np.testing.assert_allclose(
+        tensor.repeat(tensor.from_numpy(np.array([1., 2.], np.float32)),
+                      2).to_numpy(), [1, 1, 2, 2])
+    for fn, ref in ((tensor.ceil, np.ceil), (tensor.floor, np.floor),
+                    (tensor.round, np.round)):
+        v = np.array([1.2, -0.7, 2.5], np.float32)
+        np.testing.assert_allclose(fn(tensor.from_numpy(v)).to_numpy(),
+                                   ref(v))
+
+
+def test_inplace_module_fns_reject_shape_mismatch():
+    a = tensor.from_numpy(np.ones((3, 4), np.float32))
+    b = tensor.from_numpy(np.ones(4, np.float32))
+    with pytest.raises(ValueError):
+        tensor.axpy(0.5, a, b)
+    with pytest.raises(ValueError):
+        tensor.add_column(b, a)      # needs length-3 for a's rows
+    with pytest.raises(ValueError):
+        tensor.add_row(tensor.from_numpy(np.ones(3, np.float32)), a)
