@@ -16,6 +16,7 @@ into the executable as constants.
 
 from __future__ import annotations
 
+import functools
 from contextlib import contextmanager
 from typing import Dict, Optional
 
@@ -84,10 +85,32 @@ def _dev(model):
     return model_device(model)
 
 
-def _pick(logits, temperature: float, rng_key):
-    if temperature and temperature > 0.0:
-        return jax.random.categorical(rng_key, logits / temperature, axis=-1)
-    return jnp.argmax(logits, axis=-1)
+@functools.partial(jax.jit, static_argnums=(1, 3, 4))
+def _pick(logits, temperature: float, rng_key, top_k: Optional[int],
+          top_p: Optional[float]):
+    """Greedy (temperature 0) or sampled pick with optional top-k /
+    nucleus (top-p) filtering.  Jitted with the controls static so the
+    whole selection is ONE dispatch per decoded token — eager filtering
+    would reintroduce the per-token round-trip cost the compiled
+    prefill/decode design exists to avoid."""
+    if not temperature or temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    lg = logits.astype(jnp.float32) / temperature
+    if top_k is not None and 0 < top_k < lg.shape[-1]:
+        kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
+        lg = jnp.where(lg < kth, -jnp.inf, lg)
+    if top_p is not None and 0.0 < top_p < 1.0:
+        sorted_lg = jnp.sort(lg, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_lg, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative mass >= top_p (the
+        # first token is always kept); the cutoff is the SMALLEST kept
+        # logit — everything below it is masked
+        keep = cum - probs < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_lg, jnp.inf), axis=-1,
+                         keepdims=True)
+        lg = jnp.where(lg < cutoff, -jnp.inf, lg)
+    return jax.random.categorical(rng_key, lg, axis=-1)
 
 
 class GenerateMixin:
@@ -96,8 +119,10 @@ class GenerateMixin:
 
     def generate(self, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, seed: int = 0,
-                 eos_id: Optional[int] = None) -> np.ndarray:
-        """Greedy (temperature=0) or sampled decoding.
+                 eos_id: Optional[int] = None, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None) -> np.ndarray:
+        """Greedy (temperature=0) or sampled decoding, with optional
+        top-k and/or nucleus (top-p) filtering when sampling.
 
         prompt_ids: int array (B, P). Always returns (B, P +
         max_new_tokens) — static shape. When `eos_id` is given and every
@@ -135,7 +160,7 @@ class GenerateMixin:
         done = np.zeros((B,), bool)
         for i in range(max_new_tokens):
             rng, sub = jax.random.split(rng)
-            tok = _pick(logits, temperature, sub)
+            tok = _pick(logits, temperature, sub, top_k, top_p)
             out[:, P + i] = np.asarray(tok)
             if eos_id is not None:
                 done |= out[:, P + i] == eos_id

@@ -382,3 +382,49 @@ def test_gpt2_fused_loss_matches_unfused_trajectory():
         return [float(m.train_step(ids)[1].to_numpy()) for _ in range(4)]
 
     np.testing.assert_allclose(run(True), run(False), rtol=2e-4)
+
+
+class TestSamplingControls:
+    def _model(self):
+        tensor.set_seed(0)
+        np.random.seed(0)
+        m = models.Llama(models.LlamaConfig.tiny())
+        prompt = np.random.RandomState(1).randint(0, 256, (2, 8)).astype(np.int32)
+        m.compile([tensor.from_numpy(prompt)], is_train=False, use_graph=False)
+        return m, prompt
+
+    def test_top_k_one_equals_greedy(self):
+        m, prompt = self._model()
+        greedy = m.generate(prompt, max_new_tokens=5)
+        k1 = m.generate(prompt, max_new_tokens=5, temperature=0.7,
+                        top_k=1, seed=3)
+        np.testing.assert_array_equal(greedy, k1)
+
+    def test_top_p_restricts_support(self):
+        """With tiny top_p, sampling must collapse to (near-)greedy:
+        the nucleus keeps at least the argmax token."""
+        m, prompt = self._model()
+        greedy = m.generate(prompt, max_new_tokens=5)
+        p_tiny = m.generate(prompt, max_new_tokens=5, temperature=1.5,
+                            top_p=1e-6, seed=11)
+        np.testing.assert_array_equal(greedy, p_tiny)
+
+    def test_sampling_reproducible_and_valid(self):
+        m, prompt = self._model()
+        a = m.generate(prompt, max_new_tokens=6, temperature=0.9,
+                       top_k=40, top_p=0.95, seed=5)
+        b = m.generate(prompt, max_new_tokens=6, temperature=0.9,
+                       top_k=40, top_p=0.95, seed=5)
+        np.testing.assert_array_equal(a, b)
+        assert a.shape == (2, 14)
+        assert (a >= 0).all() and (a < 256).all()
+
+    def test_top_p_wide_nucleus_actually_samples(self):
+        """Regression: a wide nucleus (near-uniform logits, top_p=0.9)
+        must NOT collapse to greedy — the r3 cutoff bug masked all but
+        the argmax."""
+        m, prompt = self._model()
+        greedy = m.generate(prompt, max_new_tokens=8)
+        outs = [m.generate(prompt, max_new_tokens=8, temperature=1.0,
+                           top_p=0.9, seed=s) for s in (1, 2, 3)]
+        assert any(not np.array_equal(greedy, o) for o in outs)
