@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_with_lse"]
 
 _NEG_INF = -1e30
 
@@ -227,7 +227,7 @@ def _fwd(q, k, v, causal, scale, interpret):
     return o, lse
 
 
-def _bwd(q, k, v, o, lse, do, causal, scale, interpret):
+def _bwd(q, k, v, o, lse, do, causal, scale, interpret, dlse=None):
     B, H, Tq, D = q.shape
     K, Tk = k.shape[1], k.shape[2]
     G = H // K
@@ -235,6 +235,10 @@ def _bwd(q, k, v, o, lse, do, causal, scale, interpret):
     off = Tk - Tq
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
                     keepdims=True)                        # (B, H, Tq, 1)
+    if dlse is not None:
+        # lse cotangent folds into delta: ds = p * (dp - delta + dlse)
+        # (∂lse_i/∂s_ij = p_ij), so delta_eff = delta - dlse
+        delta = delta - dlse.reshape(B, H, Tq, 1).astype(jnp.float32)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -298,23 +302,51 @@ def _bwd(q, k, v, o, lse, do, causal, scale, interpret):
 # custom-vjp core in (B, H, T, D) layout
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash_core(q, k, v, causal, scale, interpret):
-    o, _ = _fwd(q, k, v, causal, scale, interpret)
-    return o
+    """o-only view over the (o, lse) core; the lse cotangent is zeros,
+    which _bwd folds in for free (delta - 0)."""
+    return _flash_core_lse(q, k, v, causal, scale, interpret)[0]
 
 
-def _flash_core_fwd(q, k, v, causal, scale, interpret):
+# -- (o, lse) core: also the building block for cross-chip ring attention --
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_core_lse(q, k, v, causal, scale, interpret):
+    return _fwd(q, k, v, causal, scale, interpret)
+
+
+def _flash_core_lse_fwd(q, k, v, causal, scale, interpret):
     o, lse = _fwd(q, k, v, causal, scale, interpret)
-    return o, (q, k, v, o, lse)
+    return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_core_bwd(causal, scale, interpret, res, do):
+def _flash_core_lse_bwd(causal, scale, interpret, res, cots):
     q, k, v, o, lse = res
-    return _bwd(q, k, v, o, lse, do, causal, scale, interpret)
+    do, dlse = cots
+    return _bwd(q, k, v, o, lse, do, causal, scale, interpret, dlse=dlse)
 
 
-_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+_flash_core_lse.defvjp(_flash_core_lse_fwd, _flash_core_lse_bwd)
+
+
+def flash_attention_with_lse(q, k, v, causal: bool = False,
+                             scale: float = None, interpret: bool = None):
+    """(B, H, T, D)-layout flash attention returning (o, lse) with lse
+    differentiable — the per-block primitive ring attention combines
+    across chips (lse (B, H, Tq, 1) f32).  No XLA fallback: shapes that
+    don't tile raise (a silent fallback here would skip tail rows)."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    if not _tileable(Tq, Tk, D) or H % k.shape[1] != 0:
+        raise ValueError(
+            f"flash_attention_with_lse needs tiling shapes "
+            f"(T % 128 == 0, D >= 32, D % 8 == 0); got Tq={Tq}, Tk={Tk}, "
+            f"D={D}, H={H}, K={k.shape[1]}")
+    scale = scale or (1.0 / math.sqrt(q.shape[-1]))
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _flash_core_lse(q, k, v, bool(causal), float(scale),
+                           bool(interpret))
 
 
 def _tileable(Tq, Tk, D) -> bool:

@@ -37,16 +37,30 @@ _NEG = float(jnp.finfo(jnp.float32).min)
 
 
 def ring_attention_local(q, k, v, axis: str = "seq", causal: bool = True,
-                         scale: Optional[float] = None):
+                         scale: Optional[float] = None,
+                         use_flash: Optional[bool] = None):
     """Blockwise ring attention on per-shard blocks (inside shard_map).
 
     q, k, v: (B, T_local, H, D) — the local sequence shard. Requires full
     heads (repeat kv heads before sharding for GQA).
-    """
+
+    use_flash: compute each block's attention with the Pallas flash
+    kernel (ops.flash_attention_with_lse) instead of materializing the
+    (B, H, Tl, Tl) f32 logits — SP x flash composition.  None = auto
+    (TPU, tileable shapes, SINGA_DISABLE_FLASH unset)."""
     if k.shape[2] != q.shape[2]:
         raise ValueError("ring attention needs matching q/kv heads; "
                          "repeat kv heads before the ring")
     scale = scale or (1.0 / math.sqrt(q.shape[-1]))
+    if use_flash is None:
+        import os
+
+        from .flash_attention import _on_tpu, _tileable
+        Tl, D = q.shape[1], q.shape[3]
+        use_flash = (_on_tpu() and _tileable(Tl, Tl, D)
+                     and not os.environ.get("SINGA_DISABLE_FLASH"))
+    if use_flash:
+        return _ring_local_flash(q, k, v, axis, causal, scale)
     S = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     B, Tl, H, D = q.shape
@@ -97,6 +111,68 @@ def ring_attention_local(q, k, v, axis: str = "seq", causal: bool = True,
         o, m, l, k_last, v_last = o0, m0, l0, k, v
     # final held block needs no further rotation — S-1 permutes total
     o, m, l = accumulate(o, m, l, k_last, v_last, (idx - (S - 1)) % S)
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # (B, Tl, H, D)
+
+
+def _ring_local_flash(q, k, v, axis: str, causal: bool, scale: float):
+    """Per-block flash attention (o, lse) combined across the ring with
+    a numerically-stable cross-block logsumexp merge.  Under causal
+    masking, block s=0 is the diagonal (standard causal flash); rotated
+    blocks are either fully visible (source rank < this rank) or fully
+    masked (weight 0) — no per-element mask tensors at all."""
+    from .flash_attention import flash_attention_with_lse
+
+    S = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    perm = [(r, (r + 1) % S) for r in range(S)]
+    qh = jnp.swapaxes(q, 1, 2)                      # (B, H, Tl, D)
+
+    def block(k_blk, v_blk, block_causal):
+        kh = jnp.swapaxes(k_blk, 1, 2)
+        vh = jnp.swapaxes(v_blk, 1, 2)
+        o_b, lse_b = flash_attention_with_lse(qh, kh, vh,
+                                              causal=block_causal,
+                                              scale=scale)
+        return o_b.astype(jnp.float32), lse_b[..., 0]   # (B,H,Tl,D),(B,H,Tl)
+
+    def merge(o, m, l, o_b, lse_b, s):
+        # after s rotations we hold rank (idx - s)'s block: under causal
+        # masking it is fully visible iff idx >= s, else entirely in the
+        # future (weight 0) — no per-element mask tensors at all
+        if causal:
+            lse_b = jnp.where(idx >= s, lse_b, _NEG)
+        m_new = jnp.maximum(m, lse_b)
+        alpha = jnp.exp(m - m_new)
+        w = jnp.exp(lse_b - m_new)
+        return (o * alpha[..., None] + o_b * w[..., None], m_new,
+                l * alpha + w)
+
+    if S > 1:
+        # kick off the first rotation before the diagonal's compute so
+        # ICI transfer overlaps MXU work (same trick as the einsum path)
+        k_cur = lax.ppermute(k, axis, perm)
+        v_cur = lax.ppermute(v, axis, perm)
+
+    # diagonal block: standard causal flash on the locally-held K/V
+    o, m = block(k, v, causal)
+    l = jnp.ones_like(m)                            # sum exp(s - lse) = 1
+
+    if S > 1:
+        def step(carry, s):
+            o, m, l, k_blk, v_blk = carry
+            k_next = lax.ppermute(k_blk, axis, perm)
+            v_next = lax.ppermute(v_blk, axis, perm)
+            o_b, lse_b = block(k_blk, v_blk, False)
+            o, m, l = merge(o, m, l, o_b, lse_b, s)
+            return (o, m, l, k_next, v_next), None
+
+        if S > 2:
+            (o, m, l, k_cur, v_cur), _ = lax.scan(
+                step, (o, m, l, k_cur, v_cur), jnp.arange(1, S - 1))
+        # final held block needs no further rotation — S-1 permutes total
+        o_b, lse_b = block(k_cur, v_cur, False)
+        o, m, l = merge(o, m, l, o_b, lse_b, S - 1)
     out = o / jnp.maximum(l, 1e-30)[..., None]
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # (B, Tl, H, D)
 
