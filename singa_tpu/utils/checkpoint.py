@@ -183,10 +183,20 @@ class CheckpointManager:
             ckpt.save(step, model)                  # every save_every steps
     """
 
-    def __init__(self, directory: str, keep: int = 3, save_every: int = 1):
+    def __init__(self, directory: str, keep: int = 3, save_every: int = 1,
+                 asynchronous: bool = False):
+        """asynchronous: overlap disk IO with training — save() still
+        gathers device arrays synchronously (that part is a collective
+        and must not race the next step's donation), but the npz write +
+        retention pruning run in a background thread.  Call wait() (or
+        save()/restore_latest(), which wait implicitly) before reading
+        checkpoint files."""
         self.dir = directory
         self.keep = keep
         self.save_every = max(1, save_every)
+        self.asynchronous = asynchronous
+        self._pending = None
+        self._executor = None
         os.makedirs(directory, exist_ok=True)
 
     def _path(self, step: int) -> str:
@@ -206,20 +216,46 @@ class CheckpointManager:
              force: bool = False) -> Optional[str]:
         if not force and step % self.save_every:
             return None
+        self.wait()                      # one in-flight write at a time
         path = self._path(step)
         a = dict(aux or {})
         a["step"] = int(step)
         # collective gather on every process; file IO on process 0 only
         arrays, full_aux = _collect(model, a)
-        if _process_index() == 0:
-            save_arrays(arrays, path, full_aux)
-            for old in self.steps()[:-self.keep]:
-                try:
-                    os.unlink(self._path(old))
-                except OSError:
-                    pass
-        _barrier(f"singa_ckpt_{step}")
+
+        def _write():
+            if _process_index() == 0:
+                save_arrays(arrays, path, full_aux)
+                for old in self.steps()[:-self.keep]:
+                    try:
+                        os.unlink(self._path(old))
+                    except OSError:
+                        pass
+            _barrier(f"singa_ckpt_{step}")
+
+        # multi-host saves stay synchronous: the end-of-save barrier is a
+        # collective, and issuing it from a background thread could
+        # interleave with the training step's collectives
+        if self.asynchronous and _process_count() == 1:
+            # single-worker executor: write failures surface in wait()
+            # (future.result re-raises), and its non-daemon worker is
+            # joined at interpreter exit, so the final write always lands
+            # even without an explicit trailing wait()
+            if self._executor is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._executor = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="singa-ckpt")
+            self._pending = self._executor.submit(_write)
+        else:
+            _write()
         return path
+
+    def wait(self) -> None:
+        """Block until the in-flight asynchronous write (if any) lands;
+        re-raises any exception the background write hit."""
+        if self._pending is not None:
+            pending, self._pending = self._pending, None
+            pending.result()
 
     def restore_latest(self, model) -> int:
         """Load the newest intact checkpoint; returns the step after it
@@ -227,6 +263,7 @@ class CheckpointManager:
         fall back to an older file — a checkpoint that *loads* but does
         not fit the model (shape/arch mismatch) raises, because silently
         restarting from step 0 would also rotate away the good files."""
+        self.wait()
         for step in reversed(self.steps()):
             try:
                 arrays, aux = load_arrays(self._path(step))

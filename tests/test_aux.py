@@ -280,3 +280,62 @@ def test_prefetch_to_device():
     dl2 = DataLoader(x[:8], y[:8], batch_size=8, use_native=False,
                      shuffle=False)
     assert len(list(prefetch_to_device(dl2, size=4))) == 1
+
+
+class TestAsyncCheckpoint:
+    def test_async_save_restores_identically(self, tmp_path, cpu_dev):
+        m, x, y = _mlp_and_batch(cpu_dev)
+        m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+        m.compile([x], is_train=True, use_graph=True)
+        ck = checkpoint.CheckpointManager(str(tmp_path), keep=2,
+                                          asynchronous=True)
+        for step in range(3):
+            m.train_step(x, y)
+            ck.save(step, m, force=True)
+        ck.wait()
+        ref = np.asarray(m(x).data)
+        m2, _, _ = _mlp_and_batch(cpu_dev)
+        m2.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+        m2.compile([x], is_train=True, use_graph=True)
+        assert ck.restore_latest(m2) == 3
+        np.testing.assert_allclose(np.asarray(m2(x).data), ref,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_async_snapshot_immune_to_later_steps(self, tmp_path, cpu_dev):
+        """The gathered snapshot must reflect save-time state even if
+        training mutates params while the write is in flight."""
+        m, x, y = _mlp_and_batch(cpu_dev)
+        m.set_optimizer(opt.SGD(lr=0.5))
+        m.compile([x], is_train=True, use_graph=True)
+        m.train_step(x, y)
+        snap = {n: p.to_numpy().copy() for n, p in m.get_params().items()}
+        ck = checkpoint.CheckpointManager(str(tmp_path), asynchronous=True)
+        ck.save(0, m, force=True)
+        for _ in range(3):                 # mutate while write in flight
+            m.train_step(x, y)
+        ck.wait()
+        m2, _, _ = _mlp_and_batch(cpu_dev)
+        m2.set_optimizer(opt.SGD(lr=0.5))
+        m2.compile([x], is_train=True, use_graph=True)
+        ck.restore_latest(m2)
+        for n, p in m2.get_params().items():
+            np.testing.assert_allclose(p.to_numpy(), snap[n], rtol=1e-6,
+                                       err_msg=n)
+
+    def test_async_write_failure_surfaces_in_wait(self, tmp_path, cpu_dev):
+        m, x, _ = _mlp_and_batch(cpu_dev)
+        m.compile([x], is_train=False, use_graph=False)
+        ck = checkpoint.CheckpointManager(str(tmp_path), asynchronous=True)
+        import singa_tpu.utils.checkpoint as ckmod
+
+        def boom(*a, **k):
+            raise OSError("disk full")
+
+        orig = ckmod.save_arrays
+        ckmod.save_arrays = boom
+        try:
+            ck.save(0, m, force=True)
+            with pytest.raises(OSError, match="disk full"):
+                ck.wait()
+        finally:
+            ckmod.save_arrays = orig
