@@ -190,7 +190,9 @@ class CheckpointManager:
         and must not race the next step's donation), but the npz write +
         retention pruning run in a background thread.  Call wait() (or
         save()/restore_latest(), which wait implicitly) before reading
-        checkpoint files."""
+        checkpoint files.  Multi-host runs (process_count > 1) always
+        save synchronously — the end-of-save barrier is a collective
+        that must not interleave with training collectives."""
         self.dir = directory
         self.keep = keep
         self.save_every = max(1, save_every)
@@ -263,7 +265,14 @@ class CheckpointManager:
         fall back to an older file — a checkpoint that *loads* but does
         not fit the model (shape/arch mismatch) raises, because silently
         restarting from step 0 would also rotate away the good files."""
-        self.wait()
+        try:
+            self.wait()
+        except Exception:
+            # a stale background SAVE failure must not abort recovery:
+            # the fall-back contract below still applies to whatever
+            # intact files exist on disk (the failure already surfaced,
+            # or will, via the caller's own wait()/save())
+            pass
         for step in reversed(self.steps()):
             try:
                 arrays, aux = load_arrays(self._path(step))
