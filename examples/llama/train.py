@@ -42,6 +42,9 @@ def main():
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--force-host-devices", type=int, default=0,
                    help="virtual CPU devices for meshes without hardware")
+    p.add_argument("--zero1", action="store_true",
+                   help="ZeRO-1 weight-update sharding: optimizer "
+                        "moments sharded over the data axis (1/N HBM)")
     p.add_argument("--fused-loss", action="store_true",
                    help="chunked fused lm-head+CE (no (B*T,V) logits; "
                         "train_one_batch returns (loss, loss))")
@@ -82,8 +85,11 @@ def main():
     if args.plan:
         import jax
         import jax.numpy as jnp
+        plan_opt = (opt.DistOpt(opt.AdamW(lr=args.lr),
+                                shard_weight_update=True)
+                    if args.zero1 else opt.AdamW(lr=args.lr))
         plan = parallel.plan_train_step(
-            models.Llama(cfg), opt.AdamW(lr=args.lr),
+            models.Llama(cfg), plan_opt,
             (jax.ShapeDtypeStruct((args.batch, args.seq), jnp.int32),),
             mesh=mesh)
         gib = 2.0 ** 30
@@ -99,7 +105,8 @@ def main():
 
     tensor.set_seed(0)
     m = models.Llama(cfg)
-    m.set_optimizer(opt.DistOpt(opt.AdamW(lr=args.lr)))
+    m.set_optimizer(opt.DistOpt(opt.AdamW(lr=args.lr),
+                                shard_weight_update=args.zero1))
     vocab = min(cfg.vocab_size, 32000)
     ids_np = np.random.RandomState(0).randint(
         0, vocab, (args.batch, args.seq)).astype(np.int32)

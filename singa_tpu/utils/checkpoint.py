@@ -194,6 +194,8 @@ class CheckpointManager:
         save synchronously — the end-of-save barrier is a collective
         that must not interleave with training collectives."""
         self.dir = directory
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
         self.keep = keep
         self.save_every = max(1, save_every)
         self.asynchronous = asynchronous
@@ -267,12 +269,16 @@ class CheckpointManager:
         restarting from step 0 would also rotate away the good files."""
         try:
             self.wait()
-        except Exception:
-            # a stale background SAVE failure must not abort recovery:
-            # the fall-back contract below still applies to whatever
-            # intact files exist on disk (the failure already surfaced,
-            # or will, via the caller's own wait()/save())
-            pass
+        except Exception as e:
+            # a stale background SAVE failure must not abort recovery
+            # (the fall-back contract below still applies to whatever
+            # intact files exist on disk) — but it must be REPORTED,
+            # because wait() pops the future and nothing else will
+            import warnings
+            warnings.warn(
+                f"a background checkpoint save had failed "
+                f"({type(e).__name__}: {e}); restoring from the files "
+                f"on disk", stacklevel=2)
         for step in reversed(self.steps()):
             try:
                 arrays, aux = load_arrays(self._path(step))

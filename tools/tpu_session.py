@@ -120,6 +120,8 @@ def main() -> None:
         jax.block_until_ready(g(q))
         return "flash fwd+bwd compiled+ran at bench shape"
 
+    flash()
+
     import numpy as np
 
     from singa_tpu import device, models, opt, tensor
