@@ -37,11 +37,12 @@ import numpy as np  # noqa: E402
 from singa_tpu import models, opt, parallel, tensor  # noqa: E402
 
 
-def _make_model():
+def _make_model(zero1: bool = False):
     tensor.set_seed(0)
     np.random.seed(0)
     m = models.MLP(perceptron_size=(32,), num_classes=4)
-    m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.1, momentum=0.9)))
+    m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.1, momentum=0.9),
+                                shard_weight_update=zero1))
     return m
 
 
@@ -55,7 +56,7 @@ def main() -> None:
     mesh = parallel.global_mesh({"data": world})
     parallel.set_mesh(mesh)
 
-    m = _make_model()
+    m = _make_model(zero1=(mode == "zero1"))
     rng = np.random.RandomState(123)
     X = rng.randn(8, 16).astype(np.float32)
     Y = rng.randint(0, 4, (8,)).astype(np.int32)
@@ -82,8 +83,18 @@ def main() -> None:
         start = ck.restore_latest(m)
         assert start == half, start
         train(steps - half, m)
-    elif mode == "plain":
+    elif mode in ("plain", "zero1"):
         train(steps, m)
+        if mode == "zero1":
+            # ZeRO-1 contract: moments physically sharded over 'data' —
+            # this process must hold exactly its 1/world slice
+            ex = next(iter(m._executors.values()))
+            slot = ex.slots["hidden.0.W"]   # SGD momentum buffer (16, 32)
+            assert tuple(slot.sharding.spec) == ("data",), slot.sharding
+            shards = slot.addressable_shards
+            assert len(shards) == 1, len(shards)
+            assert shards[0].data.shape[0] == slot.shape[0] // world, \
+                (shards[0].data.shape, slot.shape)
     else:
         raise SystemExit(f"unknown worker mode {mode!r}")
     parallel.distributed.assert_same_across_processes(losses[-1])

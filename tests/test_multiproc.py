@@ -113,3 +113,13 @@ def test_two_process_resume_equals_uninterrupted(tmp_path):
     ref_losses, ref_params = _single_process_reference(steps=6)
     _assert_matches_reference(results, ref_losses, ref_params,
                               "after resume")
+
+
+def test_two_process_zero1_matches_big_batch(tmp_path):
+    """ZeRO-1 across 2 REAL processes (GSPMD path, moments physically
+    sharded — asserted inside each worker) reproduces the big-batch
+    single-process trajectory."""
+    results = _launch_world(2, str(tmp_path), mode="zero1")
+    ref_losses, ref_params = _single_process_reference()
+    _assert_matches_reference(results, ref_losses, ref_params,
+                              "under zero1")
