@@ -11,7 +11,7 @@ The `singa` package alias re-exports these modules so reference user
 scripts run with only the device line changed.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 from . import device
 from . import proto

@@ -27,6 +27,20 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_HERE, "libsinga_core.so")
 _CSRC = os.path.abspath(os.path.join(_HERE, "..", "..", "csrc"))
 
+
+def _find_so():
+    """The dev build writes libsinga_core.so (csrc/Makefile); installed
+    packages carry a cpython-suffixed name from setuptools — either is
+    a plain shared object for ctypes.  The exact Makefile name wins (the
+    dev rebuild flow keeps working); among suffixed hits, newest mtime
+    wins (a stale binary from another interpreter must not shadow a
+    fresh one)."""
+    if os.path.exists(_SO):
+        return _SO
+    import glob
+    hits = glob.glob(os.path.join(_HERE, "libsinga_core*.so"))
+    return max(hits, key=os.path.getmtime) if hits else None
+
 _lib: Optional[C.CDLL] = None
 _load_error: Optional[str] = None
 _ext = None          # the CPython extension module, when importable
@@ -84,15 +98,20 @@ def lib() -> Optional[C.CDLL]:
         return _lib
     if _load_error is not None:
         return None
-    if not os.path.exists(_SO) and not _build():
-        _load_error = "build failed"
-        return None
+    so = _find_so()
+    if so is None:
+        if not _build():
+            _load_error = "build failed"
+            return None
+        so = _SO
     try:
-        l = C.CDLL(_SO)
+        l = C.CDLL(so)
         _declare(l)
         _lib = l
         return _lib
-    except OSError as e:
+    except (OSError, AttributeError) as e:
+        # AttributeError: a stale .so predating a newer sg_* symbol —
+        # degrade to the XLA path instead of crashing available()
         _load_error = str(e)
         return None
 
