@@ -23,3 +23,47 @@ def __getattr__(name):
         _sys.modules[f"singa.{name}"] = mod
         return mod
     raise AttributeError(name)
+
+
+class _AliasFinder:
+    """Make `import singa.sonnx` / `import singa.models` (and any
+    submodule underneath, e.g. `singa.sonnx.backend`) resolve to the
+    SAME module objects as their singa_tpu counterparts: plain import
+    statements bypass module __getattr__, and without this finder the
+    path-based machinery would re-execute the source files as duplicate
+    modules (distinct classes, diverged registries)."""
+
+    _PREFIXES = ("singa.sonnx", "singa.models")
+
+    def find_spec(self, fullname, path=None, target=None):
+        if fullname in self._PREFIXES or any(
+                fullname.startswith(p + ".") for p in self._PREFIXES):
+            import importlib
+            import importlib.util
+            mod = importlib.import_module(
+                "singa_tpu." + fullname.split(".", 1)[1])
+            return importlib.util.spec_from_loader(
+                fullname, _AliasLoader(mod))
+        return None
+
+
+class _AliasLoader:
+    def __init__(self, mod):
+        self._mod = mod
+
+    def create_module(self, spec):
+        # remember the real identity: the import system is about to
+        # stamp the alias spec onto this (shared) module object
+        self._orig = (self._mod.__spec__, self._mod.__loader__)
+        return self._mod
+
+    def exec_module(self, module):
+        # restore the true __spec__/__loader__ so importlib.reload and
+        # introspection keep working on the singa_tpu module
+        module.__spec__, module.__loader__ = self._orig
+
+
+# BEFORE PathFinder: singa.sonnx's __path__ points at the real
+# singa_tpu/sonnx directory, so the path machinery would happily
+# re-execute submodule files as duplicates if consulted first
+_sys.meta_path.insert(0, _AliasFinder())

@@ -70,7 +70,14 @@ def _load_ext():
         paths = glob.glob(os.path.join(_HERE, "singa_core_ext*.so"))
         if not paths:
             return None
-    spec = importlib.util.spec_from_file_location("singa_core_ext", paths[0])
+    # prefer the current interpreter's ABI tag, else newest mtime — a
+    # stale .so from another interpreter must not get tried first and
+    # latch _ext = False
+    import sysconfig
+    tag = sysconfig.get_config_var("EXT_SUFFIX") or ""
+    exact = [p for p in paths if p.endswith(tag)]
+    best = exact[0] if exact else max(paths, key=os.path.getmtime)
+    spec = importlib.util.spec_from_file_location("singa_core_ext", best)
     if spec is None or spec.loader is None:
         return None
     try:
@@ -104,14 +111,26 @@ def lib() -> Optional[C.CDLL]:
             _load_error = "build failed"
             return None
         so = _SO
-    try:
-        l = C.CDLL(so)
+
+    def _try(path):
+        l = C.CDLL(path)
         _declare(l)
-        _lib = l
+        return l
+
+    try:
+        _lib = _try(so)
         return _lib
     except (OSError, AttributeError) as e:
-        # AttributeError: a stale .so predating a newer sg_* symbol —
-        # degrade to the XLA path instead of crashing available()
+        # OSError: wrong-arch binary; AttributeError: a stale .so
+        # predating a newer sg_* symbol.  A glob-found stale file must
+        # not block the dev rebuild path: try `make` + the exact name
+        # before giving up on the native core
+        if so != _SO and _build():
+            try:
+                _lib = _try(_SO)
+                return _lib
+            except (OSError, AttributeError) as e2:
+                e = e2
         _load_error = str(e)
         return None
 

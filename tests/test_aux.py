@@ -339,3 +339,21 @@ class TestAsyncCheckpoint:
                 ck.wait()
         finally:
             ckmod.save_arrays = orig
+
+
+def test_singa_alias_deep_imports():
+    """`import singa.sonnx` / `import singa.models` (statement form,
+    which bypasses module __getattr__) must resolve to the impl."""
+    import importlib
+    import singa
+    m1 = importlib.import_module("singa.sonnx")
+    m2 = importlib.import_module("singa.models")
+    import singa_tpu
+    assert m1 is singa_tpu.sonnx
+    assert m2 is singa_tpu.models
+    # submodules alias to the SAME objects (no duplicate execution)
+    b1 = importlib.import_module("singa.sonnx.backend")
+    import singa_tpu.sonnx.backend as b2
+    assert b1 is b2
+    # the alias must not clobber the real module's spec/loader
+    assert singa_tpu.sonnx.__spec__.name == "singa_tpu.sonnx"
