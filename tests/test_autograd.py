@@ -423,3 +423,51 @@ def test_fused_linear_cross_entropy_matches_unfused():
     np.testing.assert_allclose(dw_f, dw_u, rtol=1e-4, atol=1e-6)
     # the padding row's h-grad must be exactly zero
     assert np.all(dh_f[5] == 0.0)
+
+
+def test_fused_linear_cross_entropy_property():
+    """Property test: fused == unfused for random shapes/chunkings,
+    including all-invalid targets and chunk > n."""
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    @settings(max_examples=25, deadline=None)
+    @given(
+        n=st.integers(1, 70),
+        d=st.integers(1, 24),
+        V=st.integers(2, 60),
+        chunk=st.integers(1, 96),
+        frac_invalid=st.floats(0.0, 1.0),
+        seed=st.integers(0, 2**31 - 1),
+    )
+    def check(n, d, V, chunk, frac_invalid, seed):
+        autograd.set_training(True)
+        rng = np.random.RandomState(seed)
+        h = rng.randn(n, d).astype(np.float32)
+        w = (rng.randn(d, V) * 0.2).astype(np.float32)
+        t = rng.randint(0, V, n).astype(np.int32)
+        t[rng.rand(n) < frac_invalid] = -1
+
+        def run(fused):
+            ht = tensor.Tensor(data=h.copy(), requires_grad=True,
+                               stores_grad=True)
+            wt = tensor.Tensor(data=w.copy(), requires_grad=True,
+                               stores_grad=True)
+            tt = tensor.Tensor(data=t, requires_grad=False)
+            if fused:
+                loss = autograd.fused_linear_cross_entropy(
+                    ht, wt, tt, chunk_rows=chunk)
+            else:
+                loss = autograd.softmax_cross_entropy(
+                    autograd.matmul(ht, wt), tt)
+            grads = dict((id(p), g) for p, g in autograd.backward(loss))
+            return (float(loss.to_numpy()), grads[id(ht)].to_numpy(),
+                    grads[id(wt)].to_numpy())
+
+        l_f, dh_f, dw_f = run(True)
+        l_u, dh_u, dw_u = run(False)
+        np.testing.assert_allclose(l_f, l_u, rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(dh_f, dh_u, rtol=1e-3, atol=1e-5)
+        np.testing.assert_allclose(dw_f, dw_u, rtol=1e-3, atol=1e-5)
+
+    check()
