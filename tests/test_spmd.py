@@ -194,9 +194,11 @@ def test_ring_spec_tp_heads_sharded():
         captured = {}
         orig = ra._RingSDPA.__init__
 
-        def spy(self, mesh_, specs, axis, causal, scale):
+        def spy(self, mesh_, specs, axis, causal, scale,
+                use_flash=None):
             captured["specs"] = specs
-            orig(self, mesh_, specs, axis, causal, scale)
+            orig(self, mesh_, specs, axis, causal, scale,
+                 use_flash=use_flash)
 
         ra._RingSDPA.__init__ = spy
         try:
@@ -250,3 +252,45 @@ def test_ring_attention_flash_blocks_match_einsum():
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-3, atol=2e-3,
                                        err_msg=f"grad causal={causal}")
+
+
+def test_ring_attention_flash_gqa_no_replication():
+    """Flash ring blocks consume grouped-query KV natively: result must
+    equal the einsum ring on pre-repeated heads (fwd + grads)."""
+    from singa_tpu.ops.ring_attention import ring_attention_local
+
+    mesh = parallel.make_mesh({"seq": 2})
+    rng = np.random.RandomState(5)
+    B, T, H, K, D = 1, 256, 4, 2, 32
+    q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, T, K, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, T, K, D).astype(np.float32))
+    scale = 1.0 / np.sqrt(D)
+
+    flash = jax.shard_map(
+        lambda a, b, c: ring_attention_local(a, b, c, "seq", True, scale,
+                                             use_flash=True),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"), check_vma=False)
+    k_rep = jnp.repeat(k, H // K, axis=2)
+    v_rep = jnp.repeat(v, H // K, axis=2)
+    ein = jax.shard_map(
+        lambda a, b, c: ring_attention_local(a, b, c, "seq", True, scale,
+                                             use_flash=False),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"), check_vma=False)
+
+    np.testing.assert_allclose(np.asarray(flash(q, k, v)),
+                               np.asarray(ein(q, k_rep, v_rep)),
+                               rtol=2e-4, atol=2e-4)
+    g_f = jax.grad(lambda a, b, c: jnp.sum(flash(a, b, c) ** 2),
+                   (0, 1, 2))(q, k, v)
+    g_e = jax.grad(lambda a, b, c: jnp.sum(ein(a, b, c) ** 2),
+                   (0, 1, 2))(q, k_rep, v_rep)
+    np.testing.assert_allclose(np.asarray(g_f[0]), np.asarray(g_e[0]),
+                               rtol=2e-3, atol=2e-3)
+    # grouped dk/dv == sum of the replicated heads' grads
+    for gi, ge in ((g_f[1], g_e[1]), (g_f[2], g_e[2])):
+        ge_grouped = np.asarray(ge).reshape(B, T, K, H // K, D).sum(3)
+        np.testing.assert_allclose(np.asarray(gi), ge_grouped,
+                                   rtol=2e-3, atol=2e-3)
