@@ -37,7 +37,8 @@ __all__ = [
     "MaxPool2d", "AvgPool2d", "GlobalAvgPool2d", "Flatten", "ReLU",
     "Sigmoid", "Tanh", "Gelu", "SiLU", "LeakyReLU", "Softmax", "Dropout",
     "Embedding", "LayerNorm", "RMSNorm", "RNN", "LSTM",
-    "MultiHeadAttention", "MoE", "Sequential", "CrossEntropyLoss", "MSELoss",
+    "MultiHeadAttention", "MoE", "Remat", "Sequential",
+    "CrossEntropyLoss", "MSELoss",
 ]
 
 _name_counter: Dict[str, int] = {}
@@ -130,6 +131,22 @@ class Layer:
             out[s.name] = s
         for key, sub in self._sublayers.items():
             out.update(sub._get_buffers(f"{prefix}{key}."))
+        return out
+
+    # name-PRESERVING traversals: get_params/_get_buffers rewrite each
+    # tensor's .name from the prefix — callers that only need the
+    # tensors (e.g. Remat's per-step param threading) must not clobber
+    # the executor-assigned full paths that key optimizer state
+    def _param_list(self) -> List[Tensor]:
+        out = list(self._params.values())
+        for sub in self._sublayers.values():
+            out.extend(sub._param_list())
+        return out
+
+    def _buffer_list(self) -> List[Tensor]:
+        out = list(self._states.values())
+        for sub in self._sublayers.values():
+            out.extend(sub._buffer_list())
         return out
 
     def set_states(self, states: Dict[str, Tensor], prefix: str = "") -> None:
@@ -697,6 +714,94 @@ class MoE(Layer):
             total = total + a
         self._aux_losses = []
         return total
+
+
+class _RematOp(autograd.Operator):
+    """Runs a wrapped layer's forward as a PURE jax function under
+    jax.checkpoint: the jax.vjp-derived backward then saves only the
+    op's inputs and recomputes the block's internals — activation
+    memory O(block inputs) instead of O(block internals)."""
+
+    def __init__(self, inner):
+        super().__init__()
+        self.inner = inner
+
+    def fwd(self, x, *param_leaves):
+        inner = self.inner
+
+        def pure(x_a, *pl):
+            ptens = inner._param_list()        # name-preserving
+            saved = [(t.data, t.requires_grad, t.stores_grad)
+                     for t in ptens]
+            try:
+                for t, a in zip(ptens, pl):
+                    # requires_grad=False: inner ops run plain fwd (the
+                    # outer vjp over the whole block owns the gradient)
+                    t.data = a
+                    t.requires_grad = False
+                    t.stores_grad = False
+                xt = Tensor(data=x_a, requires_grad=False)
+                out = inner.forward(xt)
+                return out.data
+            finally:
+                for t, (d, rg, sg) in zip(ptens, saved):
+                    t.data = d
+                    t.requires_grad = rg
+                    t.stores_grad = sg
+
+        return jax.checkpoint(pure)(x, *param_leaves)
+
+
+class Remat(Layer):
+    """Activation checkpointing: wrap a (stateless) sublayer so its
+    internals are recomputed during backward instead of saved —
+    `layer.Remat(block)` trades one extra forward for O(layer) less
+    activation HBM, the standard deep-transformer memory lever.
+
+    The wrapped layer must be buffer-free (e.g. no BatchNorm running
+    stats: the forward runs again in backward and must be side-effect
+    free); such layers fall back to the plain call with a warning.
+    Parameter paths are UNCHANGED (the wrapper segment is transparent),
+    so checkpoints and shard rules work identically with or without
+    the wrapper."""
+
+    def __init__(self, inner: Layer, name=None):
+        super().__init__(name)
+        self.inner = inner
+
+    # parameter/state paths pass through unchanged: Remat(block) and the
+    # bare block have identical checkpoints and shard-rule matches
+    def get_params(self, prefix: str = "") -> Dict[str, Tensor]:
+        return self.inner.get_params(prefix)
+
+    def set_params(self, params, prefix: str = "") -> None:
+        self.inner.set_params(params, prefix)
+
+    def _get_buffers(self, prefix: str = "") -> Dict[str, Tensor]:
+        return self.inner._get_buffers(prefix)
+
+    def set_states(self, states, prefix: str = "") -> None:
+        self.inner.set_states(states, prefix)
+
+    def forward(self, x: Tensor, *rest):
+        if rest:
+            # multi-arg calls (e.g. KV-cache decode paths) bypass the
+            # checkpoint — they are eval-time anyway
+            return self.inner(x, *rest)
+        if not self.inner._initialized:
+            # first call materializes params through the normal lazy
+            # path (outside any checkpoint region)
+            return self.inner(x)
+        if self.inner._buffer_list():
+            import warnings
+            warnings.warn(
+                f"Remat({self.inner.name}) skipped: wrapped layer has "
+                f"non-trainable buffers (stateful forward cannot be "
+                f"replayed in backward)", stacklevel=2)
+            return self.inner(x)
+        if not autograd.is_training():
+            return self.inner(x)     # nothing to save in eval
+        return _RematOp(self.inner)(x, *self.inner._param_list())
 
 
 class Sequential(Layer):

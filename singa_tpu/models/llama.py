@@ -55,6 +55,10 @@ class LlamaConfig:
     # on, train_one_batch returns (loss, loss) instead of (logits, loss)
     # -- hence opt-in; the bench/dryrun/example enable it explicitly
     fused_loss: bool = False
+    # activation checkpointing per transformer block (layer.Remat):
+    # block internals recomputed in backward — O(layers) less activation
+    # HBM for one extra forward; param paths unchanged
+    remat: bool = False
 
     @staticmethod
     def llama3_8b() -> "LlamaConfig":
@@ -155,7 +159,10 @@ class Llama(GenerateMixin, model.Model):
         self.cfg = cfg or LlamaConfig(**kw)
         c = self.cfg
         self.tok_emb = layer.Embedding(c.vocab_size, c.dim)
-        self.blocks = [_LlamaBlock(c) for _ in range(c.num_layers)]
+        blocks = [_LlamaBlock(c) for _ in range(c.num_layers)]
+        if c.remat:
+            blocks = [layer.Remat(b) for b in blocks]
+        self.blocks = blocks
         self.norm_f = layer.RMSNorm(c.dim, eps=c.eps)
         self.lm_head = layer.Linear(c.vocab_size, bias=False)
 
