@@ -666,6 +666,10 @@ class MoE(Layer):
     SHARD_RULES = [
         (r"\.(w_in|w_out)$", ("expert", None, None)),
     ]
+    # the aux-loss accumulator is a side channel: a forward replayed
+    # inside a jax.checkpoint region would leak its tracer (and drop
+    # the router's balance-loss gradient) — layer.Remat must bypass
+    REMAT_SAFE = False
 
     def __init__(self, num_experts: int, ffn_dim: int,
                  capacity_factor: float = 1.25, name=None):
@@ -720,14 +724,20 @@ class _RematOp(autograd.Operator):
     """Runs a wrapped layer's forward as a PURE jax function under
     jax.checkpoint: the jax.vjp-derived backward then saves only the
     op's inputs and recomputes the block's internals — activation
-    memory O(block inputs) instead of O(block internals)."""
+    memory O(block inputs) instead of O(block internals).
 
-    def __init__(self, inner):
+    `extras`: trailing non-differentiable forward args (e.g. an
+    attention mask) closed over by the pure fn — they become jaxpr
+    constants the checkpoint keeps as residuals."""
+
+    def __init__(self, inner, extras=()):
         super().__init__()
         self.inner = inner
+        self.extras = extras
 
     def fwd(self, x, *param_leaves):
         inner = self.inner
+        extras = self.extras
 
         def pure(x_a, *pl):
             ptens = inner._param_list()        # name-preserving
@@ -741,7 +751,7 @@ class _RematOp(autograd.Operator):
                     t.requires_grad = False
                     t.stores_grad = False
                 xt = Tensor(data=x_a, requires_grad=False)
-                out = inner.forward(xt)
+                out = inner.forward(xt, *extras)
                 return out.data
             finally:
                 for t, (d, rg, sg) in zip(ptens, saved):
@@ -784,24 +794,44 @@ class Remat(Layer):
         self.inner.set_states(states, prefix)
 
     def forward(self, x: Tensor, *rest):
-        if rest:
-            # multi-arg calls (e.g. KV-cache decode paths) bypass the
-            # checkpoint — they are eval-time anyway
-            return self.inner(x, *rest)
         if not self.inner._initialized:
             # first call materializes params through the normal lazy
             # path (outside any checkpoint region)
-            return self.inner(x)
-        if self.inner._buffer_list():
+            return self.inner(x, *rest)
+        if not autograd.is_training():
+            return self.inner(x, *rest)   # nothing to save in eval
+        unsafe = [l for l in _walk_layers(self.inner)
+                  if not getattr(type(l), "REMAT_SAFE", True)]
+        if unsafe or self.inner._buffer_list():
             import warnings
+            what = ("side-channel layers "
+                    f"({', '.join(type(l).__name__ for l in unsafe)})"
+                    if unsafe else "non-trainable buffers")
             warnings.warn(
                 f"Remat({self.inner.name}) skipped: wrapped layer has "
-                f"non-trainable buffers (stateful forward cannot be "
-                f"replayed in backward)", stacklevel=2)
-            return self.inner(x)
-        if not autograd.is_training():
-            return self.inner(x)     # nothing to save in eval
-        return _RematOp(self.inner)(x, *self.inner._param_list())
+                f"{what} (the forward replayed in backward must be "
+                f"side-effect free)", stacklevel=2)
+            return self.inner(x, *rest)
+        # trailing args (attention masks, ...) thread through the
+        # checkpoint as closed-over constants when non-differentiable;
+        # anything gradient-carrying or structured (KV caches) bypasses
+        for r in rest:
+            if not (r is None or (isinstance(r, Tensor)
+                                  and not r.requires_grad)):
+                import warnings
+                warnings.warn(
+                    f"Remat({self.inner.name}) bypassed for a call with "
+                    f"unsupported extra arg {type(r).__name__}",
+                    stacklevel=2)
+                return self.inner(x, *rest)
+        return _RematOp(self.inner, tuple(rest))(
+            x, *self.inner._param_list())
+
+
+def _walk_layers(l):
+    yield l
+    for s in l._sublayers.values():
+        yield from _walk_layers(s)
 
 
 class Sequential(Layer):

