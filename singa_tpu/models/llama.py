@@ -49,6 +49,14 @@ class LlamaConfig:
     ffn_dim: int = 14336
     max_position: int = 8192
     rope_theta: float = 500000.0
+    # Llama-3.1-style frequency-dependent RoPE interpolation: 0 = off;
+    # e.g. 8.0 extends the usable context ~8x past
+    # rope_scaling_original_max_position (the PRETRAINED window the
+    # interpolation bands anchor to).  Raise max_position alongside —
+    # tables are sized by it, and generate()/training length checks
+    # enforce it loudly.
+    rope_scaling: float = 0.0
+    rope_scaling_original_max_position: int = 8192
     eps: float = 1e-5
     # opt-in chunked fused lm-head+CE loss (never materializes the
     # (B*T, V) logits; autograd.FusedLinearCrossEntropy).  NOTE: with it
@@ -91,8 +99,9 @@ class _LlamaAttention(layer.Layer):
         self.k_proj = layer.Linear(c.num_kv_heads * c.head_dim, bias=False)
         self.v_proj = layer.Linear(c.num_kv_heads * c.head_dim, bias=False)
         self.o_proj = layer.Linear(c.dim, bias=False)
-        self._rope = rope_ops.rope_frequencies(c.head_dim, c.max_position,
-                                               c.rope_theta)
+        self._rope = rope_ops.rope_frequencies(
+            c.head_dim, c.max_position, c.rope_theta, c.rope_scaling,
+            c.rope_scaling_original_max_position)
 
     def forward(self, x: Tensor, cache=None, pos=0):
         c = self.cfg

@@ -7,8 +7,10 @@ kernels live here for the ops where it doesn't (attention — SURVEY.md
 
 from . import attention
 from .attention import attention as fused_attention
-from .rope import apply_rope, rope_frequencies
+from .rope import (apply_rope, llama31_rope_scaling,
+                   rope_frequencies)
 from .ring_attention import ring_attention, ring_attention_local
 
 __all__ = ["attention", "fused_attention", "apply_rope", "rope_frequencies",
+           "llama31_rope_scaling",
            "ring_attention", "ring_attention_local"]

@@ -10,16 +10,49 @@ import jax.numpy as jnp
 from .. import autograd
 from ..tensor import Tensor
 
-__all__ = ["rope_frequencies", "apply_rope"]
+__all__ = ["rope_frequencies", "apply_rope", "llama31_rope_scaling"]
+
+
+def llama31_rope_scaling(inv_freq, scale_factor: float = 8.0,
+                         low_freq_factor: float = 1.0,
+                         high_freq_factor: float = 4.0,
+                         original_max_position: int = 8192):
+    """Llama-3.1-style frequency-dependent NTK interpolation: long
+    wavelengths (beyond the original context) are divided by
+    `scale_factor`, short wavelengths pass through, and the band in
+    between blends linearly — extends the usable context by
+    ~scale_factor without retraining the short-range behavior."""
+    wavelen = 2.0 * jnp.pi / inv_freq
+    low_bound = original_max_position / low_freq_factor    # long waves
+    high_bound = original_max_position / high_freq_factor  # short waves
+    # smooth in (0,1): 0 at the long-wave bound, 1 at the short-wave one
+    smooth = (original_max_position / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor)
+    scaled = jnp.where(
+        wavelen > low_bound, inv_freq / scale_factor,
+        jnp.where(wavelen < high_bound, inv_freq,
+                  (1 - smooth) * inv_freq / scale_factor + smooth * inv_freq))
+    return scaled
 
 
 @functools.lru_cache(maxsize=32)
-def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0):
+def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0,
+                     rope_scaling: float = 0.0,
+                     rope_original_max_position: int = 8192):
     """Precompute (cos, sin) tables of shape (max_len, head_dim//2).
 
     Cached so every attention layer of a model shares one table pair
-    instead of baking per-layer copies into the compiled module."""
+    instead of baking per-layer copies into the compiled module.
+
+    rope_scaling > 0 applies Llama-3.1-style frequency-dependent
+    interpolation with that scale factor (context extension);
+    `rope_original_max_position` is the PRETRAINED context window the
+    interpolation bands are anchored to."""
     inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if rope_scaling and rope_scaling > 0.0:
+        inv = llama31_rope_scaling(
+            inv, scale_factor=float(rope_scaling),
+            original_max_position=int(rope_original_max_position))
     t = jnp.arange(max_len, dtype=jnp.float32)
     freqs = jnp.outer(t, inv)
     return jnp.cos(freqs), jnp.sin(freqs)

@@ -477,3 +477,49 @@ def test_gpt2_remat_engages_with_padding_mask():
     ag.set_training(True)
     feats = m.features(ids, attention_mask=mask_t)
     assert feats.shape == (2, 16, cfg.dim)
+
+
+def test_llama31_rope_scaling():
+    """Frequency-dependent context-extension interpolation: short
+    wavelengths unchanged, long wavelengths divided by the scale
+    factor, smooth monotone blend in between."""
+    import jax.numpy as jnp
+
+    from singa_tpu.ops import llama31_rope_scaling
+    from singa_tpu.ops.rope import rope_frequencies
+
+    head_dim = 64
+    theta = 500000.0
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32)
+                           / head_dim))
+    scaled = np.asarray(llama31_rope_scaling(jnp.asarray(inv)))
+    wavelen = 2 * np.pi / inv
+    # short wavelengths (< 8192/4) untouched
+    short = wavelen < 8192 / 4.0
+    np.testing.assert_allclose(scaled[short], inv[short], rtol=1e-6)
+    # long wavelengths (> 8192) fully scaled by 1/8
+    long = wavelen > 8192.0
+    np.testing.assert_allclose(scaled[long], inv[long] / 8.0, rtol=1e-6)
+    # in between: bounded by the two regimes, monotone in frequency
+    mid = ~(short | long)
+    assert np.all(scaled[mid] <= inv[mid] + 1e-9)
+    assert np.all(scaled[mid] >= inv[mid] / 8.0 - 1e-12)
+    # table plumbing: scaled tables differ from unscaled, shapes equal
+    c0, s0 = rope_frequencies(head_dim, 64, theta, 0.0)
+    c8, s8 = rope_frequencies(head_dim, 64, theta, 8.0)
+    assert c0.shape == c8.shape
+    assert not np.allclose(np.asarray(c0), np.asarray(c8))
+    # a model with rope_scaling still trains
+    import dataclasses
+    tensor.set_seed(0)
+    np.random.seed(0)
+    cfg = dataclasses.replace(models.LlamaConfig.tiny(), rope_scaling=8.0,
+                              rope_scaling_original_max_position=32)
+    m = models.Llama(cfg)
+    m.set_optimizer(opt.SGD(lr=0.05))
+    ids = tensor.from_numpy(np.random.randint(
+        0, cfg.vocab_size, (2, 32)).astype(np.int32))
+    m.compile([ids], is_train=True, use_graph=True)
+    l0 = float(m.train_step(ids)[1].to_numpy())
+    l1 = float(m.train_step(ids)[1].to_numpy())
+    assert np.isfinite(l0) and l1 < l0
