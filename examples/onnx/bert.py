@@ -43,6 +43,10 @@ def main():
     p.add_argument("--seq", type=int, default=64)
     p.add_argument("--compile", action="store_true",
                    help="compile the imported graph to one XLA module")
+    p.add_argument("--finetune", type=int, default=0, metavar="STEPS",
+                   help="fine-tune the IMPORTED model for N steps "
+                        "(training-capable import: the reimported graph "
+                        "trains through the compiled executor)")
     args = p.parse_args()
 
     dev = singa.device.create_device(args.device)
@@ -82,6 +86,18 @@ def main():
         print(f"import vs native max |diff| = {err:.2e}")
         assert err < 1e-2, "sonnx round-trip mismatch"
         print("round-trip OK")
+
+    if args.finetune:
+        from singa_tpu import autograd, opt
+        rep.set_optimizer(opt.AdamW(lr=3e-4))
+        rep.set_loss(lambda outs, y: autograd.mse_loss(
+            outs[0] if isinstance(outs, (list, tuple)) else outs, y))
+        target = Tensor(data=np.zeros_like(out), device=dev,
+                        requires_grad=False)
+        rep.compile([t_ids], is_train=True, use_graph=True)
+        for step in range(args.finetune):
+            _, loss = rep.train_step(t_ids, target)
+            print(f"finetune step {step}: loss {float(loss.to_numpy()):.4f}")
 
 
 if __name__ == "__main__":
