@@ -187,6 +187,19 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 # ---------------------------------------------------------------------------
 
 def _block_sizes(seq_q, seq_k):
+    """Tile sizes for the kernel grid.  SINGA_FLASH_BLOCK="bq,bk"
+    overrides for tuning (each must divide its sequence length and be a
+    multiple of 128; invalid overrides fall back to the default)."""
+    import os
+    override = os.environ.get("SINGA_FLASH_BLOCK")
+    if override:
+        try:
+            bq, bk = (int(v) for v in override.split(","))
+            if (bq % 128 == 0 and bk % 128 == 0 and bq > 0 and bk > 0
+                    and seq_q % bq == 0 and seq_k % bk == 0):
+                return bq, bk
+        except ValueError:
+            pass
     bq = 256 if seq_q % 256 == 0 else 128
     bk = 256 if seq_k % 256 == 0 else 128
     return bq, bk

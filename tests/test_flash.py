@@ -143,3 +143,29 @@ def test_flash_tq_ne_tk_noncausal():
     ref = _sdpa_reference(q, k, v, False, None, 1.0 / np.sqrt(64))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_flash_block_size_override(monkeypatch):
+    """SINGA_FLASH_BLOCK tunes the kernel tiles; invalid overrides fall
+    back; numerics unchanged either way (interpret mode)."""
+    import jax.numpy as jnp
+
+    from singa_tpu.ops.attention import _sdpa_reference
+    from singa_tpu.ops.flash_attention import _block_sizes, flash_attention
+
+    monkeypatch.delenv("SINGA_FLASH_BLOCK", raising=False)
+    assert _block_sizes(256, 256) == (256, 256)
+    monkeypatch.setenv("SINGA_FLASH_BLOCK", "128,128")
+    assert _block_sizes(256, 256) == (128, 128)
+    monkeypatch.setenv("SINGA_FLASH_BLOCK", "384,128")   # 384 ∤ 256
+    assert _block_sizes(256, 256) == (256, 256)
+    monkeypatch.setenv("SINGA_FLASH_BLOCK", "garbage")
+    assert _block_sizes(256, 256) == (256, 256)
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 256, 2, 32).astype(np.float32))
+    ref = _sdpa_reference(q, q, q, True, None, 1.0 / np.sqrt(32))
+    monkeypatch.setenv("SINGA_FLASH_BLOCK", "128,128")
+    out = flash_attention(q, q, q, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
