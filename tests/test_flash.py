@@ -169,3 +169,39 @@ def test_flash_block_size_override(monkeypatch):
     out = flash_attention(q, q, q, causal=True, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_flash_with_lse_dlse_cotangent():
+    """flash_attention_with_lse: gradients through BOTH outputs (o and
+    lse) must match autodiff of the reference (the dlse term folds into
+    the backward as delta - dlse)."""
+    import jax
+    import jax.numpy as jnp
+
+    from singa_tpu.ops.flash_attention import flash_attention_with_lse
+
+    rng = np.random.RandomState(3)
+    B, H, T, D = 1, 2, 128, 32
+    q = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+    scale = 1.0 / np.sqrt(D)
+
+    def ref_loss(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+        # depends on BOTH o and lse, with different weights
+        return jnp.sum(o ** 2) + 0.5 * jnp.sum(lse ** 2)
+
+    def flash_loss(q, k, v):
+        o, lse = flash_attention_with_lse(q, k, v, causal=False,
+                                          scale=scale, interpret=True)
+        return jnp.sum(o.astype(jnp.float32) ** 2) \
+            + 0.5 * jnp.sum(lse[..., 0] ** 2)
+
+    g_ref = jax.grad(ref_loss, (0, 1, 2))(q, k, v)
+    g_fl = jax.grad(flash_loss, (0, 1, 2))(q, k, v)
+    for a, b, nm in zip(g_fl, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3, err_msg=nm)
