@@ -738,12 +738,19 @@ class _RematOp(autograd.Operator):
     def fwd(self, x, *param_leaves):
         inner = self.inner
         extras = self.extras
+        # reserve a PRNG key for the block's internal RNG (dropout)
+        # OUTSIDE the checkpoint: splits inside the checkpoint trace
+        # would otherwise write checkpoint-scoped tracers into the
+        # global key, crashing the next consumer after the trace closes
+        blk_key = tensor_mod._next_key()
 
         def pure(x_a, *pl):
             ptens = inner._param_list()        # name-preserving
             saved = [(t.data, t.requires_grad, t.stores_grad)
                      for t in ptens]
+            saved_key = tensor_mod._rng_key
             try:
+                tensor_mod._rng_key = blk_key
                 for t, a in zip(ptens, pl):
                     # requires_grad=False: inner ops run plain fwd (the
                     # outer vjp over the whole block owns the gradient)
@@ -754,6 +761,7 @@ class _RematOp(autograd.Operator):
                 out = inner.forward(xt, *extras)
                 return out.data
             finally:
+                tensor_mod._rng_key = saved_key
                 for t, (d, rg, sg) in zip(ptens, saved):
                     t.data = d
                     t.requires_grad = rg

@@ -370,20 +370,25 @@ class GradAccum(Optimizer):
 
     def load_slot_arrays(self, slots: Dict[str, List]) -> None:
         """Rebuild {"acc", "base"} dict slots from the checkpoint's flat
-        leaf lists (leaf 0 is the accumulator; the rest reconstruct the
-        wrapped optimizer's slot generically) — both the eager path and
-        the graph executor then see the structure GradAccum.apply needs."""
-        est = {}
+        leaf lists: leaf 0 is the accumulator; the rest reconstruct the
+        WRAPPED optimizer's slot through ITS load_slot_arrays (so
+        structured inner slots — e.g. a nested GradAccum — resume too).
+        Both the eager path and the graph executor then see the
+        structure GradAccum.apply needs."""
+        heads, rests = {}, {}
         for name, leaves in slots.items():
             arrs = [jnp.asarray(l) for l in leaves]
             if not arrs:
                 raise ValueError(
                     f"GradAccum slot for {name!r} is empty in checkpoint")
-            rest = arrs[1:]
-            base = (None if not rest
-                    else rest[0] if len(rest) == 1 else tuple(rest))
-            est[name] = {"acc": arrs[0], "base": base}
-        self._eager_state = est
+            heads[name] = arrs[0]
+            rests[name] = arrs[1:]
+        saved_inner = getattr(self.opt, "_eager_state", None)
+        self.opt.load_slot_arrays(rests)
+        inner = self.opt._eager_state
+        self.opt._eager_state = saved_inner
+        self._eager_state = {n: {"acc": heads[n], "base": inner.get(n)}
+                             for n in heads}
 
 
 # ---------------------------------------------------------------------------

@@ -168,7 +168,14 @@ class Tensor:
                 "a device-backed Tensor cannot be converted to numpy "
                 "without a copy (np.asarray(..., copy=False))")
         a = np.asarray(self.data)
-        return a.astype(dtype, copy=False) if dtype is not None else a
+        if dtype is not None:
+            a = a.astype(dtype, copy=False)
+        if copy:
+            # honor the NumPy 2 contract: copy=True must return a fresh
+            # WRITABLE array (np.asarray of a jax.Array can be a
+            # read-only zero-copy view)
+            a = np.array(a, copy=True)
+        return a
 
     def numpy(self) -> np.ndarray:
         return self.to_numpy()

@@ -505,3 +505,72 @@ class TestRemat:
         out = m_r.generate(np.random.RandomState(0).randint(
             0, 256, (2, 8)).astype(np.int32), max_new_tokens=4)
         assert np.asarray(out).shape == (2, 12)
+
+
+def test_remat_with_dropout_trains():
+    """Dropout inside a rematted block: the block RNG key is reserved
+    OUTSIDE the checkpoint, so the global key never holds a
+    checkpoint-scoped tracer (regression: UnexpectedTracerError with
+    two dropout-carrying remat blocks)."""
+    class Net(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.b1 = layer.Remat(layer.Sequential(
+                layer.Linear(32), layer.Dropout(0.2), layer.ReLU()))
+            self.b2 = layer.Remat(layer.Sequential(
+                layer.Linear(32), layer.Dropout(0.2), layer.ReLU()))
+            self.head = layer.Linear(4)
+
+        def forward(self, x):
+            return self.head(self.b2(self.b1(x)))
+
+        def train_one_batch(self, x, y):
+            out = self.forward(x)
+            loss = autograd.softmax_cross_entropy(out, y)
+            self.optimizer.backward_and_update(loss)
+            return out, loss
+
+    tensor.set_seed(13)
+    np.random.seed(13)
+    x, y = make_blobs(n=32)
+    m = Net()
+    m.set_optimizer(opt.Adam(lr=5e-3))
+    tx, ty = tensor.from_numpy(x), tensor.from_numpy(y)
+    m.compile([tx], is_train=True, use_graph=True)
+    losses = [float(m.train_step(tx, ty)[1].to_numpy()) for _ in range(6)]
+    assert all(np.isfinite(v) for v in losses)
+    assert losses[-1] < losses[0], losses
+    assert "remat" in str(m.graph.jaxpr)
+
+
+def test_nested_grad_accum_resume(tmp_path):
+    """GradAccum wrapping GradAccum: dict-structured inner slots must
+    survive a checkpoint round trip (recursive load_slot_arrays)."""
+    def build():
+        tensor.set_seed(23)
+        m = MLP(hidden=16)
+        m.set_optimizer(opt.GradAccum(
+            opt.GradAccum(opt.SGD(lr=0.1, momentum=0.9), 2), 2))
+        return m
+
+    np.random.seed(23)
+    x, y = make_blobs(n=16)
+    tx, ty = tensor.from_numpy(x), tensor.from_numpy(y)
+    m1 = build()
+    m1.compile([tx], is_train=True, use_graph=True)
+    for _ in range(3):                     # mid-accumulation at both levels
+        m1.train_step(tx, ty)
+    p = str(tmp_path / "nested.npz")
+    m1.save_states(p)
+    for _ in range(5):
+        m1.train_step(tx, ty)
+
+    m2 = build()
+    m2.compile([tx], is_train=True, use_graph=True)
+    m2.load_states(p)
+    for _ in range(5):
+        m2.train_step(tx, ty)
+    for (n1, p1), (n2, p2) in zip(sorted(m1.get_params().items()),
+                                  sorted(m2.get_params().items())):
+        np.testing.assert_allclose(p1.to_numpy(), p2.to_numpy(),
+                                   rtol=1e-5, atol=1e-7, err_msg=n1)

@@ -158,3 +158,13 @@ def test_inplace_module_fns_reject_shape_mismatch():
         tensor.add_column(b, a)      # needs length-3 for a's rows
     with pytest.raises(ValueError):
         tensor.add_row(tensor.from_numpy(np.ones(3, np.float32)), a)
+
+
+def test_array_copy_true_is_writable():
+    """NumPy-2 protocol: copy=True must return a fresh WRITABLE array."""
+    t = tensor.from_numpy(np.arange(4, dtype=np.float32))
+    a = np.asarray(t, copy=True) if np.lib.NumpyVersion(
+        np.__version__) >= "2.0.0" else t.__array__(copy=True)
+    a[0] = 99.0
+    assert a[0] == 99.0
+    assert float(t.to_numpy()[0]) == 0.0   # original untouched
