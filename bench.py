@@ -295,14 +295,22 @@ def _allreduce_sub_main() -> None:
     print(json.dumps(_allreduce_bw(8, mib=8.0, iters=10)))
 
 
-def _enable_persistent_cache() -> None:
+def _enable_persistent_cache(platform: str) -> None:
     """Persist compiled executables across bench invocations (the repo
     dir survives between driver runs on this host).  First compile of
     the big train-step module over a tunneled backend is minutes; a
-    cache hit is seconds.  Harmless no-op on backends that don't
-    support executable serialization."""
+    cache hit is seconds.
+
+    TPU-only: TPU executables are keyed by the TPU target, so entries
+    primed on one host are valid on another.  XLA:CPU entries are
+    AOT-compiled for the *priming host's* CPU features — loading them
+    on a different machine risks SIGILL and floods stderr with
+    feature-mismatch warnings (BENCH_r03: ~40 such lines drowned the
+    headline JSON in the driver's tail capture)."""
     import jax
 
+    if platform == "cpu":
+        return
     cache_dir = os.environ.get(
         "SINGA_JAX_CACHE",
         os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
@@ -323,7 +331,7 @@ def _sub_main(platform: str) -> None:
 
     if platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
-    _enable_persistent_cache()
+    _enable_persistent_cache(platform)
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
     if platform == "tpu" and not on_tpu:
@@ -343,6 +351,16 @@ def _sub_main(platform: str) -> None:
     # its conv-heavy compile is the most likely budget-eater).
     headline = bench_llama(dev, on_tpu)
     print(json.dumps(headline), flush=True)
+    try:
+        _sub_main_secondaries(dev, on_tpu)
+    finally:
+        # BENCH_r03: the driver parses a bounded tail; anything noisy
+        # after the headline can push it out.  Re-emit it as the child's
+        # LAST stdout line no matter what the secondaries did.
+        print(json.dumps(headline), flush=True)
+
+
+def _sub_main_secondaries(dev, on_tpu: bool) -> None:
 
     # minimum seconds a bench realistically needs (compile + steps); skip
     # with an explicit line rather than getting killed mid-compile.  The
@@ -367,18 +385,22 @@ def _sub_main(platform: str) -> None:
                   file=sys.stderr)
 
 
-def _run_sub(platform: str, timeout_s: float) -> bool:
+def _run_sub(platform: str, timeout_s: float) -> str | None:
     """Spawn `bench.py --sub <platform>` and STREAM its output: the
     child's headline JSON line is forwarded to our stdout the moment it
     appears (so a later hang in a secondary bench can't eat it); its
-    stderr detail lines are forwarded to our stderr.  Returns True once
-    a headline was emitted."""
+    stderr detail lines are forwarded to our stderr.  Returns the
+    headline line once one was emitted, else None."""
     import subprocess
     import threading
 
     env = dict(os.environ)
     if platform == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
+        # never load persistent-cache entries on the CPU fallback: they
+        # may be AOT-compiled for another machine's CPU features
+        # (SIGILL risk + stderr flood, BENCH_r03)
+        env["SINGA_JAX_CACHE"] = "0"
     # soft budget below our hard timeout so the child can skip remaining
     # benches gracefully instead of being killed mid-bench
     env.setdefault("SINGA_BENCH_BUDGET_S", str(max(60, int(timeout_s) - 60)))
@@ -387,18 +409,20 @@ def _run_sub(platform: str, timeout_s: float) -> bool:
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         bufsize=1, start_new_session=True,
         cwd=os.path.dirname(os.path.abspath(__file__)))
-    emitted = [False]
+    emitted = [None]
 
     def _pump_stdout():
         for line in p.stdout:
             line = line.strip()
             if not line:
                 continue
-            if not emitted[0] and line.startswith("{"):
+            if line == emitted[0]:
+                continue  # the child's end-of-run headline re-print
+            if emitted[0] is None and line.startswith("{"):
                 try:
                     if "metric" in json.loads(line):
                         print(line, flush=True)
-                        emitted[0] = True
+                        emitted[0] = line
                         continue
                 except json.JSONDecodeError:
                     pass
@@ -495,17 +519,21 @@ def main() -> None:
               f"retrying in {wait}s", file=sys.stderr)
         time.sleep(wait)
 
-    emitted = False
+    headline = None
     if usable:
-        emitted = _run_sub("tpu", tpu_timeout)
-    if not emitted:
+        headline = _run_sub("tpu", tpu_timeout)
+    if headline is None:
         print("# no TPU headline; running the suite on CPU",
               file=sys.stderr)
-        emitted = _run_sub("cpu", cpu_timeout)
-    if not emitted:
-        print(json.dumps({"metric": "llama_train_tokens_per_sec",
-                          "value": 0.0, "unit": "tokens/s",
-                          "vs_baseline": 0.0}), flush=True)
+        headline = _run_sub("cpu", cpu_timeout)
+    if headline is None:
+        headline = json.dumps({"metric": "llama_train_tokens_per_sec",
+                               "value": 0.0, "unit": "tokens/s",
+                               "vs_baseline": 0.0})
+    # The driver parses a bounded tail of this process's output
+    # (BENCH_r03: stderr noise after the early headline pushed it out of
+    # the capture).  The LAST stdout line is always the headline JSON.
+    print(headline, flush=True)
 
 
 if __name__ == "__main__":

@@ -87,8 +87,9 @@ class GPT2Config:
     # opt-in chunked fused tied-head+CE loss (no (B*T, V) logits;
     # train_one_batch then returns (loss, loss) instead of (logits, loss))
     fused_loss: bool = False
-    # activation checkpointing per block (layer.Remat; engages for
-    # unmasked training calls — padding-masked calls bypass)
+    # activation checkpointing per block (layer.Remat; padding masks
+    # thread through the checkpoint as saved non-grad residuals, so
+    # masked calls remat too)
     remat: bool = False
 
     @staticmethod
@@ -146,7 +147,9 @@ class GPT2(GenerateMixin, model.Model):
         x = self.wte(ids) + self.wpe(_positions(ids))
         x = self.drop(x)
         for blk in self.blocks:
-            # single-arg when unmasked so layer.Remat can engage
+            # mask is an optional extra; when present, layer.Remat
+            # carries it as a saved (non-grad) residual through the
+            # checkpoint, so both call forms remat
             x = blk(x) if mask is None else blk(x, mask)
         return self.ln_f(x)
 

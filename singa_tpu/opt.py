@@ -332,7 +332,17 @@ class GradAccum(Optimizer):
     The wrapped optimizer's schedule sees the number of *applied*
     updates (step // every), so LR decay is in optimizer-update units.
     Composes with DistOpt: DistOpt(GradAccum(SGD(...), 4)) allreduces
-    each microbatch gradient, then accumulates the mean."""
+    each microbatch gradient, then accumulates the mean.
+
+    Communication cost note: that nesting moves k allreduces per
+    applied update over ICI — k times the bytes of an
+    accumulate-locally-then-allreduce schedule.  It is the supported
+    ordering because the executor emits the allreduce unconditionally
+    each compiled step (a step-conditional collective inside the jitted
+    module would need diverging comm schedules under one trace).  If
+    the per-microbatch allreduce dominates, prefer cutting `every` and
+    raising the per-step batch, or DistOpt(compress_dtype=...) /
+    topk_ratio to shrink the per-step bytes instead."""
 
     def __init__(self, opt: Optimizer, every: int):
         super().__init__(opt.sched)

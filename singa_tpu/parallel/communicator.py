@@ -76,12 +76,30 @@ def ppermute(x, axis: str, perm):
 
 
 def broadcast(x, axis: str = "data", src: int = 0):
-    """Replicate rank-src's value: implemented as select + psum."""
+    """Replicate rank-src's value via a distance-doubling ppermute tree.
+
+    ceil(log2(W)) ring hops: after hop k, every rank whose offset from
+    src (mod W) is < 2^(k+1) holds the value.  Each hop is a full
+    permutation (one-to-many ppermute is rejected by JAX), and each
+    rank moves the payload log2(W) times total — the native
+    collective-permute lowering, vs. the old select+psum workaround
+    that ran a full f32 all-reduce over masked zeros."""
     if not axis_bound(axis):
         return x
-    idx = jax.lax.axis_index(axis)
-    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
-    return jax.lax.psum(masked, axis)
+    W = jax.lax.axis_size(axis)
+    if W == 1:
+        return x
+    d = (jax.lax.axis_index(axis) - src) % W  # offset from src, traced
+    val = x
+    step = 1
+    while step < W:
+        perm = [(i, (i + step) % W) for i in range(W)]
+        recv = jax.lax.ppermute(val, axis, perm)
+        # the sender (offset d-step) holds a valid value iff d-step < step
+        use = (d >= step) & (d < 2 * step)
+        val = jax.tree.map(lambda r, v: jnp.where(use, r, v), recv, val)
+        step *= 2
+    return val
 
 
 def barrier(axis: str = "data"):

@@ -42,6 +42,11 @@ class DataLoader:
             raise ValueError(f"rank {rank} outside world {world_size}")
         if world_size > 1:
             per = len(x) // world_size
+            if per == 0:
+                raise ValueError(
+                    f"dataset of {len(x)} samples shards to 0 per rank "
+                    f"at world_size={world_size}; every rank would "
+                    "silently iterate zero batches")
             lo = rank * per
             x = x[lo:lo + per]
             y = y[lo:lo + per] if y is not None else None

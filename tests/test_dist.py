@@ -439,3 +439,36 @@ def test_grad_accum_composes_with_distopt():
                                   sorted(ma.get_params().items())):
         np.testing.assert_allclose(p1.to_numpy(), p2.to_numpy(),
                                    rtol=1e-5, atol=1e-6, err_msg=n1)
+
+
+@pytest.mark.parametrize("world,src", [(8, 0), (8, 5), (5, 2), (1, 0)])
+def test_broadcast_tree_correctness(world, src):
+    """broadcast replicates rank-src's value for pow2 and non-pow2
+    worlds, any src (distance-doubling ppermute tree)."""
+    from jax.sharding import Mesh
+
+    from singa_tpu.parallel import communicator as comm
+
+    mesh = Mesh(np.array(jax.devices()[:world]), ("data",))
+    xs = (jnp.arange(world, dtype=jnp.float32) * 10.0 + 1.0).reshape(world, 1)
+    f = jax.jit(jax.shard_map(
+        lambda x: comm.broadcast(x, "data", src=src), mesh=mesh,
+        in_specs=parallel.mesh.P("data"),
+        out_specs=parallel.mesh.P("data"), check_vma=False))
+    out = np.asarray(f(xs)).reshape(-1)
+    np.testing.assert_allclose(out, np.full(world, src * 10.0 + 1.0))
+
+
+def test_broadcast_lowers_to_collective_permute():
+    """the native broadcast must ride collective-permute, not mask+psum
+    (no all-reduce in the module)."""
+    from singa_tpu.parallel import communicator as comm
+
+    mesh = parallel.data_parallel_mesh(8)
+    f = jax.jit(jax.shard_map(
+        lambda x: comm.broadcast(x, "data", src=3), mesh=mesh,
+        in_specs=parallel.mesh.P("data"),
+        out_specs=parallel.mesh.P("data"), check_vma=False))
+    hlo = f.lower(jnp.zeros((8, 16), jnp.float32)).compile().as_text()
+    assert "collective-permute" in hlo
+    assert "all-reduce" not in hlo

@@ -1,0 +1,93 @@
+"""Watch for the TPU tunnel to recover, then immediately run the
+measurement session.
+
+The axon tunnel wedges server-side for hours at a time: a fresh
+process's `jax.devices()` blocks indefinitely.  This watcher probes in
+a subprocess with a timeout every PROBE_EVERY_S seconds; the first
+successful probe triggers `tools/tpu_session.py` (which writes
+PERF_NOTES.md + tpu_session.json and primes .jax_cache).
+
+Usage:  cd /root/repo && nohup setsid python tools/tpu_watch.py \
+            > /tmp/tpu_watch.out 2>&1 &
+        tail -f tpu_watch.log
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+_LOG = os.path.join(_REPO, "tpu_watch.log")
+_PROBE_TIMEOUT_S = float(os.environ.get("SINGA_WATCH_PROBE_TIMEOUT_S", "150"))
+_PROBE_EVERY_S = float(os.environ.get("SINGA_WATCH_PROBE_EVERY_S", "480"))
+_DEADLINE_H = float(os.environ.get("SINGA_WATCH_HOURS", "11"))
+
+_PROBE = ("import jax, jax.numpy as jnp;"
+          "d = jax.devices();"
+          "assert d[0].platform != 'cpu', d;"
+          "x = jnp.ones((256, 256), jnp.bfloat16);"
+          "jax.block_until_ready(jax.jit(lambda a: a @ a)(x));"
+          "print('TPU_PROBE_OK', d[0].device_kind)")
+
+
+def log(msg: str) -> None:
+    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+    with open(_LOG, "a") as f:
+        f.write(line + "\n")
+    print(line, flush=True)
+
+
+def _run_session() -> bool:
+    """Run tpu_session.py with a hard timeout (the tunnel can re-wedge
+    between the probe and the session's own backend init, hanging it
+    forever).  Success = the headline stage actually produced a result
+    in tpu_session.json — not merely rc==0."""
+    budget = float(os.environ.get("SINGA_TPU_SESSION_BUDGET_S", "1900"))
+    try:
+        rc = subprocess.run(
+            [sys.executable, os.path.join("tools", "tpu_session.py")],
+            cwd=_REPO, timeout=budget + 600).returncode
+    except subprocess.TimeoutExpired:
+        log(f"tpu_session.py hung >{budget + 600:.0f}s; killed")
+        return False
+    log(f"tpu_session.py exited rc={rc}")
+    try:
+        import json
+        with open(os.path.join(_REPO, "tpu_session.json")) as f:
+            stages = json.load(f).get("stages", {})
+        return bool(stages.get("llama_headline", {}).get("ok"))
+    except (OSError, ValueError):
+        return False
+
+
+def main() -> None:
+    deadline = time.time() + _DEADLINE_H * 3600
+    attempt = 0
+    log(f"watch start: probe every {_PROBE_EVERY_S:.0f}s, "
+        f"timeout {_PROBE_TIMEOUT_S:.0f}s, deadline {_DEADLINE_H:.1f}h")
+    while time.time() < deadline:
+        attempt += 1
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE], capture_output=True,
+                text=True, timeout=_PROBE_TIMEOUT_S)
+            if r.returncode == 0 and "TPU_PROBE_OK" in (r.stdout or ""):
+                log(f"probe #{attempt}: {r.stdout.strip()} — "
+                    "launching tpu_session.py")
+                if _run_session():
+                    return
+                log("session did not produce results; resuming watch")
+            else:
+                tail = ((r.stderr or "").strip().splitlines() or [""])[-1]
+                log(f"probe #{attempt}: rc={r.returncode} {tail[:160]}")
+        except subprocess.TimeoutExpired:
+            log(f"probe #{attempt}: hung >{_PROBE_TIMEOUT_S:.0f}s (wedged)")
+        time.sleep(_PROBE_EVERY_S)
+    log("deadline reached without a live chip")
+
+
+if __name__ == "__main__":
+    main()
