@@ -44,6 +44,24 @@ void sg_conv2d_nhwc(const float* x, const float* w, float* y,
 void sg_sgd_update(float* param, const float* grad, float* mom,
                    float lr, float momentum, float weight_decay, int64_t n);
 
+/* ---------------- pjrt_device ---------------- */
+/* Native TpuDevice touchpoint: load a PJRT plugin (libtpu.so), do the
+ * C-API version handshake, read plugin attributes; client creation is
+ * opt-in (can hang over a wedged tunneled backend).  pjrt_device.cc. */
+int64_t sg_pjrt_load(const char* so_path, int init, char* err,
+                     int64_t errcap);
+int64_t sg_pjrt_api_version(int64_t h, int32_t* major, int32_t* minor);
+int     sg_pjrt_init_error(int64_t h, char* buf, int64_t cap);
+int64_t sg_pjrt_attr_count(int64_t h);
+int     sg_pjrt_attr_get(int64_t h, int64_t i, char* name, int64_t ncap,
+                         char* val, int64_t vcap);
+int64_t sg_pjrt_client_create(int64_t h, char* err, int64_t errcap);
+int64_t sg_pjrt_client_device_count(int64_t c);
+int     sg_pjrt_client_platform(int64_t c, char* buf, int64_t cap);
+int     sg_pjrt_device_desc(int64_t c, int64_t i, char* buf, int64_t cap);
+void    sg_pjrt_client_destroy(int64_t c);
+void    sg_pjrt_unload(int64_t h);
+
 /* ---------------- scheduler ---------------- */
 /* Build a graph of ops; topo-sort; plan buffer reuse by liveness.
  * Handles are opaque int64 ids. */

@@ -187,6 +187,26 @@ def _declare(l: C.CDLL) -> None:
     l.sg_pool_free.argtypes = [C.c_void_p]
     l.sg_pool_bytes_in_use.restype = C.c_size_t
     l.sg_pool_bytes_reserved.restype = C.c_size_t
+    # PJRT touchpoint (pjrt_device.cc)
+    cp = C.c_char_p
+    l.sg_pjrt_load.restype = i64
+    l.sg_pjrt_load.argtypes = [cp, C.c_int, C.c_char_p, i64]
+    l.sg_pjrt_api_version.restype = i64
+    l.sg_pjrt_api_version.argtypes = [i64, C.POINTER(C.c_int32),
+                                      C.POINTER(C.c_int32)]
+    l.sg_pjrt_init_error.argtypes = [i64, C.c_char_p, i64]
+    l.sg_pjrt_attr_count.restype = i64
+    l.sg_pjrt_attr_count.argtypes = [i64]
+    l.sg_pjrt_attr_get.restype = C.c_int
+    l.sg_pjrt_attr_get.argtypes = [i64, i64, C.c_char_p, i64, C.c_char_p, i64]
+    l.sg_pjrt_client_create.restype = i64
+    l.sg_pjrt_client_create.argtypes = [i64, C.c_char_p, i64]
+    l.sg_pjrt_client_device_count.restype = i64
+    l.sg_pjrt_client_device_count.argtypes = [i64]
+    l.sg_pjrt_client_platform.argtypes = [i64, C.c_char_p, i64]
+    l.sg_pjrt_device_desc.argtypes = [i64, i64, C.c_char_p, i64]
+    l.sg_pjrt_client_destroy.argtypes = [i64]
+    l.sg_pjrt_unload.argtypes = [i64]
 
 
 def version() -> str:

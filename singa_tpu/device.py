@@ -34,6 +34,8 @@ __all__ = [
     "get_default_device",
     "set_default_device",
     "enable_lazy_alloc",
+    "pjrt_plugin_info",
+    "pjrt_native_probe",
 ]
 
 # dtype aliases used across the framework (proto-enum parity kept in
@@ -231,3 +233,92 @@ def set_default_device(dev: Device) -> None:
 def enable_lazy_alloc(flag: bool) -> None:
     """Reference-API no-op: PJRT owns allocation; kept for compatibility."""
     del flag
+
+
+# ---------------------------------------------------------------------------
+# native PJRT touchpoint (csrc/pjrt_device.cc) — SURVEY §7.1
+# ---------------------------------------------------------------------------
+
+def _default_plugin_path() -> Optional[str]:
+    import importlib.util
+    spec = importlib.util.find_spec("libtpu")
+    if spec and spec.submodule_search_locations:
+        p = os.path.join(list(spec.submodule_search_locations)[0],
+                         "libtpu.so")
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def pjrt_plugin_info(path: Optional[str] = None,
+                     init: bool = True) -> dict:
+    """Load a PJRT plugin through the NATIVE C++ core and return the
+    C-API handshake: {path, api_struct_size, api_version: (major,
+    minor), attributes: {name: value}, init_error}.
+
+    This is the device layer's C++ entry onto the TPU runtime
+    (csrc/pjrt_device.cc over the official pjrt_c_api.h).  It does NOT
+    create a client — safe even when the tunneled backend is wedged.
+    Raises RuntimeError if the native core or the plugin is
+    unavailable."""
+    import ctypes as C
+
+    from . import _core
+
+    l = _core.lib()
+    if l is None:
+        raise RuntimeError("native core unavailable (csrc build failed)")
+    path = path or _default_plugin_path()
+    if not path:
+        raise RuntimeError("no PJRT plugin path given and libtpu not found")
+    err = C.create_string_buffer(512)
+    h = l.sg_pjrt_load(path.encode(), 1 if init else 0, err, 512)
+    if h < 0:
+        raise RuntimeError(f"PJRT plugin load failed: {err.value.decode()}")
+    major, minor = C.c_int32(), C.c_int32()
+    ssize = l.sg_pjrt_api_version(h, C.byref(major), C.byref(minor))
+    attrs = {}
+    n = l.sg_pjrt_attr_count(h)
+    nb, vb = C.create_string_buffer(256), C.create_string_buffer(4096)
+    for i in range(max(0, n)):
+        if l.sg_pjrt_attr_get(h, i, nb, 256, vb, 4096) >= 0:
+            attrs[nb.value.decode()] = vb.value.decode()
+    l.sg_pjrt_init_error(h, vb, 4096)
+    return {"path": path, "api_struct_size": int(ssize),
+            "api_version": (major.value, minor.value),
+            "attributes": attrs, "init_error": vb.value.decode(),
+            "_handle": int(h)}
+
+
+def pjrt_native_probe(path: Optional[str] = None) -> dict:
+    """OPT-IN deep probe: create a PJRT client through the native core
+    and enumerate devices (platform name, per-device description).
+
+    WARNING: client creation over a wedged tunneled backend can block
+    indefinitely — call this in a subprocess with a timeout (the same
+    discipline as bench.py's TPU probe), and never while another client
+    in this process already holds the chip."""
+    import ctypes as C
+
+    from . import _core
+
+    info = pjrt_plugin_info(path)
+    l = _core.lib()
+    err = C.create_string_buffer(1024)
+    c = l.sg_pjrt_client_create(info["_handle"], err, 1024)
+    if c < 0:
+        raise RuntimeError(f"PJRT client create failed: {err.value.decode()}")
+    try:
+        buf = C.create_string_buffer(4096)
+        l.sg_pjrt_client_platform(c, buf, 4096)
+        platform = buf.value.decode()
+        ndev = l.sg_pjrt_client_device_count(c)
+        devices = []
+        for i in range(max(0, ndev)):
+            if l.sg_pjrt_device_desc(c, i, buf, 4096) == 0:
+                devices.append(buf.value.decode())
+        return {**{k: v for k, v in info.items() if k != "_handle"},
+                "platform": platform, "num_devices": int(ndev),
+                "devices": devices}
+    finally:
+        l.sg_pjrt_client_destroy(c)

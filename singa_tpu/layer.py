@@ -37,7 +37,7 @@ __all__ = [
     "MaxPool2d", "AvgPool2d", "GlobalAvgPool2d", "Flatten", "ReLU",
     "Sigmoid", "Tanh", "Gelu", "SiLU", "LeakyReLU", "Softmax", "Dropout",
     "Embedding", "LayerNorm", "RMSNorm", "RNN", "LSTM",
-    "MultiHeadAttention", "MoE", "Remat", "Sequential",
+    "MultiHeadAttention", "MoE", "Remat", "PipelineStack", "Sequential",
     "CrossEntropyLoss", "MSELoss",
 ]
 
@@ -840,6 +840,232 @@ def _walk_layers(l):
     yield l
     for s in l._sublayers.values():
         yield from _walk_layers(s)
+
+
+class _PipelineOp(autograd.Operator):
+    """GPipe over the 'pipe' mesh axis, expressed as ONE global-semantics
+    pure function (the TPU-native formulation — no shard_map):
+
+      * every block's params are stacked in-graph onto a leading
+        (stages, blocks_per_stage) axis and pinned to P('pipe') with a
+        sharding constraint, so each pipe rank materializes only its
+        stage's weights;
+      * each schedule tick runs `vmap` over the stage axis (all stages
+        compute concurrently on different microbatches — exactly the
+        per-rank stage step of parallel/pipeline.py's shard_map gpipe);
+      * the activation hand-off is `jnp.roll` along the 'pipe'-sharded
+        stage axis, which GSPMD lowers to a one-hop collective-permute
+        over ICI;
+      * `lax.scan` drives the n_micro + S - 1 ticks, and because scan
+        and roll differentiate, the jax.vjp-derived Operator backward IS
+        the reverse pipeline schedule (GPipe backward) for free.
+
+    Bubble ticks are masked to zero so they contribute nothing to
+    gradients.  Block internals optionally run under jax.checkpoint
+    (remat), composing PP with activation checkpointing.
+    """
+
+    def __init__(self, stack: "PipelineStack"):
+        super().__init__()
+        self.stack = stack
+
+    def fwd(self, x, *param_leaves):
+        import jax.numpy as jnp
+
+        from .parallel import mesh as mesh_mod
+
+        st = self.stack
+        blocks = st.inner
+        L, S, M = len(blocks), st.stages, st.n_micro
+        k = L // S
+        template = blocks[0]
+        tpl = template._param_list()
+        n_per = len(tpl)
+        blk_key = tensor_mod._next_key()
+        mesh = mesh_mod.current_mesh()
+
+        def constrain(a, *axes):
+            if mesh is None:
+                return a
+            spec = mesh_mod.P(*[ax if (ax in mesh.shape
+                                       and mesh.shape[ax] > 1) else None
+                                for ax in axes])
+            return jax.lax.with_sharding_constraint(
+                a, mesh_mod.NamedSharding(mesh, spec))
+
+        def apply_block(leaves, h):
+            saved = [(t.data, t.requires_grad, t.stores_grad) for t in tpl]
+            saved_key = tensor_mod._rng_key
+            try:
+                tensor_mod._rng_key = blk_key
+                for t, a in zip(tpl, leaves):
+                    t.data = a
+                    t.requires_grad = False
+                    t.stores_grad = False
+                out = template.forward(Tensor(data=h, requires_grad=False))
+                return out.data
+            finally:
+                tensor_mod._rng_key = saved_key
+                for t, (d, rg, sg) in zip(tpl, saved):
+                    t.data = d
+                    t.requires_grad = rg
+                    t.stores_grad = sg
+
+        if st.remat:
+            apply_block = jax.checkpoint(apply_block)
+
+        def pure(x_a, *leaves):
+            B = x_a.shape[0]
+            if B % M:
+                raise ValueError(
+                    f"batch {B} not divisible by n_micro={M}")
+            mb = B // M
+            # stack blocks-major flat leaves into per-param
+            # (S, k, *param_shape) arrays, stage axis sharded over 'pipe'
+            stacked = tuple(
+                constrain(
+                    jnp.stack([leaves[b * n_per + j] for b in range(L)])
+                    .reshape((S, k) + leaves[j].shape), "pipe")
+                for j in range(n_per))
+            x_micro = x_a.reshape((M, mb) + x_a.shape[1:])
+
+            def stage_fn(stage_leaves, h):
+                for i in range(k):
+                    h = apply_block([a[i] for a in stage_leaves], h)
+                return h
+
+            vstage = jax.vmap(stage_fn, in_axes=(0, 0))
+            act_shape = (mb,) + x_a.shape[1:]
+            bufs0 = jnp.zeros((S,) + act_shape, x_a.dtype).at[0].set(
+                x_micro[0])
+            outs0 = jnp.zeros((M,) + act_shape, x_a.dtype)
+            sidx = jnp.arange(S)
+            bcast = (S,) + (1,) * len(act_shape)
+
+            def tick(carry, t):
+                bufs, outs = carry
+                bufs = constrain(bufs, "pipe", "data")
+                ys = vstage(stacked, bufs)
+                live = ((t - sidx) >= 0) & ((t - sidx) < M)
+                ys = jnp.where(live.reshape(bcast), ys, 0)
+                oidx = t - (S - 1)
+                rec = jax.lax.dynamic_update_index_in_dim(
+                    outs, ys[S - 1], jnp.clip(oidx, 0, M - 1), axis=0)
+                outs = jnp.where(oidx >= 0, rec, outs)
+                bufs = jnp.roll(ys, 1, axis=0)
+                nxt = jax.lax.dynamic_index_in_dim(
+                    x_micro, jnp.clip(t + 1, 0, M - 1), axis=0,
+                    keepdims=False)
+                inj = jnp.where(t + 1 < M, nxt, jnp.zeros_like(nxt))
+                bufs = bufs.at[0].set(inj)
+                return (bufs, outs), None
+
+            (_, outs), _ = jax.lax.scan(
+                tick, (bufs0, outs0), jnp.arange(M + S - 1))
+            return outs.reshape((B,) + x_a.shape[1:])
+
+        return pure(x, *param_leaves)
+
+
+class PipelineStack(Layer):
+    """Pipeline-parallel stack of identical shape-preserving blocks
+    (transformer blocks): the Model-API surface for the 'pipe' mesh
+    axis.
+
+    Parameter paths are IDENTICAL to a plain block list (`self.blocks =
+    PipelineStack([...])` exposes "blocks.0..." exactly like
+    `self.blocks = [...]`), so checkpoints round-trip between pipelined
+    and sequential instantiations, and DistOpt/ZeRO-1 compose
+    unchanged.
+
+    Forward dispatch:
+      * a mesh with a 'pipe' axis (>1) in training → the GPipe schedule
+        (_PipelineOp), n_micro microbatches over the batch dim;
+      * otherwise (no mesh, eval, KV-cached decode, lazy init) → plain
+        sequential application, numerically the reference behavior.
+
+    Constraints: len(blocks) % stages == 0, all blocks structurally
+    identical, batch % n_micro == 0, blocks buffer-free (same rule as
+    layer.Remat); block-internal dropout draws one shared key (Llama
+    blocks carry no dropout).
+    """
+
+    def __init__(self, blocks, stages: int, n_micro: Optional[int] = None,
+                 remat: bool = False, name=None):
+        super().__init__(name)
+        if stages < 1 or len(blocks) % stages:
+            raise ValueError(
+                f"{len(blocks)} blocks do not divide into {stages} stages")
+        self.inner = list(blocks)
+        self.stages = stages
+        self.n_micro = n_micro or stages
+        self.remat = remat
+
+    # param/state paths mirror a plain list attribute ("0.", "1.", ...)
+    def get_params(self, prefix: str = "") -> Dict[str, Tensor]:
+        out = dict()
+        for i, blk in enumerate(self.inner):
+            out.update(blk.get_params(f"{prefix}{i}."))
+        return out
+
+    def set_params(self, params, prefix: str = "") -> None:
+        for i, blk in enumerate(self.inner):
+            blk.set_params(params, f"{prefix}{i}.")
+
+    def _get_buffers(self, prefix: str = "") -> Dict[str, Tensor]:
+        out = dict()
+        for i, blk in enumerate(self.inner):
+            out.update(blk._get_buffers(f"{prefix}{i}."))
+        return out
+
+    def set_states(self, states, prefix: str = "") -> None:
+        for i, blk in enumerate(self.inner):
+            blk.set_states(states, f"{prefix}{i}.")
+
+    def __iter__(self):
+        return iter(self.inner)
+
+    def __len__(self):
+        return len(self.inner)
+
+    def _pipe_live(self) -> bool:
+        from .parallel import mesh as mesh_mod
+        m = mesh_mod.current_mesh()
+        if m is None:
+            return False
+        pipe = m.shape.get("pipe", 0)
+        if pipe == self.stages > 1:
+            return True
+        if pipe > 1 and pipe != self.stages:
+            # a misconfigured pipe axis must not silently train
+            # unpipelined with pipe-axis devices replicating work
+            import warnings
+            warnings.warn(
+                f"PipelineStack({self.name}): mesh 'pipe' axis is "
+                f"{pipe} but stages={self.stages}; running "
+                "sequentially (set pipeline_stages to the mesh's pipe "
+                "size)", stacklevel=3)
+        return False
+
+    def forward(self, x: Tensor) -> Tensor:
+        ready = all(b._initialized for b in self.inner)
+        if not (ready and autograd.is_training() and self._pipe_live()):
+            for blk in self.inner:
+                x = blk(x)
+            return x
+        if any(b._buffer_list() for b in self.inner):
+            import warnings
+            warnings.warn(
+                f"PipelineStack({self.name}) running sequentially: "
+                "blocks hold non-trainable buffers (the pipelined "
+                "forward must be replayable)", stacklevel=2)
+            for blk in self.inner:
+                x = blk(x)
+            return x
+        leaves = []
+        for blk in self.inner:
+            leaves.extend(blk._param_list())
+        return _PipelineOp(self)(x, *leaves)
 
 
 class Sequential(Layer):

@@ -67,6 +67,15 @@ class LlamaConfig:
     # block internals recomputed in backward — O(layers) less activation
     # HBM for one extra forward; param paths unchanged
     remat: bool = False
+    # pipeline parallelism over the 'pipe' mesh axis: blocks divide into
+    # this many stages driven by the GPipe schedule
+    # (layer.PipelineStack — global-semantics vmap+roll formulation, so
+    # it composes with DistOpt/'data' sharding and remat).  0 = off.
+    # Param paths are unchanged, so checkpoints round-trip between
+    # pipelined and sequential configs.
+    pipeline_stages: int = 0
+    # microbatches per step when pipelining (default: = stages)
+    pipeline_microbatches: int = 0
 
     @staticmethod
     def llama3_8b() -> "LlamaConfig":
@@ -169,17 +178,29 @@ class Llama(GenerateMixin, model.Model):
         c = self.cfg
         self.tok_emb = layer.Embedding(c.vocab_size, c.dim)
         blocks = [_LlamaBlock(c) for _ in range(c.num_layers)]
-        if c.remat:
-            blocks = [layer.Remat(b) for b in blocks]
-        self.blocks = blocks
+        if c.pipeline_stages:
+            # embed and lm head stay outside the pipeline (replicated /
+            # 'model'-sharded as usual); only the shape-preserving block
+            # stack rides the 'pipe' axis.  remat folds into the stack
+            # (per-block jax.checkpoint inside the schedule).
+            self.blocks = layer.PipelineStack(
+                blocks, stages=c.pipeline_stages,
+                n_micro=c.pipeline_microbatches or None, remat=c.remat)
+        else:
+            if c.remat:
+                blocks = [layer.Remat(b) for b in blocks]
+            self.blocks = blocks
         self.norm_f = layer.RMSNorm(c.dim, eps=c.eps)
         self.lm_head = layer.Linear(c.vocab_size, bias=False)
 
     def features(self, ids: Tensor) -> Tensor:
         """Final hidden states (B, T, dim) — everything but the lm head."""
         x = self.tok_emb(ids)
-        for blk in self.blocks:
-            x = blk(x)
+        if isinstance(self.blocks, layer.PipelineStack):
+            x = self.blocks(x)
+        else:
+            for blk in self.blocks:
+                x = blk(x)
         return self.norm_f(x)
 
     def forward(self, ids: Tensor) -> Tensor:

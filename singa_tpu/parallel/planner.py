@@ -81,21 +81,39 @@ def _reset_lazy(layer) -> None:
 
 @dataclass
 class MemoryPlan:
+    """Per-device memory accounting for one compiled train step.
+
+    Gradient accounting: `grad_bytes_per_device` is the BACKWARD PEAK —
+    one full gradient set at the params' shardings (gradients
+    materialize at param shardings before the update consumes them,
+    ZeRO-1 or not).  Under ZeRO-1 (`DistOpt(shard_weight_update=True)`)
+    the update itself only holds the reduce-scattered 1/W shard —
+    reported separately as `grad_bytes_update_per_device` — and the
+    durable saving shows up in `slot_bytes_per_device`, whose moments
+    are sharded over the data axis.  A GradAccum wrapper's f32
+    accumulator is part of the optimizer state tree (opt.init), so it
+    is counted in `slot_bytes_per_device`, not here.
+    """
+
     mesh_shape: Dict[str, int]
     param_bytes_global: int
     param_bytes_per_device: int
     slot_bytes_per_device: int
     grad_bytes_per_device: int
+    # gradient residency during the (possibly ZeRO-1-sharded) update
+    grad_bytes_update_per_device: int = 0
     per_device_state_bytes: int = field(init=False)
     lowered: object = None
 
     def __post_init__(self):
+        if not self.grad_bytes_update_per_device:
+            self.grad_bytes_update_per_device = self.grad_bytes_per_device
         self.per_device_state_bytes = (self.param_bytes_per_device
                                        + self.slot_bytes_per_device
                                        + self.grad_bytes_per_device)
 
     def fits(self, chip: str = "v4", headroom: float = 0.75) -> bool:
-        """True when params + moments + one gradient set leave
+        """True when params + moments + one peak gradient set leave
         `1-headroom` of the chip's HBM for activations/workspace."""
         return self.per_device_state_bytes <= HBM_BYTES[chip] * headroom
 
@@ -149,8 +167,13 @@ def plan_train_step(model, optimizer, batch_sds,
                             jax.tree.leaves(slot_sh[n],
                                             is_leaf=lambda x: hasattr(x, "spec"))):
             sb_dev += _sharded_bytes(leaf.shape, leaf.dtype, sh)
-    # gradients live at param shardings for one step
+    # backward peak: one gradient set at param shardings; update-time
+    # residency shrinks 1/W under ZeRO-1 (reduce-scattered into the
+    # sharded update) — see MemoryPlan docstring
     gb_dev = pb_dev
+    zero1_ax = spmd.zero1_axis_for(optimizer, mesh)
+    gb_upd = (math.ceil(pb_dev / mesh.shape[zero1_ax])
+              if zero1_ax else pb_dev)
 
     lowered = None
     if lower:
@@ -180,4 +203,5 @@ def plan_train_step(model, optimizer, batch_sds,
                       param_bytes_per_device=pb_dev,
                       slot_bytes_per_device=sb_dev,
                       grad_bytes_per_device=gb_dev,
+                      grad_bytes_update_per_device=gb_upd,
                       lowered=lowered)

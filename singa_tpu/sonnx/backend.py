@@ -163,11 +163,30 @@ def _h_unary(ctx, node, attrs, ins):
 
 # -- elementwise binary / variadic ------------------------------------------
 
+def _onnx_div_jnp(a, b):
+    """ONNX Div: C-style truncating division for integer operands
+    (torch exports chunk/shape arithmetic as int64 Div; true division
+    would leak floats into downstream Slice/Reshape bounds)."""
+    a, b = jnp.asarray(a), jnp.asarray(b)
+    if (jnp.issubdtype(a.dtype, jnp.integer)
+            and jnp.issubdtype(b.dtype, jnp.integer)):
+        return jnp.sign(a) * jnp.sign(b) * (jnp.abs(a) // jnp.abs(b))
+    return jnp.divide(a, b)
+
+
+def _onnx_div_np(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    if (np.issubdtype(a.dtype, np.integer)
+            and np.issubdtype(b.dtype, np.integer)):
+        return np.sign(a) * np.sign(b) * (np.abs(a) // np.abs(b))
+    return np.divide(a, b)
+
+
 _BINARY = {
     "Add": (jnp.add, np.add),
     "Sub": (jnp.subtract, np.subtract),
     "Mul": (jnp.multiply, np.multiply),
-    "Div": (jnp.divide, np.divide),
+    "Div": (_onnx_div_jnp, _onnx_div_np),
     "Pow": (jnp.power, np.power),
     "Equal": (jnp.equal, np.equal),
     "Greater": (jnp.greater, np.greater),

@@ -405,3 +405,41 @@ class TestCExtensionBinding:
         with pytest.raises(ValueError, match="inconsistent"):
             e.gemm(np.zeros(4, np.float32), np.zeros(4, np.float32),
                    np.zeros((8, 8), np.float32), 8, 8, 8, False, False)
+
+
+class TestPjrtTouchpoint:
+    """Native TpuDevice surface (csrc/pjrt_device.cc over the official
+    pjrt_c_api.h): plugin load + C-API version handshake + attributes.
+    Client creation is NOT exercised here — it can hang over a wedged
+    tunneled backend (docs/native_tpu_device.md)."""
+
+    def test_plugin_handshake_against_libtpu(self):
+        from singa_tpu import device as device_mod
+        if _core.lib() is None:
+            pytest.skip("native core unavailable")
+        if device_mod._default_plugin_path() is None:
+            pytest.skip("libtpu not in this environment")
+        info = device_mod.pjrt_plugin_info()
+        assert info["api_struct_size"] > 0
+        major, minor = info["api_version"]
+        assert major >= 0 and minor > 0, info["api_version"]
+        assert info["init_error"] == ""
+        # libtpu publishes at least the xla/stablehlo version attrs
+        assert "xla_version" in info["attributes"], info["attributes"]
+
+    def test_plugin_load_bad_path_raises(self):
+        from singa_tpu import device as device_mod
+        if _core.lib() is None:
+            pytest.skip("native core unavailable")
+        with pytest.raises(RuntimeError, match="load failed"):
+            device_mod.pjrt_plugin_info(path="/nonexistent/plugin.so")
+
+    def test_plugin_load_non_pjrt_so_raises(self):
+        """A real shared object without GetPjrtApi must be rejected by
+        the symbol check, not crash."""
+        from singa_tpu import device as device_mod
+        from singa_tpu._core import _SO
+        if _core.lib() is None:
+            pytest.skip("native core unavailable")
+        with pytest.raises(RuntimeError, match="GetPjrtApi"):
+            device_mod.pjrt_plugin_info(path=str(_SO))

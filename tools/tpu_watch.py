@@ -46,6 +46,13 @@ def _run_session() -> bool:
     forever).  Success = the headline stage actually produced a result
     in tpu_session.json — not merely rc==0."""
     budget = float(os.environ.get("SINGA_TPU_SESSION_BUDGET_S", "1900"))
+    # a stale results file from an earlier session must not count as
+    # this run's success
+    results = os.path.join(_REPO, "tpu_session.json")
+    try:
+        os.remove(results)
+    except OSError:
+        pass
     try:
         rc = subprocess.run(
             [sys.executable, os.path.join("tools", "tpu_session.py")],
@@ -56,7 +63,7 @@ def _run_session() -> bool:
     log(f"tpu_session.py exited rc={rc}")
     try:
         import json
-        with open(os.path.join(_REPO, "tpu_session.json")) as f:
+        with open(results) as f:
             stages = json.load(f).get("stages", {})
         return bool(stages.get("llama_headline", {}).get("ok"))
     except (OSError, ValueError):
