@@ -96,7 +96,8 @@ int64_t sg_pjrt_load(const char* so_path, int init, char* err,
   p.dl = dlopen(so_path, RTLD_NOW | RTLD_LOCAL);
   if (!p.dl) {
     const char* m = dlerror();
-    copy_str(m ? m : "dlopen failed", m ? std::strlen(m) : 12, err, errcap);
+    if (!m) m = "dlopen failed";
+    copy_str(m, std::strlen(m), err, errcap);
     return -1;
   }
   using GetApiFn = const PJRT_Api* (*)();

@@ -36,6 +36,11 @@ def main():
     p.add_argument("--dp", type=int, default=1, help="data-parallel ways")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel ways")
     p.add_argument("--sp", type=int, default=1, help="sequence-parallel ways")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel stages (GPipe over the "
+                        "'pipe' mesh axis; layers must divide evenly)")
+    p.add_argument("--micro", type=int, default=0,
+                   help="pipeline microbatches (default: = --pp)")
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, default=128)
     p.add_argument("--steps", type=int, default=10)
@@ -74,9 +79,13 @@ def main():
     cfg = presets[args.preset]()
     if args.fused_loss:
         cfg.fused_loss = True
+    if args.pp > 1:
+        cfg.pipeline_stages = args.pp
+        cfg.pipeline_microbatches = args.micro
 
     axes = {k: v for k, v in
-            (("data", args.dp), ("model", args.tp), ("seq", args.sp))
+            (("data", args.dp), ("model", args.tp), ("seq", args.sp),
+             ("pipe", args.pp))
             if v > 1} or {"data": 1}
     mesh = parallel.make_mesh(axes)
     parallel.set_mesh(mesh)

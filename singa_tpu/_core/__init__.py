@@ -187,7 +187,11 @@ def _declare(l: C.CDLL) -> None:
     l.sg_pool_free.argtypes = [C.c_void_p]
     l.sg_pool_bytes_in_use.restype = C.c_size_t
     l.sg_pool_bytes_reserved.restype = C.c_size_t
-    # PJRT touchpoint (pjrt_device.cc)
+    # PJRT touchpoint (pjrt_device.cc) — OPTIONAL: the Makefile skips
+    # it when the official pjrt_c_api.h is absent, and its absence must
+    # not take down the rest of the native core
+    if not hasattr(l, "sg_pjrt_load"):
+        return
     cp = C.c_char_p
     l.sg_pjrt_load.restype = i64
     l.sg_pjrt_load.argtypes = [cp, C.c_int, C.c_char_p, i64]

@@ -1000,6 +1000,13 @@ class PipelineStack(Layer):
         self.stages = stages
         self.n_micro = n_micro or stages
         self.remat = remat
+        # remat must survive the sequential fallback too (a user who
+        # sized HBM with remat=True would otherwise OOM on a pipe-less
+        # mesh).  The wrappers share the inner blocks; bypass __setattr__
+        # so they are not registered as duplicate sublayers for the
+        # lazy-init walk.
+        self.__dict__["_seq"] = ([Remat(b) for b in self.inner] if remat
+                                 else self.inner)
 
     # param/state paths mirror a plain list attribute ("0.", "1.", ...)
     def get_params(self, prefix: str = "") -> Dict[str, Tensor]:
@@ -1050,7 +1057,7 @@ class PipelineStack(Layer):
     def forward(self, x: Tensor) -> Tensor:
         ready = all(b._initialized for b in self.inner)
         if not (ready and autograd.is_training() and self._pipe_live()):
-            for blk in self.inner:
+            for blk in self._seq:
                 x = blk(x)
             return x
         if any(b._buffer_list() for b in self.inner):
@@ -1059,7 +1066,7 @@ class PipelineStack(Layer):
                 f"PipelineStack({self.name}) running sequentially: "
                 "blocks hold non-trainable buffers (the pipelined "
                 "forward must be replayable)", stacklevel=2)
-            for blk in self.inner:
+            for blk in self._seq:
                 x = blk(x)
             return x
         leaves = []

@@ -83,16 +83,6 @@ def main() -> None:
     if _SMOKE:
         jax.config.update("jax_platforms", "cpu")
 
-    # persistent compile cache: the driver's bench.py reuses these
-    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                         ".jax_cache")
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    except Exception as e:
-        mark(f"cache config unavailable: {type(e).__name__}")
-
     import jax.numpy as jnp
 
     @stage("probe", 60)
@@ -101,11 +91,29 @@ def main() -> None:
         x = jnp.ones((256, 256), jnp.bfloat16)
         jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
         _RESULTS["device"] = getattr(d[0], "device_kind", d[0].platform)
-        return _RESULTS["device"]
+        return d[0].platform
 
-    if probe() is None:
+    platform = probe()
+    if platform is None:
         _finish()
         return
+
+    # persistent compile cache: the driver's bench.py reuses these.
+    # Keyed on the DETECTED backend, not smoke mode: XLA:CPU entries are
+    # AOT-compiled for THIS host's CPU features and poison later runs on
+    # other machines (BENCH_r03: SIGILL-risk warnings flooded the
+    # driver's tail capture) — a non-smoke session that fell back to CPU
+    # must not write them either
+    if platform != "cpu":
+        cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "..", ".jax_cache")
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              1.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        except Exception as e:
+            mark(f"cache config unavailable: {type(e).__name__}")
 
     @stage("flash_fwd_bwd", 120)
     def flash():
@@ -282,6 +290,35 @@ def main() -> None:
                 "samples_per_s": round(b / dt, 1)}
 
     bert()
+
+    @stage("llama_generate", 240)
+    def generate():
+        # KV-cached decode throughput: prefill + N greedy steps through
+        # the jitted _GenSession (compile-once asserted)
+        tensor.set_seed(0)
+        np.random.seed(0)
+        cfg = models.LlamaConfig.tiny() if _SMOKE \
+            else models.LlamaConfig.small()
+        B, P, N = (2, 16, 8) if _SMOKE else (8, 128, 128)
+        gm = models.Llama(cfg)
+        gm.eval()
+        prompt = np.random.randint(0, cfg.vocab_size, (B, P)).astype(np.int32)
+        gm.compile([tensor.from_numpy(prompt)], is_train=False,
+                   use_graph=True)
+        t0 = time.time()
+        gm.generate(prompt, max_new_tokens=N)
+        t_first = time.time() - t0
+        t0 = time.perf_counter()
+        out = gm.generate(prompt, max_new_tokens=N)
+        dt = time.perf_counter() - t0
+        assert out.shape == (B, P + N)
+        assert len(gm._gen_sessions) == 1
+        return {"batch": B, "prompt": P, "new_tokens": N,
+                "first_call_s": round(t_first, 1),
+                "tokens_per_s": round(B * N / dt, 1),
+                "ms_per_token": round(dt / N * 1e3, 2)}
+
+    generate()
 
     @stage("llama_batch32", 300)
     def batch32():
