@@ -865,9 +865,13 @@ class _PipelineOp(autograd.Operator):
     (remat), composing PP with activation checkpointing.
     """
 
-    def __init__(self, stack: "PipelineStack"):
+    def __init__(self, stack: "PipelineStack", extras=()):
         super().__init__()
         self.stack = stack
+        # non-grad, batch-leading extra arrays (e.g. a (B,1,1,T) padding
+        # mask): microbatched alongside x and gathered per stage per
+        # tick, so masked transformer blocks pipeline too
+        self.extras = tuple(extras)
 
     def fwd(self, x, *param_leaves):
         import jax.numpy as jnp
@@ -883,6 +887,7 @@ class _PipelineOp(autograd.Operator):
         n_per = len(tpl)
         blk_key = tensor_mod._next_key()
         mesh = mesh_mod.current_mesh()
+        extras = self.extras
 
         def constrain(a, *axes):
             if mesh is None:
@@ -893,7 +898,7 @@ class _PipelineOp(autograd.Operator):
             return jax.lax.with_sharding_constraint(
                 a, mesh_mod.NamedSharding(mesh, spec))
 
-        def apply_block(leaves, h):
+        def apply_block(leaves, h, *ex):
             saved = [(t.data, t.requires_grad, t.stores_grad) for t in tpl]
             saved_key = tensor_mod._rng_key
             try:
@@ -902,7 +907,9 @@ class _PipelineOp(autograd.Operator):
                     t.data = a
                     t.requires_grad = False
                     t.stores_grad = False
-                out = template.forward(Tensor(data=h, requires_grad=False))
+                out = template.forward(
+                    Tensor(data=h, requires_grad=False),
+                    *(Tensor(data=e, requires_grad=False) for e in ex))
                 return out.data
             finally:
                 tensor_mod._rng_key = saved_key
@@ -928,13 +935,16 @@ class _PipelineOp(autograd.Operator):
                     .reshape((S, k) + leaves[j].shape), "pipe")
                 for j in range(n_per))
             x_micro = x_a.reshape((M, mb) + x_a.shape[1:])
+            ex_micro = tuple(e.reshape((M, mb) + e.shape[1:])
+                             for e in extras)
 
-            def stage_fn(stage_leaves, h):
+            def stage_fn(stage_leaves, h, *ex):
                 for i in range(k):
-                    h = apply_block([a[i] for a in stage_leaves], h)
+                    h = apply_block([a[i] for a in stage_leaves], h, *ex)
                 return h
 
-            vstage = jax.vmap(stage_fn, in_axes=(0, 0))
+            vstage = jax.vmap(stage_fn,
+                              in_axes=(0, 0) + (0,) * len(extras))
             act_shape = (mb,) + x_a.shape[1:]
             bufs0 = jnp.zeros((S,) + act_shape, x_a.dtype).at[0].set(
                 x_micro[0])
@@ -945,7 +955,11 @@ class _PipelineOp(autograd.Operator):
             def tick(carry, t):
                 bufs, outs = carry
                 bufs = constrain(bufs, "pipe", "data")
-                ys = vstage(stacked, bufs)
+                # stage s works on microbatch t-s this tick: gather its
+                # slice of every extra (mask etc.)
+                midx = jnp.clip(t - sidx, 0, M - 1)
+                ex_s = tuple(jnp.take(em, midx, axis=0) for em in ex_micro)
+                ys = vstage(stacked, bufs, *ex_s)
                 live = ((t - sidx) >= 0) & ((t - sidx) < M)
                 ys = jnp.where(live.reshape(bcast), ys, 0)
                 oidx = t - (S - 1)
@@ -1054,25 +1068,53 @@ class PipelineStack(Layer):
                 "size)", stacklevel=3)
         return False
 
-    def forward(self, x: Tensor) -> Tensor:
+    def forward(self, x: Tensor, *rest) -> Tensor:
+        rest = tuple(r for r in rest if r is not None)
+
+        def sequential():
+            h = x
+            for blk in self._seq:
+                h = blk(h, *rest) if rest else blk(h)
+            return h
+
         ready = all(b._initialized for b in self.inner)
         if not (ready and autograd.is_training() and self._pipe_live()):
-            for blk in self._seq:
-                x = blk(x)
-            return x
-        if any(b._buffer_list() for b in self.inner):
+            return sequential()
+        why = self._pipe_blocker(x, rest)
+        if why:
             import warnings
             warnings.warn(
-                f"PipelineStack({self.name}) running sequentially: "
-                "blocks hold non-trainable buffers (the pipelined "
-                "forward must be replayable)", stacklevel=2)
-            for blk in self._seq:
-                x = blk(x)
-            return x
+                f"PipelineStack({self.name}) running sequentially: {why}",
+                stacklevel=2)
+            return sequential()
         leaves = []
         for blk in self.inner:
             leaves.extend(blk._param_list())
-        return _PipelineOp(self)(x, *leaves)
+        extras = tuple(r.data if isinstance(r, Tensor) else jnp.asarray(r)
+                       for r in rest)
+        return _PipelineOp(self, extras)(x, *leaves)
+
+    def _pipe_blocker(self, x, rest) -> Optional[str]:
+        """Reason the GPipe path cannot run (None = it can)."""
+        if any(b._buffer_list() for b in self.inner):
+            return ("blocks hold non-trainable buffers (the pipelined "
+                    "forward must be replayable)")
+        B = x.shape[0]
+        if B % self.n_micro:
+            return f"batch {B} not divisible by n_micro={self.n_micro}"
+        for r in rest:
+            if isinstance(r, Tensor) and r.requires_grad:
+                return "gradient-carrying extra args are unsupported"
+            shape = getattr(r, "shape", None)
+            if not shape or shape[0] != B:
+                return (f"extra arg must be batch-leading (got shape "
+                        f"{shape}, batch {B})")
+        for blk in self.inner:
+            for l in _walk_layers(blk):
+                if isinstance(l, Dropout) and l.p > 0:
+                    return ("Dropout(p>0) inside blocks would draw "
+                            "different keys than sequential execution")
+        return None
 
 
 class Sequential(Layer):

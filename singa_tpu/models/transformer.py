@@ -91,6 +91,12 @@ class GPT2Config:
     # thread through the checkpoint as saved non-grad residuals, so
     # masked calls remat too)
     remat: bool = False
+    # pipeline parallelism over the 'pipe' mesh axis
+    # (layer.PipelineStack); padding masks ride the schedule as
+    # microbatched extras.  Requires dropout=0.0 for exact sequential
+    # parity (the stack falls back to sequential otherwise).  0 = off.
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 0
 
     @staticmethod
     def tiny() -> "GPT2Config":
@@ -133,9 +139,14 @@ class GPT2(GenerateMixin, model.Model):
         self.wpe = layer.Embedding(c.max_position, c.dim)
         self.drop = layer.Dropout(c.dropout)
         blocks = [_GPT2Block(c) for _ in range(c.num_layers)]
-        if c.remat:
-            blocks = [layer.Remat(b) for b in blocks]
-        self.blocks = blocks
+        if c.pipeline_stages:
+            self.blocks = layer.PipelineStack(
+                blocks, stages=c.pipeline_stages,
+                n_micro=c.pipeline_microbatches or None, remat=c.remat)
+        else:
+            if c.remat:
+                blocks = [layer.Remat(b) for b in blocks]
+            self.blocks = blocks
         self.ln_f = layer.LayerNorm(c.dim)
 
     def features(self, ids: Tensor,
@@ -146,11 +157,16 @@ class GPT2(GenerateMixin, model.Model):
             mask = Tensor(data=mask, device=ids.device, requires_grad=False)
         x = self.wte(ids) + self.wpe(_positions(ids))
         x = self.drop(x)
-        for blk in self.blocks:
-            # mask is an optional extra; when present, layer.Remat
-            # carries it as a saved (non-grad) residual through the
-            # checkpoint, so both call forms remat
-            x = blk(x) if mask is None else blk(x, mask)
+        if isinstance(self.blocks, layer.PipelineStack):
+            # mask (None filtered by the stack) rides the GPipe
+            # schedule as a microbatched extra
+            x = self.blocks(x, mask)
+        else:
+            for blk in self.blocks:
+                # mask is an optional extra; when present, layer.Remat
+                # carries it as a saved (non-grad) residual through the
+                # checkpoint, so both call forms remat
+                x = blk(x) if mask is None else blk(x, mask)
         return self.ln_f(x)
 
     def _tied_head_w(self, x: Tensor) -> Tensor:

@@ -213,3 +213,171 @@ class TestModelAPIPipeline:
         cfg.pipeline_stages = 4
         with pytest.raises(ValueError, match="stages"):
             models.Llama(cfg)
+
+
+class TestPipelineComposition:
+    """The Model-API pipeline composes with the other mesh axes on one
+    3-D mesh: activations data+seq sharded (ring attention under 'seq'),
+    or TP rules on the non-pipelined embed/head, all while the block
+    stack rides 'pipe' — and the result equals sequential training."""
+
+    def _run(self, axes, pipe_stages, steps=3):
+        from singa_tpu import models, opt, tensor
+        jax.config.update("jax_default_matmul_precision", "highest")
+        tensor.set_seed(0)
+        np.random.seed(0)
+        cfg = models.LlamaConfig.tiny()
+        cfg.num_layers = 4
+        cfg.pipeline_stages = pipe_stages
+        parallel.set_mesh(parallel.make_mesh(axes) if axes else None)
+        try:
+            m = models.Llama(cfg)
+            m.set_optimizer(
+                opt.DistOpt(opt.SGD(lr=0.05, momentum=0.9)) if axes
+                else opt.SGD(lr=0.05, momentum=0.9))
+            ids = tensor.from_numpy(np.random.randint(
+                0, cfg.vocab_size, (8, 32)).astype(np.int32))
+            m.compile([ids], is_train=True, use_graph=True)
+            losses = [float(m.train_step(ids)[1].to_numpy())
+                      for _ in range(steps)]
+            if pipe_stages:
+                # parity must not pass vacuously via a silent
+                # sequential fallback
+                assert "collective-permute" in m.graph.compiled_hlo()
+            return losses
+        finally:
+            parallel.set_mesh(None)
+
+    def test_dp_sp_pipe_matches_sequential(self):
+        l_seq = self._run(None, 0)
+        l_3d = self._run({"data": 2, "seq": 2, "pipe": 2}, 2)
+        np.testing.assert_allclose(l_seq, l_3d, rtol=2e-4, atol=2e-5)
+
+    def test_dp_tp_pipe_matches_sequential(self):
+        l_seq = self._run(None, 0)
+        l_3d = self._run({"data": 2, "model": 2, "pipe": 2}, 2)
+        np.testing.assert_allclose(l_seq, l_3d, rtol=2e-4, atol=2e-5)
+
+
+class TestPipelineExtras:
+    """Masked transformer blocks pipeline too: non-grad batch-leading
+    extras (padding masks) are microbatched and gathered per stage per
+    tick; GPT-2 gains pipeline_stages."""
+
+    def test_gpt2_pipeline_matches_sequential(self):
+        from singa_tpu import models, opt, tensor
+
+        def run(pipe):
+            jax.config.update("jax_default_matmul_precision", "highest")
+            tensor.set_seed(0)
+            np.random.seed(0)
+            cfg = models.GPT2Config.tiny()
+            cfg.num_layers = 4
+            cfg.dropout = 0.0
+            cfg.pipeline_stages = 4 if pipe else 0
+            parallel.set_mesh(
+                parallel.make_mesh({"data": 2, "pipe": 4}) if pipe
+                else None)
+            try:
+                m = models.GPT2(cfg)
+                m.set_optimizer(
+                    opt.DistOpt(opt.SGD(lr=0.05, momentum=0.9)) if pipe
+                    else opt.SGD(lr=0.05, momentum=0.9))
+                ids = tensor.from_numpy(np.random.randint(
+                    0, cfg.vocab_size, (8, 16)).astype(np.int32))
+                m.compile([ids], is_train=True, use_graph=True)
+                losses = [float(m.train_step(ids)[1].to_numpy())
+                          for _ in range(3)]
+                if pipe:
+                    assert "collective-permute" in m.graph.compiled_hlo()
+                return losses
+            finally:
+                parallel.set_mesh(None)
+
+        np.testing.assert_allclose(run(False), run(True),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_masked_blocks_pipeline_matches_sequential(self):
+        from singa_tpu import autograd, layer, model, models, opt, tensor
+        from singa_tpu.models.transformer import (_GPT2Block,
+                                                  _padding_mask)
+        from singa_tpu.tensor import Tensor
+
+        class MaskedNet(model.Model):
+            def __init__(self, cfg, pipe):
+                super().__init__()
+                blocks = [_GPT2Block(cfg) for _ in range(4)]
+                self.blocks = (layer.PipelineStack(blocks, stages=4)
+                               if pipe else blocks)
+                self.head = layer.Linear(4)
+
+            def forward(self, x, mask):
+                mk = Tensor(data=_padding_mask(mask), device=x.device,
+                            requires_grad=False)
+                if isinstance(self.blocks, layer.PipelineStack):
+                    x = self.blocks(x, mk)
+                else:
+                    for blk in self.blocks:
+                        x = blk(x, mk)
+                return self.head(x.reshape((x.shape[0], -1)))
+
+            def train_one_batch(self, x, mask, y):
+                out = self.forward(x, mask)
+                loss = autograd.softmax_cross_entropy(out, y)
+                self.optimizer.backward_and_update(loss)
+                return out, loss
+
+        def run(pipe):
+            jax.config.update("jax_default_matmul_precision", "highest")
+            tensor.set_seed(0)
+            np.random.seed(0)
+            cfg = models.GPT2Config.tiny()
+            cfg.dropout = 0.0
+            parallel.set_mesh(
+                parallel.make_mesh({"data": 2, "pipe": 4}) if pipe
+                else None)
+            try:
+                m = MaskedNet(cfg, pipe)
+                m.set_optimizer(
+                    opt.DistOpt(opt.SGD(lr=0.05, momentum=0.9)) if pipe
+                    else opt.SGD(lr=0.05, momentum=0.9))
+                x = tensor.from_numpy(
+                    np.random.randn(8, 12, cfg.dim).astype(np.float32))
+                am = np.ones((8, 12), np.float32)
+                am[:, 9:] = 0     # padded tail — mask must matter
+                mk = tensor.from_numpy(am)
+                y = tensor.from_numpy(
+                    np.random.randint(0, 4, (8,)).astype(np.int32))
+                m.compile([x, mk], is_train=True, use_graph=True)
+                losses = [float(m.train_step(x, mk, y)[1].to_numpy())
+                          for _ in range(3)]
+                if pipe:
+                    assert "collective-permute" in m.graph.compiled_hlo()
+                return losses
+            finally:
+                parallel.set_mesh(None)
+
+        np.testing.assert_allclose(run(False), run(True),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_dropout_blocks_fall_back_with_warning(self):
+        from singa_tpu import models, opt, tensor
+
+        jax.config.update("jax_default_matmul_precision", "highest")
+        tensor.set_seed(0)
+        np.random.seed(0)
+        cfg = models.GPT2Config.tiny()
+        cfg.num_layers = 4
+        cfg.dropout = 0.1           # nonzero: pipeline must decline
+        cfg.pipeline_stages = 4
+        parallel.set_mesh(parallel.make_mesh({"data": 2, "pipe": 4}))
+        try:
+            m = models.GPT2(cfg)
+            m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.05)))
+            ids = tensor.from_numpy(np.random.randint(
+                0, cfg.vocab_size, (8, 16)).astype(np.int32))
+            with pytest.warns(UserWarning, match="Dropout"):
+                m.compile([ids], is_train=True, use_graph=True)
+                m.train_step(ids)
+        finally:
+            parallel.set_mesh(None)
