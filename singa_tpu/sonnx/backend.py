@@ -603,6 +603,190 @@ def _h_trilu(ctx, node, attrs, ins):
     return [_apply(ctx, fn, ins[0])]
 
 
+# -- recurrent ops (LSTM/GRU/RNN) -------------------------------------------
+# ONNX layout=0 tensors: X (T, B, I); W (D, G*H, I); R (D, G*H, H);
+# B (D, 2*G*H) = W-bias ++ R-bias; initial_h/c (D, B, H); outputs
+# Y (T, D, B, H), Y_h/Y_c (D, B, H).  The time loop is lax.scan with the
+# input projection hoisted out (one big (T*B, I)x(I, G*H) matmul feeds
+# the MXU; only the (B, H)x(H, G*H) recurrence stays sequential), and
+# the whole cell is a pure jnp function so jax.vjp keeps imported
+# recurrent graphs trainable.
+
+_RNN_ACT = {"Sigmoid": jax.nn.sigmoid, "Tanh": jnp.tanh,
+            "Relu": jax.nn.relu, "Affine": None}
+
+
+def _rnn_common(node, attrs, ins, default_acts):
+    """Shared decode/validation; returns (H, D, direction, acts, clip)."""
+    H = int(attrs["hidden_size"])
+    direction = attrs.get("direction", b"forward")
+    if isinstance(direction, bytes):
+        direction = direction.decode()
+    if direction not in ("forward", "reverse", "bidirectional"):
+        raise ValueError(f"{node.op_type}: bad direction {direction!r}")
+    D = 2 if direction == "bidirectional" else 1
+    if int(attrs.get("layout", 0)) != 0:
+        raise ValueError(f"{node.op_type}: layout=1 is not supported")
+    acts = attrs.get("activations")
+    if acts:
+        acts = [a.decode() if isinstance(a, bytes) else a for a in acts]
+        for a in acts:
+            if a not in _RNN_ACT or _RNN_ACT[a] is None:
+                raise ValueError(f"{node.op_type}: activation {a!r} "
+                                 "unsupported")
+        want = len(default_acts) * D
+        if len(acts) != want:
+            raise ValueError(
+                f"{node.op_type}: activations lists {len(acts)} names, "
+                f"expected {want} ({len(default_acts)} per direction)")
+    else:
+        acts = default_acts * D
+    clip = float(attrs["clip"]) if "clip" in attrs else None
+    seq_lens = ins[4] if len(ins) > 4 else None
+    if seq_lens is not None:
+        sl = _require_host(seq_lens, node, "sequence_lens").reshape(-1)
+        T = ins[0].shape[0] if hasattr(ins[0], "shape") else None
+        if not np.all(sl == sl[0]) or (T is not None and sl[0] != T):
+            raise ValueError(
+                f"{node.op_type}: sequence_lens {sl.tolist()} != full "
+                f"length {T} are not supported (ONNX requires zero "
+                "padding + per-row final states, which need dynamic "
+                "shapes)")
+    if node.op_type == "LSTM" and len(ins) > 7 and ins[7] is not None:
+        raise ValueError("LSTM: peephole weights (P) are not supported")
+    return H, D, direction, acts, clip
+
+
+def _rnn_scan(op_type, x, w, r, b, h0, c0, H, D, direction, acts, clip,
+              linear_before_reset=0):
+    """Pure jnp: run the recurrence; returns (Y, Y_h[, Y_c])."""
+    T, Bs, _ = x.shape
+    n_g = {"LSTM": 4, "GRU": 3, "RNN": 1}[op_type]
+    acts_per_dir = {"LSTM": 3, "GRU": 2, "RNN": 1}[op_type]
+    outs, hs, cs = [], [], []
+    for d in range(D):
+        rev = (direction == "reverse") or (d == 1)
+        xd = x[::-1] if rev else x
+        wd, rd = w[d], r[d]                       # (G*H, I), (G*H, H)
+        bd = b[d] if b is not None else jnp.zeros((2 * n_g * H,), x.dtype)
+        wb, rb = bd[:n_g * H], bd[n_g * H:]
+        da = acts[d * acts_per_dir:(d + 1) * acts_per_dir]
+        f_act = _RNN_ACT[da[0]]
+        g_act = _RNN_ACT[da[1]] if len(da) > 1 else None
+        h_act = _RNN_ACT[da[2]] if len(da) > 2 else None
+        hd0 = h0[d]
+        cd0 = c0[d] if c0 is not None else None
+
+        def cl(v):
+            return jnp.clip(v, -clip, clip) if clip is not None else v
+
+        if op_type == "LSTM":
+            pre = xd @ wd.T + wb + rb             # (T, Bs, 4H)
+
+            def step(carry, px):
+                h, c = carry
+                g = cl(px + h @ rd.T)
+                i = f_act(g[..., 0:H])
+                o = f_act(g[..., H:2 * H])
+                f = f_act(g[..., 2 * H:3 * H])
+                cand = g_act(g[..., 3 * H:4 * H])
+                c2 = f * c + i * cand
+                h2 = o * h_act(c2)
+                return (h2, c2), h2
+
+            (hT, cT), ys = jax.lax.scan(step, (hd0, cd0), pre)
+            cs.append(cT)
+        elif op_type == "GRU":
+            pre = xd @ wd.T + wb                  # (T, Bs, 3H)
+            rb_h = rb[2 * H:3 * H]
+            rd_h = rd[2 * H:3 * H]
+
+            if linear_before_reset:
+                # all three recurrent projections use un-gated h: one
+                # fused (Bs,H)x(H,3H) matmul per step
+                def step(h, px):
+                    hr = h @ rd.T + rb            # (Bs, 3H)
+                    z = f_act(cl(px[..., 0:H] + hr[..., 0:H]))
+                    rr = f_act(cl(px[..., H:2 * H] + hr[..., H:2 * H]))
+                    hh = g_act(cl(px[..., 2 * H:] + rr * hr[..., 2 * H:]))
+                    h2 = (1 - z) * hh + z * h
+                    return h2, h2
+            else:
+                # z/r fuse on un-gated h; the candidate needs (r*h)
+                rd_zr = rd[0:2 * H]
+                rb_zr = rb[0:2 * H]
+
+                def step(h, px):
+                    hzr = h @ rd_zr.T + rb_zr     # (Bs, 2H)
+                    z = f_act(cl(px[..., 0:H] + hzr[..., 0:H]))
+                    rr = f_act(cl(px[..., H:2 * H] + hzr[..., H:]))
+                    hh = g_act(cl(px[..., 2 * H:] + (rr * h) @ rd_h.T
+                                  + rb_h))
+                    h2 = (1 - z) * hh + z * h
+                    return h2, h2
+
+            hT, ys = jax.lax.scan(step, hd0, pre)
+        else:  # RNN
+            pre = xd @ wd.T + wb + rb             # (T, Bs, H)
+
+            def step(h, px):
+                h2 = f_act(cl(px + h @ rd.T))
+                return h2, h2
+
+            hT, ys = jax.lax.scan(step, hd0, pre)
+        if rev:
+            ys = ys[::-1]
+        outs.append(ys)
+        hs.append(hT)
+    Y = jnp.stack(outs, axis=1)                   # (T, D, Bs, H)
+    Yh = jnp.stack(hs, axis=0)                    # (D, Bs, H)
+    if op_type == "LSTM":
+        return Y, Yh, jnp.stack(cs, axis=0)
+    return Y, Yh
+
+
+def _h_recurrent(ctx, node, attrs, ins, default_acts):
+    H, D, direction, acts, clip = _rnn_common(node, attrs, ins,
+                                              default_acts)
+    lbr = int(attrs.get("linear_before_reset", 0))
+    X, W, R = ins[0], ins[1], ins[2]
+    Bb = ins[3] if len(ins) > 3 else None
+    h0 = ins[5] if len(ins) > 5 else None
+    c0 = ins[6] if len(ins) > 6 else None
+    has_b, has_h, has_c = (Bb is not None, h0 is not None, c0 is not None)
+    present = [v for v in (X, W, R, Bb, h0, c0) if v is not None]
+
+    def fn(*arrs):
+        it = iter(arrs)
+        x, w, r = next(it), next(it), next(it)
+        b = next(it) if has_b else None
+        Bs = x.shape[1]
+        hh = next(it) if has_h else jnp.zeros((D, Bs, H), x.dtype)
+        cc = (next(it) if has_c else jnp.zeros((D, Bs, H), x.dtype)) \
+            if node.op_type == "LSTM" else None
+        return _rnn_scan(node.op_type, x, w, r, b, hh, cc, H, D,
+                         direction, acts, clip, lbr)
+
+    outs = _apply(ctx, fn, *present)
+    return list(outs)[:max(1, len(node.output))]
+
+
+@handles("LSTM")
+def _h_lstm(ctx, node, attrs, ins):
+    return _h_recurrent(ctx, node, attrs, ins,
+                        ["Sigmoid", "Tanh", "Tanh"])
+
+
+@handles("GRU")
+def _h_gru(ctx, node, attrs, ins):
+    return _h_recurrent(ctx, node, attrs, ins, ["Sigmoid", "Tanh"])
+
+
+@handles("RNN")
+def _h_rnn(ctx, node, attrs, ins):
+    return _h_recurrent(ctx, node, attrs, ins, ["Tanh"])
+
+
 @handles("OneHot")
 def _h_onehot(ctx, node, attrs, ins):
     axis = attrs.get("axis", -1)

@@ -460,3 +460,103 @@ class TestHuggingFaceGPT2:
         losses = [float(rep.train_step(x, y)[-1].to_numpy())
                   for _ in range(10)]
         assert losses[-1] < losses[0] * 0.9, losses
+
+
+# ---------------------------------------------------------------------------
+# recurrent ops (LSTM/GRU/RNN) from torch exports
+# ---------------------------------------------------------------------------
+
+class TestTorchRecurrent:
+    """torch.nn.{LSTM,GRU,RNN} export as the ONNX recurrent ops; the
+    import must reproduce torch (incl. bidirectional) and stay
+    trainable through the lax.scan recurrence."""
+
+    def _module(self, kind, bidir=False):
+        class Net(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                cls = {"LSTM": torch.nn.LSTM, "GRU": torch.nn.GRU,
+                       "RNN": torch.nn.RNN}[kind]
+                self.rnn = cls(8, 12, bidirectional=bidir)
+
+            def forward(self, x):
+                y, _ = self.rnn(x)
+                return y
+
+        torch.manual_seed(0)
+        return Net()
+
+    @pytest.mark.parametrize("kind,bidir", [
+        ("LSTM", False), ("LSTM", True), ("GRU", False),
+        ("GRU", True), ("RNN", False)])
+    def test_import_matches_torch(self, kind, bidir):
+        m = self._module(kind, bidir)
+        x = torch.randn(6, 3, 8)          # (T, B, I)
+        data = _torch_export_bytes(m, (x,))
+        proto, _, outs = _run_sonnx(data, [x.numpy()])
+        assert kind in {n.op_type for n in proto.graph.node}
+        ref = m(x).detach().numpy()
+        np.testing.assert_allclose(outs[0], ref, rtol=1e-4, atol=1e-5)
+
+    def test_finetune_lstm_import(self):
+        m = self._module("LSTM")
+        np.random.seed(0)
+        x_t = torch.randn(6, 4, 8)
+        data = _torch_export_bytes(m, (x_t,))
+        rep = sonnx.prepare(sonnx.load_model_from_string(data))
+        rep.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+
+        def mse_last(outs, y):
+            out = outs[0] if isinstance(outs, (list, tuple)) else outs
+            last = autograd.reshape(out, (-1, 12))
+            return autograd.mse_loss(last, y)
+
+        rep.set_loss(mse_last)
+        x = tensor.from_numpy(x_t.numpy())
+        y = tensor.from_numpy(
+            np.random.randn(6 * 4, 12).astype(np.float32) * 0.1)
+        rep.compile([x], is_train=True, use_graph=True)
+        losses = [float(rep.train_step(x, y)[-1].to_numpy())
+                  for _ in range(10)]
+        assert losses[-1] < losses[0] * 0.9, losses
+
+    def test_gru_linear_before_reset_0(self):
+        """torch always exports linear_before_reset=1; the default=0
+        formulation is exercised via a hand-assembled node."""
+        rng = np.random.RandomState(0)
+        T, B, I, H = 5, 2, 4, 3
+        X = rng.randn(T, B, I).astype(np.float32)
+        W = rng.randn(1, 3 * H, I).astype(np.float32)
+        R = rng.randn(1, 3 * H, H).astype(np.float32)
+        bias = rng.randn(1, 6 * H).astype(np.float32)
+        node = sonnx.make_node("GRU", ["x", "w", "r", "b"], ["y"],
+                               hidden_size=H)
+        graph = sonnx.make_graph(
+            [node], "g",
+            [sonnx.make_tensor_value_info(
+                "x", sonnx.TensorProto.FLOAT, (T, B, I))],
+            [sonnx.make_tensor_value_info(
+                "y", sonnx.TensorProto.FLOAT, (T, 1, B, H))],
+            initializer=[sonnx.from_array(W, "w"),
+                         sonnx.from_array(R, "r"),
+                         sonnx.from_array(bias, "b")])
+        model = sonnx.make_model(graph)
+        _, _, outs = _run_sonnx(model.SerializeToString(), [X])
+
+        # numpy reference, ONNX GRU default (linear_before_reset=0)
+        def sig(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        wb, rb = bias[0, :3 * H], bias[0, 3 * H:]
+        h = np.zeros((B, H), np.float32)
+        ys = []
+        for t in range(T):
+            px = X[t] @ W[0].T + wb
+            z = sig(px[:, 0:H] + h @ R[0, 0:H].T + rb[0:H])
+            rr = sig(px[:, H:2 * H] + h @ R[0, H:2 * H].T + rb[H:2 * H])
+            hh = np.tanh(px[:, 2 * H:] + (rr * h) @ R[0, 2 * H:].T
+                         + rb[2 * H:])
+            h = (1 - z) * hh + z * h
+            ys.append(h.copy())
+        ref = np.stack(ys)[:, None]
+        np.testing.assert_allclose(outs[0], ref, rtol=1e-4, atol=1e-5)
