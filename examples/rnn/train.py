@@ -1,0 +1,124 @@
+"""examples/rnn — character-level LSTM language model (reference
+lineage: the singa char-rnn example; SURVEY.md §2.2 row 7 RNN/LSTM).
+
+Trains next-character prediction over a built-in corpus (no downloads:
+this image has no network egress; pass --text for your own file), then
+samples from the model.
+
+    python examples/rnn/train.py --device cpu --steps 200
+    python examples/rnn/train.py --device cpu --sample 200
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import common  # noqa: E402,F401  (pins the cpu backend for --device cpu)
+
+from singa_tpu import layer, model, opt, tensor  # noqa: E402
+
+_CORPUS = (
+    "the quick brown fox jumps over the lazy dog. "
+    "pack my box with five dozen liquor jugs. "
+    "how vexingly quick daft zebras jump! "
+    "sphinx of black quartz, judge my vow. "
+    "the five boxing wizards jump quickly. "
+    "a mad boxer shot a quick, gloved jab to the jaw of his "
+    "dizzy opponent. jackdaws love my big sphinx of quartz. "
+    "the jay, pig, fox, zebra and my wolves quack! "
+    "few quips galvanized the mock jury box. "
+    "crazy fredrick bought many very exquisite opal jewels. "
+) * 8
+
+
+class CharRNN(model.Model):
+    """Embedding -> stacked LSTM -> per-step Linear over the vocab."""
+
+    def __init__(self, vocab, hidden=128, embed=64, num_layers=2):
+        super().__init__()
+        self.vocab = vocab
+        self.embed = layer.Embedding(vocab, embed)
+        self.rnns = [layer.LSTM(hidden) for _ in range(num_layers)]
+        self.head = layer.Linear(vocab)
+
+    def forward(self, ids):
+        x = self.embed(ids)                       # (B, T, E)
+        for rnn in self.rnns:
+            x = rnn(x)                            # (B, T, H)
+        B, T, H = x.shape
+        return self.head(x.reshape((B * T, H)))   # (B*T, V) logits
+
+
+def batches(data, batch, seqlen, rng):
+    starts = rng.randint(0, len(data) - seqlen - 1, size=batch)
+    x = np.stack([data[s:s + seqlen] for s in starts])
+    y = np.stack([data[s + 1:s + seqlen + 1] for s in starts])
+    return x.astype(np.int32), y.reshape(-1).astype(np.int32)
+
+
+def sample(m, text, stoi, itos, n, temperature=0.8, win=32):
+    """Greedy-ish sampling with the training forward (teacher-forced
+    window).  The seed is the corpus' first `win` chars, so the eval
+    context is ALWAYS (1, win) and graph mode compiles exactly once."""
+    rng = np.random.RandomState(0)
+    ids = [stoi[c] for c in text[:win]]
+    for _ in range(n):
+        ctx = np.asarray(ids[-win:], np.int32)[None, :]
+        logits = m(tensor.from_numpy(ctx)).to_numpy()
+        logits = logits.reshape(ctx.shape[1], -1)[-1] / max(temperature, 1e-3)
+        p = np.exp(logits - logits.max())
+        p /= p.sum()
+        ids.append(int(rng.choice(len(p), p=p)))
+    return "".join(itos[i] for i in ids)
+
+
+def main():
+    p = common.base_parser("char-level LSTM LM (reference char-rnn)")
+    p.add_argument("--text", default=None, help="path to a text corpus")
+    p.add_argument("--steps", type=int, default=150)
+    p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--seqlen", type=int, default=64)
+    p.add_argument("--sample", type=int, default=120,
+                   help="characters to sample after training")
+    p.set_defaults(lr=3e-3)      # char-LM-appropriate Adam step size
+    args = p.parse_args()
+
+    text = (open(args.text).read() if args.text else _CORPUS)
+    chars = sorted(set(text))
+    stoi = {c: i for i, c in enumerate(chars)}
+    itos = {i: c for c, i in stoi.items()}
+    data = np.asarray([stoi[c] for c in text], np.int32)
+    print(f"corpus: {len(text)} chars, vocab {len(chars)}")
+
+    tensor.set_seed(0)
+    rng = np.random.RandomState(0)
+    m = CharRNN(len(chars), hidden=args.hidden, num_layers=args.layers)
+    m.set_optimizer(opt.Adam(lr=args.lr))
+    x0, y0 = batches(data, args.batch_size, args.seqlen, rng)
+    tx = tensor.from_numpy(x0)
+    m.compile([tx], is_train=True, use_graph=args.graph)
+
+    import time
+    t0 = time.perf_counter()
+    for step in range(args.steps):
+        x, y = batches(data, args.batch_size, args.seqlen, rng)
+        _, loss = m.train_step(tensor.from_numpy(x), tensor.from_numpy(y))
+        if step % max(1, args.steps // 10) == 0 or step == args.steps - 1:
+            lv = float(loss.to_numpy())
+            dt = time.perf_counter() - t0
+            cps = args.batch_size * args.seqlen * (step + 1) / dt
+            print(f"step {step:4d}: loss {lv:.4f}  {cps:,.0f} chars/s")
+
+    if args.sample:
+        m.eval()
+        print("--- sample ---")
+        print(sample(m, text, stoi, itos, args.sample))
+
+
+if __name__ == "__main__":
+    main()
