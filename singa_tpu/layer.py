@@ -511,12 +511,17 @@ class RMSNorm(Layer):
 
 class _ScanRNNOp(autograd.Operator):
     """Generic scanned RNN cell op; the cell body is a pure function so the
-    whole unrolled-in-time computation lowers to one lax.scan."""
+    whole unrolled-in-time computation lowers to one lax.scan.
 
-    def __init__(self, cell_fn, h0_fn):
+    `kind`/`hidden` identify the cell for the ONNX exporter (sonnx
+    emits a real LSTM/RNN node with the weight layout converted)."""
+
+    def __init__(self, cell_fn, h0_fn, kind: str = "", hidden: int = 0):
         super().__init__()
         self.cell_fn = cell_fn
         self.h0_fn = h0_fn
+        self.kind = kind
+        self.hidden = hidden
 
     def fwd(self, x, *weights):
         # x: (B, T, D) -> scan over T
@@ -556,9 +561,9 @@ class RNN(Layer):
         def h0(xa):
             return jnp.zeros((xa.shape[0], h), xa.dtype)
 
-        return _ScanRNNOp(cell, h0)(x, _maybe_cast(self.Wx, x),
-                                    _maybe_cast(self.Wh, x),
-                                    _maybe_cast(self.b, x))
+        return _ScanRNNOp(cell, h0, "RNN", h)(x, _maybe_cast(self.Wx, x),
+                                              _maybe_cast(self.Wh, x),
+                                              _maybe_cast(self.b, x))
 
 
 class LSTM(Layer):
@@ -591,9 +596,9 @@ class LSTM(Layer):
             z = jnp.zeros((xa.shape[0], h), xa.dtype)
             return (z, z)
 
-        return _ScanRNNOp(cell, h0)(x, _maybe_cast(self.Wx, x),
-                                    _maybe_cast(self.Wh, x),
-                                    _maybe_cast(self.b, x))
+        return _ScanRNNOp(cell, h0, "LSTM", h)(x, _maybe_cast(self.Wx, x),
+                                               _maybe_cast(self.Wh, x),
+                                               _maybe_cast(self.b, x))
 
 
 class MultiHeadAttention(Layer):

@@ -529,6 +529,80 @@ def _e_batchnorm(ex, op, ins, outs):
     _nhwc_out(ex, y_nchw, _outn(ex, outs)[0])
 
 
+def _register_scan_rnn_rule():
+    """layer.RNN / layer.LSTM (generic _ScanRNNOp) → real ONNX RNN/LSTM
+    nodes.  Layout conversion happens in-graph (Transpose/Split/Concat
+    of the weight initializers — runtimes constant-fold them):
+      ours: x (B,T,D); Wx (D,G*H); Wh (H,G*H); b (G*H,), LSTM gate
+      order i,f,g,o.  ONNX: X (T,B,D); W (1,G*H,D); R (1,G*H,H);
+      B (1,2*G*H) with zero recurrence bias; LSTM gate order i,o,f,c."""
+    from ..layer import _ScanRNNOp
+
+    @_exports(_ScanRNNOp)
+    def _e_scan_rnn(ex, op, ins, outs):
+        kind, H = op.kind, op.hidden
+        if kind not in ("RNN", "LSTM"):
+            raise ValueError(
+                f"cannot export generic _ScanRNNOp (kind={kind!r}); "
+                "only layer.RNN / layer.LSTM cells map onto ONNX nodes")
+        G = 4 if kind == "LSTM" else 1
+        ax0 = ex.add_init(np.asarray([0], np.int64), "ax0")
+        # explicit split sizes: valid in opset 13 through 18+ (a bare
+        # 4-output Split without them is rejected at opset 18)
+        gate_splits = ex.add_init(np.full((4,), H, np.int64), "gsplit")
+
+        def to_onnx_weight(name, hint):
+            t = ex.fresh(hint)
+            ex.emit("Transpose", [name], [t], perm=[1, 0])  # (G*H, in)
+            if kind == "LSTM":
+                parts = [ex.fresh(f"{hint}_g{i}") for i in range(4)]
+                ex.emit("Split", [t, gate_splits], parts, axis=0)
+                ro = ex.fresh(f"{hint}_iofc")
+                # ours [i, f, g, o] -> ONNX [i, o, f, c(=g)]
+                ex.emit("Concat", [parts[0], parts[3], parts[1],
+                                   parts[2]], [ro], axis=0)
+                t = ro
+            u = ex.fresh(f"{hint}_d")
+            ex.emit("Unsqueeze", [t, ax0], [u])             # (1, G*H, in)
+            return u
+
+        w = to_onnx_weight(ins[1], "rnn_w")
+        r = to_onnx_weight(ins[2], "rnn_r")
+        lstm_ins = [None, w, r]
+        if len(ins) > 3:
+            b = ex.fresh("rnn_b")
+            if kind == "LSTM":
+                parts = [ex.fresh(f"rnn_b_g{i}") for i in range(4)]
+                ex.emit("Split", [ins[3], gate_splits], parts, axis=0)
+                ro = ex.fresh("rnn_b_iofc")
+                ex.emit("Concat", [parts[0], parts[3], parts[1],
+                                   parts[2]], [ro], axis=0)
+                src = ro
+            else:
+                src = ins[3]
+            # recurrence-bias zeros in the traced activation dtype
+            # (bf16/f16 models would otherwise emit a mixed-type Concat)
+            zeros = ex.add_init(
+                np.zeros((G * H,), np.dtype(outs[0].dtype)), "rb0")
+            ex.emit("Concat", [src, zeros], [b], axis=0)    # (2*G*H,)
+            bu = ex.fresh("rnn_b_d")
+            ex.emit("Unsqueeze", [b, ax0], [bu])            # (1, 2*G*H)
+            lstm_ins.append(bu)
+
+        xt = ex.fresh("x_tbd")
+        ex.emit("Transpose", [ins[0]], [xt], perm=[1, 0, 2])
+        lstm_ins[0] = xt
+        y = ex.fresh("rnn_y")                               # (T, 1, B, H)
+        ex.emit(kind, lstm_ins, [y], hidden_size=int(H))
+        sq = ex.fresh("rnn_y_sq")
+        ax1 = ex.add_init(np.asarray([1], np.int64), "ax1")
+        ex.emit("Squeeze", [y, ax1], [sq])                  # (T, B, H)
+        ex.emit("Transpose", [sq], _outn(ex, outs), perm=[1, 0, 2])
+
+
+_register_scan_rnn_rule()
+
+
 def _register_sdpa_rule():
     """Fused attention (singa_tpu.ops.attention.SDPA) → portable ONNX:
     head-transposed MatMul / Mul(scale) / Where(mask) / Softmax / MatMul.

@@ -560,3 +560,55 @@ class TestTorchRecurrent:
             ys.append(h.copy())
         ref = np.stack(ys)[:, None]
         np.testing.assert_allclose(outs[0], ref, rtol=1e-4, atol=1e-5)
+
+
+class TestRecurrentExport:
+    """layer.LSTM / layer.RNN export as REAL ONNX LSTM/RNN nodes (gate
+    order + layout converted in-graph) and round-trip through the
+    importer."""
+
+    def _net(self):
+        from singa_tpu import layer, model
+
+        class Net(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.emb = layer.Embedding(37, 16)
+                self.lstm = layer.LSTM(24)
+                self.rnn = layer.RNN(20)
+                self.head = layer.Linear(5)
+
+            def forward(self, ids):
+                x = self.rnn(self.lstm(self.emb(ids)))
+                B, T, H = x.shape
+                return self.head(x.reshape((B * T, H)))
+
+        return Net()
+
+    def test_roundtrip_matches_native(self):
+        tensor.set_seed(0)
+        np.random.seed(0)
+        m = self._net()
+        ids = tensor.from_numpy(
+            np.random.randint(0, 37, (3, 9)).astype(np.int32))
+        m.compile([ids], is_train=False, use_graph=False)
+        m.eval()
+        ref = m(ids).to_numpy()
+        proto = sonnx.to_onnx(m, [ids])
+        ops = [n.op_type for n in proto.graph.node]
+        assert "LSTM" in ops and "RNN" in ops, ops
+        rep = sonnx.prepare(proto)
+        out = rep.run([ids])
+        o0 = (out[0] if isinstance(out, (list, tuple)) else out).to_numpy()
+        np.testing.assert_allclose(o0, ref, rtol=1e-5, atol=1e-6)
+
+    def test_checker_accepts_recurrent_export(self):
+        onnx = pytest.importorskip("onnx")
+        tensor.set_seed(0)
+        np.random.seed(0)
+        m = self._net()
+        ids = tensor.from_numpy(
+            np.random.randint(0, 37, (3, 9)).astype(np.int32))
+        m.compile([ids], is_train=False, use_graph=False)
+        data = sonnx.to_onnx(m, [ids]).SerializeToString()
+        onnx.checker.check_model(onnx.load_model_from_string(data))
