@@ -44,9 +44,16 @@ def main():
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, default=128)
     p.add_argument("--steps", type=int, default=10)
-    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--lr", type=float, default=None,
+                   help="default 3e-4; with --opt adafactor, unset "
+                        "means the relative-step schedule")
     p.add_argument("--force-host-devices", type=int, default=0,
                    help="virtual CPU devices for meshes without hardware")
+    p.add_argument("--opt", default="adamw",
+                   choices=["adamw", "adafactor", "sgd"],
+                   help="adafactor = factored second moment (r+c floats "
+                        "per matrix instead of r*c) with relative step "
+                        "size — the big-model TPU recipe")
     p.add_argument("--zero1", action="store_true",
                    help="ZeRO-1 weight-update sharding: optimizer "
                         "moments sharded over the data axis (1/N HBM)")
@@ -94,9 +101,10 @@ def main():
     if args.plan:
         import jax
         import jax.numpy as jnp
-        plan_opt = (opt.DistOpt(opt.AdamW(lr=args.lr),
+        plan_lr = 3e-4 if args.lr is None else args.lr
+        plan_opt = (opt.DistOpt(opt.AdamW(lr=plan_lr),
                                 shard_weight_update=True)
-                    if args.zero1 else opt.AdamW(lr=args.lr))
+                    if args.zero1 else opt.AdamW(lr=plan_lr))
         plan = parallel.plan_train_step(
             models.Llama(cfg), plan_opt,
             (jax.ShapeDtypeStruct((args.batch, args.seq), jnp.int32),),
@@ -114,7 +122,13 @@ def main():
 
     tensor.set_seed(0)
     m = models.Llama(cfg)
-    m.set_optimizer(opt.DistOpt(opt.AdamW(lr=args.lr),
+    lr = 3e-4 if args.lr is None else args.lr
+    base_opt = {"adamw": lambda: opt.AdamW(lr=lr),
+                # explicit --lr overrides adafactor's relative step
+                "adafactor": lambda: opt.Adafactor(lr=args.lr),
+                "sgd": lambda: opt.SGD(lr=lr, momentum=0.9),
+                }[args.opt]()
+    m.set_optimizer(opt.DistOpt(base_opt,
                                 shard_weight_update=args.zero1))
     vocab = min(cfg.vocab_size, 32000)
     ids_np = np.random.RandomState(0).randint(

@@ -33,8 +33,8 @@ from .tensor import Tensor
 
 __all__ = [
     "Optimizer", "SGD", "Adam", "AdamW", "RMSProp", "AdaGrad",
-    "DistOpt", "GradAccum", "Constant", "ExponentialDecay", "CosineDecay",
-    "WarmupCosine", "MultiStepLR",
+    "Adafactor", "DistOpt", "GradAccum", "Constant", "ExponentialDecay",
+    "CosineDecay", "WarmupCosine", "MultiStepLR",
 ]
 
 
@@ -316,6 +316,131 @@ class AdaGrad(Optimizer):
             g = g + self.weight_decay * p
         acc = slot + g * g
         return (p - lr * g / (jnp.sqrt(acc) + self.eps)).astype(p.dtype), acc
+
+
+class Adafactor(Optimizer):
+    """Adafactor (Shazeer & Stern 2018) — the TPU-idiomatic
+    memory-efficient optimizer for large models: the second moment of a
+    (r, c) matrix parameter is stored as a rank-1 factorization (r + c
+    floats instead of r*c), cutting optimizer HBM by ~dim/2 per matrix;
+    f32 stats regardless of param dtype (bf16-safe).
+
+    Modes mirror the T5 recipe:
+      * ``lr=None`` (default): relative step size
+        min(relative_step_cap, 1/sqrt(t)), usually combined with
+        ``multiply_by_parameter_scale=True`` — no LR tuning needed;
+      * explicit ``lr``: fixed/scheduled step size (set
+        multiply_by_parameter_scale=False for optax-equivalent math —
+        cross-validated against optax.adafactor in tests).
+
+    ``momentum`` (beta1) adds back a full-size first moment — off by
+    default, which is the memory win.  Factorization covers the last
+    two axes when both are >= min_dim_size_to_factor; smaller or 1-D
+    params keep a full second moment."""
+
+    def __init__(self, lr=None, min_dim_size_to_factor=128,
+                 decay_rate=0.8, multiply_by_parameter_scale=None,
+                 clipping_threshold=1.0, momentum=None,
+                 eps=(1e-30, 1e-3), weight_decay=0.0,
+                 relative_step_cap=1e-2):
+        super().__init__(0.0 if lr is None else lr)
+        self.relative = lr is None
+        if multiply_by_parameter_scale is None:
+            multiply_by_parameter_scale = self.relative
+        self.min_factor = int(min_dim_size_to_factor)
+        self.decay_rate = float(decay_rate)
+        self.param_scale = bool(multiply_by_parameter_scale)
+        self.clip = clipping_threshold
+        self.momentum = momentum
+        self.eps1, self.eps2 = eps
+        self.weight_decay = weight_decay
+        self.relative_step_cap = relative_step_cap
+
+    def _factored(self, p) -> bool:
+        return (p.ndim >= 2
+                and min(p.shape[-2], p.shape[-1]) >= self.min_factor)
+
+    def init(self, params):
+        return {n: self._init_slot(p) for n, p in params.items()}
+
+    def _init_slot(self, p):
+        if self._factored(p):
+            slot = {"vr": jnp.zeros(p.shape[:-1], jnp.float32),
+                    "vc": jnp.zeros(p.shape[:-2] + p.shape[-1:],
+                                    jnp.float32)}
+        else:
+            slot = {"v": jnp.zeros(p.shape, jnp.float32)}
+        if self.momentum:
+            slot["m"] = jnp.zeros(p.shape, jnp.float32)
+        return slot
+
+    def apply(self, step, name, p, g, slot):
+        t = (step + 1).astype(jnp.float32) if hasattr(step, "astype") \
+            else float(step + 1)
+        decay = 1.0 - t ** (-self.decay_rate)
+        g32 = g.astype(jnp.float32)
+        gsq = g32 * g32 + self.eps1
+        new = {}
+        if "vr" in slot:
+            vr = decay * slot["vr"] + (1 - decay) * gsq.mean(-1)
+            vc = decay * slot["vc"] + (1 - decay) * gsq.mean(-2)
+            reduced = vr.mean(-1, keepdims=True)
+            y = (g32 * jax.lax.rsqrt(vr / reduced)[..., None]
+                 * jax.lax.rsqrt(vc)[..., None, :])
+            new["vr"], new["vc"] = vr, vc
+        else:
+            v = decay * slot["v"] + (1 - decay) * gsq
+            y = g32 * jax.lax.rsqrt(v)
+            new["v"] = v
+        if self.clip:
+            rms_y = jnp.sqrt(jnp.mean(y * y))
+            y = y / jnp.maximum(1.0, rms_y / self.clip)
+        if self.relative:
+            rho = jnp.minimum(self.relative_step_cap,
+                              jax.lax.rsqrt(jnp.asarray(t, jnp.float32)))
+        else:
+            rho = self.sched(step)
+        if self.param_scale:
+            p32 = p.astype(jnp.float32)
+            rho = rho * jnp.maximum(jnp.sqrt(jnp.mean(p32 * p32)),
+                                    self.eps2)
+        upd = rho * y
+        if self.momentum:
+            m = self.momentum * slot["m"] + (1 - self.momentum) * upd
+            new["m"] = m
+            upd = m
+        if self.weight_decay:
+            upd = upd + rho * self.weight_decay * p.astype(jnp.float32)
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), new
+
+    def state_signature(self) -> str:
+        return (f"Adafactor(f{self.min_factor},"
+                f"m{self.momentum or 0})")
+
+    def load_slot_arrays(self, slots: Dict[str, List]) -> None:
+        """Rebuild the dict slots from the checkpoint's flat leaf lists.
+        jax.tree flattens dicts in sorted-key order, so leaves arrive as
+        ["m"?, "v"] or ["m"?, "vc", "vr"]."""
+        est = {}
+        for name, leaves in slots.items():
+            arrs = [jnp.asarray(l) for l in leaves]
+            if not arrs:
+                est[name] = None
+                continue
+            slot = {}
+            if self.momentum:
+                slot["m"] = arrs[0]
+                arrs = arrs[1:]
+            if len(arrs) == 1:
+                slot["v"] = arrs[0]
+            elif len(arrs) == 2:
+                slot["vc"], slot["vr"] = arrs
+            else:
+                raise ValueError(
+                    f"unexpected Adafactor slot leaf count for {name!r}: "
+                    f"{len(arrs)}")
+            est[name] = slot
+        self._eager_state = est
 
 
 class GradAccum(Optimizer):

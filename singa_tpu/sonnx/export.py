@@ -548,8 +548,10 @@ def _register_scan_rnn_rule():
         G = 4 if kind == "LSTM" else 1
         ax0 = ex.add_init(np.asarray([0], np.int64), "ax0")
         # explicit split sizes: valid in opset 13 through 18+ (a bare
-        # 4-output Split without them is rejected at opset 18)
-        gate_splits = ex.add_init(np.full((4,), H, np.int64), "gsplit")
+        # 4-output Split without them is rejected at opset 18); only
+        # LSTM reorders gates, so only it emits the initializer
+        gate_splits = (ex.add_init(np.full((4,), H, np.int64), "gsplit")
+                       if kind == "LSTM" else None)
 
         def to_onnx_weight(name, hint):
             t = ex.fresh(hint)

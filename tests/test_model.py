@@ -2,6 +2,7 @@
 BASELINE.json:7), graph mode compiles to one module and matches eager
 step-for-step (SURVEY.md §4 item 2)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -574,3 +575,106 @@ def test_nested_grad_accum_resume(tmp_path):
                                   sorted(m2.get_params().items())):
         np.testing.assert_allclose(p1.to_numpy(), p2.to_numpy(),
                                    rtol=1e-5, atol=1e-7, err_msg=n1)
+
+
+class TestAdafactor:
+    """Adafactor: optax-equivalent math, factored-slot memory win,
+    relative-step training, checkpoint resume."""
+
+
+    def test_matches_optax_factored(self):
+        optax = pytest.importorskip("optax")
+        rng = np.random.RandomState(0)
+        p0 = rng.randn(132, 136).astype(np.float32) * 0.1
+        grads = [rng.randn(132, 136).astype(np.float32) * 0.01
+                 for _ in range(5)]
+        tx = optax.adafactor(
+            learning_rate=1e-2, multiply_by_parameter_scale=False,
+            momentum=None, factored=True, min_dim_size_to_factor=128,
+            clipping_threshold=1.0, weight_decay_rate=None)
+        params = {"w": jnp.asarray(p0)}
+        state = tx.init(params)
+        for g in grads:
+            updates, state = tx.update({"w": jnp.asarray(g)}, state,
+                                       params)
+            params = optax.apply_updates(params, updates)
+
+        o = opt.Adafactor(lr=1e-2, multiply_by_parameter_scale=False,
+                          min_dim_size_to_factor=128)
+        slot = o._init_slot(jnp.asarray(p0))
+        pp = jnp.asarray(p0)
+        for i, g in enumerate(grads):
+            pp, slot = o.apply(jnp.asarray(i), "w", pp, jnp.asarray(g),
+                               slot)
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   np.asarray(pp), rtol=1e-6, atol=1e-7)
+
+    def test_matches_optax_unfactored_1d(self):
+        optax = pytest.importorskip("optax")
+        rng = np.random.RandomState(1)
+        p0 = rng.randn(64).astype(np.float32)
+        grads = [rng.randn(64).astype(np.float32) * 0.1 for _ in range(4)]
+        tx = optax.adafactor(
+            learning_rate=5e-3, multiply_by_parameter_scale=False,
+            momentum=None, factored=True, clipping_threshold=1.0,
+            weight_decay_rate=None)
+        params = {"b": jnp.asarray(p0)}
+        state = tx.init(params)
+        for g in grads:
+            updates, state = tx.update({"b": jnp.asarray(g)}, state,
+                                       params)
+            params = optax.apply_updates(params, updates)
+        o = opt.Adafactor(lr=5e-3, multiply_by_parameter_scale=False)
+        slot = o._init_slot(jnp.asarray(p0))
+        assert "v" in slot and "vr" not in slot
+        pp = jnp.asarray(p0)
+        for i, g in enumerate(grads):
+            pp, slot = o.apply(jnp.asarray(i), "b", pp, jnp.asarray(g),
+                               slot)
+        np.testing.assert_allclose(np.asarray(params["b"]),
+                                   np.asarray(pp), rtol=1e-6, atol=1e-7)
+
+    def test_factored_slots_are_small(self):
+        p = jnp.zeros((256, 512), jnp.float32)
+        o = opt.Adafactor()
+        slot = o._init_slot(p)
+        slot_elems = sum(int(np.prod(v.shape)) for v in slot.values())
+        assert slot_elems == 256 + 512        # vs 256*512 for Adam's v
+        # sub-threshold matrices keep the full moment
+        o2 = opt.Adafactor(min_dim_size_to_factor=1024)
+        assert "v" in o2._init_slot(p)
+
+    def test_relative_step_trains(self):
+        tensor.set_seed(0)
+        np.random.seed(0)
+        x, y = make_blobs(128)
+        m = MLP()
+        m.set_optimizer(opt.Adafactor(min_dim_size_to_factor=8))
+        tx, ty = tensor.from_numpy(x), tensor.from_numpy(y)
+        m.compile([tx], is_train=True, use_graph=True)
+        losses = [float(m.train_step(tx, ty)[1].to_numpy())
+                  for _ in range(25)]
+        assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+    def test_checkpoint_resume(self, tmp_path):
+        def run(steps, resume_from=None, save_to=None):
+            tensor.set_seed(3)
+            np.random.seed(3)
+            x, y = make_blobs(64)
+            m = MLP()
+            m.set_optimizer(opt.Adafactor(min_dim_size_to_factor=8))
+            tx, ty = tensor.from_numpy(x), tensor.from_numpy(y)
+            m.compile([tx], is_train=True, use_graph=True)
+            if resume_from:
+                m.load_states(resume_from)
+            for _ in range(steps):
+                _, loss = m.train_step(tx, ty)
+            if save_to:
+                m.save_states(save_to)
+            return m, float(loss.to_numpy())
+
+        path = str(tmp_path / "ck")
+        run(3, save_to=path)
+        _, resumed = run(2, resume_from=path)
+        _, straight = run(5)
+        np.testing.assert_allclose(resumed, straight, rtol=1e-5)
