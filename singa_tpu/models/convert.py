@@ -1,0 +1,179 @@
+"""Weight importers from HuggingFace `transformers` models — the
+switch-over path for users arriving with pretrained checkpoints
+(BASELINE.json:9's interchange story, beyond ONNX files: direct
+state-dict conversion, no serialization round-trip).
+
+    import transformers
+    hf = transformers.GPT2LMHeadModel.from_pretrained(...)   # or local
+    m = models.from_hf(hf)            # singa_tpu model, same logits
+
+Supported: GPT2LMHeadModel -> models.GPT2, LlamaForCausalLM ->
+models.Llama.  Conversions are pure layout mapping (HF Linear stores
+(out, in) -> ours (in, out); GPT-2's Conv1D already stores (in, out);
+HF's fused c_attn splits into q/k/v).  RoPE needs no permutation: both
+sides use the rotate-half convention.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from .. import tensor as tensor_mod
+from ..tensor import Tensor
+
+__all__ = ["from_hf", "from_hf_gpt2", "from_hf_llama"]
+
+
+def _np(t) -> np.ndarray:
+    return t.detach().cpu().numpy().astype(np.float32)
+
+
+def _set(params: Dict[str, Tensor], name: str, arr: np.ndarray) -> None:
+    if name not in params:
+        raise KeyError(f"no such param {name!r} (have e.g. "
+                       f"{list(params)[:4]})")
+    p = params[name]
+    if tuple(p.shape) != tuple(arr.shape):
+        raise ValueError(f"{name}: shape {tuple(arr.shape)} does not fit "
+                         f"{tuple(p.shape)}")
+    p.copy_from(arr)
+
+
+def _init(model, batch_t: int = 8):
+    """Materialize lazy params with a dummy forward."""
+    ids = tensor_mod.from_numpy(np.zeros((1, batch_t), np.int32))
+    model.compile([ids], is_train=False, use_graph=False)
+    return model
+
+
+def from_hf_gpt2(hf_model, pipeline_stages: int = 0, dropout=None):
+    """transformers.GPT2LMHeadModel -> models.GPT2 (tied head).
+
+    `dropout` defaults to the checkpoint's resid_pdrop so fine-tuning
+    regularizes like the source model; pass 0.0 for inference parity
+    under training mode."""
+    from . import transformer as t
+
+    hc = hf_model.config
+    if dropout is None:
+        dropout = float(getattr(hc, "resid_pdrop", 0.0) or 0.0)
+    cfg = t.GPT2Config(
+        vocab_size=hc.vocab_size, max_position=hc.n_positions,
+        dim=hc.n_embd, num_layers=hc.n_layer, num_heads=hc.n_head,
+        dropout=dropout, pipeline_stages=pipeline_stages)
+    m = _init(t.GPT2(cfg))
+    params = m.get_params()
+    sd = hf_model.state_dict()
+
+    _set(params, "wte.table", _np(sd["transformer.wte.weight"]))
+    _set(params, "wpe.table", _np(sd["transformer.wpe.weight"]))
+    _set(params, "ln_f.gamma", _np(sd["transformer.ln_f.weight"]))
+    _set(params, "ln_f.beta", _np(sd["transformer.ln_f.bias"]))
+    D = hc.n_embd
+    for i in range(hc.n_layer):
+        hfp = f"transformer.h.{i}."
+        our = f"blocks.{i}."
+        for ln, theirs in (("ln_1", "ln_1"), ("ln_2", "ln_2")):
+            _set(params, f"{our}{ln}.gamma", _np(sd[f"{hfp}{theirs}.weight"]))
+            _set(params, f"{our}{ln}.beta", _np(sd[f"{hfp}{theirs}.bias"]))
+        # HF Conv1D stores (in, out): c_attn (D, 3D) fuses q|k|v columns
+        ca_w = _np(sd[f"{hfp}attn.c_attn.weight"])
+        ca_b = _np(sd[f"{hfp}attn.c_attn.bias"])
+        for j, which in enumerate(("q_proj", "k_proj", "v_proj")):
+            _set(params, f"{our}attn.{which}.W",
+                 ca_w[:, j * D:(j + 1) * D])
+            _set(params, f"{our}attn.{which}.b",
+                 ca_b[j * D:(j + 1) * D])
+        _set(params, f"{our}attn.out_proj.W",
+             _np(sd[f"{hfp}attn.c_proj.weight"]))
+        _set(params, f"{our}attn.out_proj.b",
+             _np(sd[f"{hfp}attn.c_proj.bias"]))
+        _set(params, f"{our}mlp.c_fc.W", _np(sd[f"{hfp}mlp.c_fc.weight"]))
+        _set(params, f"{our}mlp.c_fc.b", _np(sd[f"{hfp}mlp.c_fc.bias"]))
+        _set(params, f"{our}mlp.c_proj.W",
+             _np(sd[f"{hfp}mlp.c_proj.weight"]))
+        _set(params, f"{our}mlp.c_proj.b",
+             _np(sd[f"{hfp}mlp.c_proj.bias"]))
+    return m
+
+
+def from_hf_llama(hf_model, pipeline_stages: int = 0):
+    """transformers.LlamaForCausalLM -> models.Llama."""
+    from . import llama as lm
+
+    hc = hf_model.config
+    if getattr(hc, "attention_bias", False) or \
+            getattr(hc, "mlp_bias", False):
+        raise NotImplementedError(
+            "checkpoint uses attention_bias/mlp_bias; models.Llama's "
+            "projections are bias-free — silently dropping the biases "
+            "would corrupt the logits")
+    # Llama-3.1-style RoPE scaling must carry over or the scaled
+    # frequency bands diverge from transformers
+    scaling, orig_max = 0.0, hc.max_position_embeddings
+    rs = getattr(hc, "rope_scaling", None)
+    if rs:
+        kind = rs.get("rope_type", rs.get("type", "default"))
+        if kind == "llama3":
+            scaling = float(rs["factor"])
+            orig_max = int(rs.get("original_max_position_embeddings",
+                                  orig_max))
+        elif kind != "default":
+            raise NotImplementedError(
+                f"rope_scaling type {kind!r} is not supported "
+                "(supported: llama3)")
+    cfg = lm.LlamaConfig(
+        vocab_size=hc.vocab_size, dim=hc.hidden_size,
+        num_layers=hc.num_hidden_layers,
+        num_heads=hc.num_attention_heads,
+        num_kv_heads=getattr(hc, "num_key_value_heads",
+                             hc.num_attention_heads),
+        ffn_dim=hc.intermediate_size,
+        max_position=hc.max_position_embeddings,
+        rope_theta=float(getattr(hc, "rope_theta", 10000.0)),
+        rope_scaling=scaling,
+        rope_scaling_original_max_position=orig_max,
+        eps=float(hc.rms_norm_eps),
+        pipeline_stages=pipeline_stages)
+    m = _init(lm.Llama(cfg))
+    params = m.get_params()
+    sd = hf_model.state_dict()
+
+    _set(params, "tok_emb.table", _np(sd["model.embed_tokens.weight"]))
+    _set(params, "norm_f.gamma", _np(sd["model.norm.weight"]))
+    head = sd.get("lm_head.weight",
+                  sd["model.embed_tokens.weight"])   # tied fallback
+    _set(params, "lm_head.W", _np(head).T)
+    for i in range(hc.num_hidden_layers):
+        hfp = f"model.layers.{i}."
+        our = f"blocks.{i}."
+        _set(params, f"{our}attn_norm.gamma",
+             _np(sd[f"{hfp}input_layernorm.weight"]))
+        _set(params, f"{our}ffn_norm.gamma",
+             _np(sd[f"{hfp}post_attention_layernorm.weight"]))
+        # HF Linear stores (out, in) -> ours (in, out)
+        for theirs, ours in (("self_attn.q_proj", "attn.q_proj"),
+                             ("self_attn.k_proj", "attn.k_proj"),
+                             ("self_attn.v_proj", "attn.v_proj"),
+                             ("self_attn.o_proj", "attn.o_proj"),
+                             ("mlp.gate_proj", "ffn.gate"),
+                             ("mlp.up_proj", "ffn.up"),
+                             ("mlp.down_proj", "ffn.down")):
+            _set(params, f"{our}{ours}.W",
+                 _np(sd[f"{hfp}{theirs}.weight"]).T)
+    return m
+
+
+def from_hf(hf_model, **kw):
+    """Dispatch on the exact transformers class name (headless/variant
+    classes have different state-dict prefixes and are rejected)."""
+    name = type(hf_model).__name__
+    if name == "GPT2LMHeadModel":
+        return from_hf_gpt2(hf_model, **kw)
+    if name == "LlamaForCausalLM":
+        return from_hf_llama(hf_model, **kw)
+    raise NotImplementedError(
+        f"no converter for {name}; supported: GPT2LMHeadModel, "
+        "LlamaForCausalLM")

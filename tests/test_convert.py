@@ -1,0 +1,148 @@
+"""HF transformers -> singa_tpu weight conversion (models.from_hf):
+the direct switch-over path for users with pretrained checkpoints.
+Logit-level agreement with transformers, and the converted models
+train/generate through the normal framework surface."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import models, opt, parallel, tensor
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _ids(vocab=211, shape=(2, 16), seed=0):
+    return np.random.RandomState(seed).randint(
+        0, vocab, shape).astype(np.int32)
+
+
+@pytest.fixture(scope="module")
+def hf_gpt2():
+    torch.manual_seed(0)
+    cfg = transformers.GPT2Config(
+        vocab_size=211, n_positions=64, n_embd=48, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0, use_cache=False,
+        attn_implementation="eager")
+    return transformers.GPT2LMHeadModel(cfg).eval()
+
+
+@pytest.fixture(scope="module")
+def hf_llama():
+    torch.manual_seed(0)
+    cfg = transformers.LlamaConfig(
+        vocab_size=211, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        rope_theta=10000.0, rms_norm_eps=1e-5,
+        attn_implementation="eager", use_cache=False)
+    return transformers.LlamaForCausalLM(cfg).eval()
+
+
+def _hf_logits(hf, ids):
+    return hf(input_ids=torch.tensor(ids.astype(np.int64)),
+              use_cache=False).logits.detach().numpy()
+
+
+def test_gpt2_conversion_matches(hf_gpt2):
+    m = models.from_hf(hf_gpt2)
+    m.eval()
+    ids = _ids()
+    ref = _hf_logits(hf_gpt2, ids)
+    out = m(tensor.from_numpy(ids)).to_numpy().reshape(ref.shape)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_llama_conversion_matches_incl_gqa(hf_llama):
+    m = models.from_hf(hf_llama)
+    m.eval()
+    assert m.cfg.num_kv_heads == 2      # GQA carried over
+    ids = _ids()
+    ref = _hf_logits(hf_llama, ids)
+    out = m(tensor.from_numpy(ids)).to_numpy().reshape(ref.shape)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_converted_llama_generates(hf_llama):
+    m = models.from_hf(hf_llama)
+    m.eval()
+    ids = _ids(shape=(1, 8))
+    out = m.generate(ids, max_new_tokens=5)
+    assert out.shape == (1, 13)
+    assert (out[:, :8] == ids).all()
+
+
+def test_converted_model_finetunes(hf_gpt2):
+    np.random.seed(0)
+    m = models.from_hf(hf_gpt2)
+    m.set_optimizer(opt.AdamW(lr=1e-3))
+    ids = tensor.from_numpy(_ids())
+    m.compile([ids], is_train=True, use_graph=True)
+    losses = [float(m.train_step(ids)[1].to_numpy()) for _ in range(8)]
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_converted_llama_trains_pipelined(hf_llama):
+    """Conversion + pipeline compose: the HF weights drop into a
+    pipelined instantiation (param paths are identical) and the model
+    still matches transformers before training."""
+    parallel.set_mesh(parallel.make_mesh({"data": 4, "pipe": 2}))
+    try:
+        m = models.from_hf(hf_llama, pipeline_stages=2)
+        m.eval()
+        ids = _ids()
+        ref = _hf_logits(hf_llama, ids)
+        out = m(tensor.from_numpy(ids)).to_numpy().reshape(ref.shape)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+        m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.05)))
+        tids = tensor.from_numpy(_ids(shape=(8, 16)))
+        m.compile([tids], is_train=True, use_graph=True)
+        losses = [float(m.train_step(tids)[1].to_numpy())
+                  for _ in range(3)]
+        assert losses[-1] < losses[0], losses
+        assert "collective-permute" in m.graph.compiled_hlo()
+    finally:
+        parallel.set_mesh(None)
+
+
+def test_unsupported_model_raises():
+    class Fake:
+        pass
+
+    with pytest.raises(NotImplementedError, match="no converter"):
+        models.from_hf(Fake())
+
+
+def test_llama31_rope_scaling_carries_over():
+    """A rope_scaling='llama3' checkpoint must convert with the scaled
+    frequency bands (silently unscaled RoPE would diverge)."""
+    torch.manual_seed(0)
+    cfg = transformers.LlamaConfig(
+        vocab_size=211, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=256,
+        rope_theta=10000.0, rms_norm_eps=1e-5,
+        attn_implementation="eager", use_cache=False,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "original_max_position_embeddings": 32,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0})
+    hf = transformers.LlamaForCausalLM(cfg).eval()
+    m = models.from_hf(hf)
+    m.eval()
+    assert m.cfg.rope_scaling == 8.0
+    ids = _ids(shape=(2, 48))       # past the 32-token original window
+    ref = _hf_logits(hf, ids)
+    out = m(tensor.from_numpy(ids)).to_numpy().reshape(ref.shape)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_unsupported_rope_scaling_raises():
+    torch.manual_seed(0)
+    cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2,
+        max_position_embeddings=64, rope_scaling={
+            "rope_type": "yarn", "factor": 4.0})
+    hf = transformers.LlamaForCausalLM(cfg).eval()
+    with pytest.raises(NotImplementedError, match="yarn"):
+        models.from_hf(hf)
