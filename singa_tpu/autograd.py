@@ -897,8 +897,15 @@ class Tanh(Operator):
 
 
 class Gelu(Operator):
+    """GELU; approximate=True is the tanh form (GPT-2's gelu_new),
+    False the exact erf form (BERT, ONNX Gelu default)."""
+
+    def __init__(self, approximate: bool = True):
+        super().__init__()
+        self.approximate = approximate
+
     def fwd(self, a):
-        return jax.nn.gelu(a, approximate=True)
+        return jax.nn.gelu(a, approximate=self.approximate)
 
 
 class SiLU(Operator):
@@ -1016,8 +1023,8 @@ def tanh(a):
     return Tanh()(a)
 
 
-def gelu(a):
-    return Gelu()(a)
+def gelu(a, approximate: bool = True):
+    return Gelu(approximate)(a)
 
 
 def silu(a):

@@ -414,8 +414,12 @@ class Tanh(Layer):
 
 
 class Gelu(Layer):
+    def __init__(self, approximate: bool = True, name=None):
+        super().__init__(name)
+        self.approximate = approximate
+
     def forward(self, x):
-        return autograd.gelu(x)
+        return autograd.gelu(x, self.approximate)
 
 
 class SiLU(Layer):

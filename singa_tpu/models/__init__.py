@@ -31,7 +31,8 @@ from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 from .transformer import GPT2, BERT, GPT2Config, BERTConfig
 from .llama import Llama, LlamaConfig
-from .convert import from_hf, from_hf_gpt2, from_hf_llama
+from .convert import (from_hf, from_hf_bert, from_hf_gpt2,
+                      from_hf_llama)
 
 __all__ = [
     "mlp", "cnn", "resnet", "vgg", "transformer", "llama",
@@ -40,5 +41,5 @@ __all__ = [
     "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
     "GPT2", "BERT", "GPT2Config", "BERTConfig",
     "Llama", "LlamaConfig",
-    "from_hf", "from_hf_gpt2", "from_hf_llama",
+    "from_hf", "from_hf_bert", "from_hf_gpt2", "from_hf_llama",
 ]

@@ -8,7 +8,8 @@ state-dict conversion, no serialization round-trip).
     m = models.from_hf(hf)            # singa_tpu model, same logits
 
 Supported: GPT2LMHeadModel -> models.GPT2, LlamaForCausalLM ->
-models.Llama.  Conversions are pure layout mapping (HF Linear stores
+models.Llama, BertForSequenceClassification -> models.BERT.
+Conversions are pure layout mapping (HF Linear stores
 (out, in) -> ours (in, out); GPT-2's Conv1D already stores (in, out);
 HF's fused c_attn splits into q/k/v).  RoPE needs no permutation: both
 sides use the rotate-half convention.
@@ -23,7 +24,7 @@ import numpy as np
 from .. import tensor as tensor_mod
 from ..tensor import Tensor
 
-__all__ = ["from_hf", "from_hf_gpt2", "from_hf_llama"]
+__all__ = ["from_hf", "from_hf_gpt2", "from_hf_llama", "from_hf_bert"]
 
 
 def _np(t) -> np.ndarray:
@@ -166,6 +167,68 @@ def from_hf_llama(hf_model, pipeline_stages: int = 0):
     return m
 
 
+def from_hf_bert(hf_model):
+    """transformers.BertForSequenceClassification -> models.BERT
+    (exact-erf GELU on both sides)."""
+    from . import transformer as t
+
+    hc = hf_model.config
+    cfg = t.BERTConfig(
+        vocab_size=hc.vocab_size, max_position=hc.max_position_embeddings,
+        type_vocab_size=hc.type_vocab_size, dim=hc.hidden_size,
+        num_layers=hc.num_hidden_layers, num_heads=hc.num_attention_heads,
+        dropout=float(hc.hidden_dropout_prob),
+        num_labels=hc.num_labels, ffn_dim=hc.intermediate_size,
+        eps=float(hc.layer_norm_eps))
+    if getattr(hc, "hidden_act", "gelu") != "gelu":
+        raise NotImplementedError(
+            f"hidden_act={hc.hidden_act!r}; models.BERT implements the "
+            "standard exact-gelu BERT")
+    pe = getattr(hc, "position_embedding_type", "absolute")
+    if pe != "absolute":
+        raise NotImplementedError(
+            f"position_embedding_type={pe!r}; models.BERT implements "
+            "absolute position embeddings (relative-key checkpoints "
+            "would silently lose their distance embeddings)")
+    m = _init(t.BERT(cfg))
+    params = m.get_params()
+    sd = hf_model.state_dict()
+
+    emb = "bert.embeddings."
+    _set(params, "wte.table", _np(sd[emb + "word_embeddings.weight"]))
+    _set(params, "wpe.table", _np(sd[emb + "position_embeddings.weight"]))
+    _set(params, "wtype.table",
+         _np(sd[emb + "token_type_embeddings.weight"]))
+    _set(params, "ln_emb.gamma", _np(sd[emb + "LayerNorm.weight"]))
+    _set(params, "ln_emb.beta", _np(sd[emb + "LayerNorm.bias"]))
+    for i in range(hc.num_hidden_layers):
+        hfp = f"bert.encoder.layer.{i}."
+        our = f"blocks.{i}."
+        # HF Linear stores (out, in) -> ours (in, out)
+        for theirs, ours in (
+                ("attention.self.query", "attn.q_proj"),
+                ("attention.self.key", "attn.k_proj"),
+                ("attention.self.value", "attn.v_proj"),
+                ("attention.output.dense", "attn.out_proj"),
+                ("intermediate.dense", "mlp.c_fc"),
+                ("output.dense", "mlp.c_proj")):
+            _set(params, f"{our}{ours}.W",
+                 _np(sd[f"{hfp}{theirs}.weight"]).T)
+            _set(params, f"{our}{ours}.b",
+                 _np(sd[f"{hfp}{theirs}.bias"]))
+        for theirs, ours in (("attention.output.LayerNorm", "ln_1"),
+                             ("output.LayerNorm", "ln_2")):
+            _set(params, f"{our}{ours}.gamma",
+                 _np(sd[f"{hfp}{theirs}.weight"]))
+            _set(params, f"{our}{ours}.beta",
+                 _np(sd[f"{hfp}{theirs}.bias"]))
+    _set(params, "pooler.W", _np(sd["bert.pooler.dense.weight"]).T)
+    _set(params, "pooler.b", _np(sd["bert.pooler.dense.bias"]))
+    _set(params, "classifier.W", _np(sd["classifier.weight"]).T)
+    _set(params, "classifier.b", _np(sd["classifier.bias"]))
+    return m
+
+
 def from_hf(hf_model, **kw):
     """Dispatch on the exact transformers class name (headless/variant
     classes have different state-dict prefixes and are rejected)."""
@@ -174,6 +237,8 @@ def from_hf(hf_model, **kw):
         return from_hf_gpt2(hf_model, **kw)
     if name == "LlamaForCausalLM":
         return from_hf_llama(hf_model, **kw)
+    if name == "BertForSequenceClassification":
+        return from_hf_bert(hf_model, **kw)
     raise NotImplementedError(
         f"no converter for {name}; supported: GPT2LMHeadModel, "
-        "LlamaForCausalLM")
+        "LlamaForCausalLM, BertForSequenceClassification")

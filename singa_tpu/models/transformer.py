@@ -57,10 +57,20 @@ def _padding_mask(attention_mask: Optional[Tensor]):
 
 
 class _MLP(layer.Layer):
+    """act: "gelu_tanh" (GPT-2's gelu_new), "gelu" (exact erf — real
+    BERT semantics), or "relu"."""
+
     def __init__(self, hidden: int, act: str = "gelu", name=None):
         super().__init__(name)
         self.c_fc = layer.Linear(hidden)
-        self.act = layer.Gelu() if act == "gelu" else layer.ReLU()
+        if act == "gelu":
+            self.act = layer.Gelu(approximate=False)
+        elif act == "gelu_tanh":
+            self.act = layer.Gelu(approximate=True)
+        elif act == "relu":
+            self.act = layer.ReLU()
+        else:
+            raise ValueError(f"unknown _MLP act {act!r}")
         self.c_proj: Optional[layer.Layer] = None
         self._out: Optional[int] = None
 
@@ -111,7 +121,7 @@ class _GPT2Block(layer.Layer):
         self.attn = layer.MultiHeadAttention(cfg.num_heads, cfg.dim,
                                              causal=True)
         self.ln_2 = layer.LayerNorm(cfg.dim)
-        self.mlp = _MLP(4 * cfg.dim, "gelu")
+        self.mlp = _MLP(4 * cfg.dim, "gelu_tanh")   # HF gelu_new
         self.drop = layer.Dropout(cfg.dropout)
 
     def forward(self, x, mask=None, cache=None, pos=0):
@@ -270,6 +280,10 @@ class BERTConfig:
     num_heads: int = 12
     dropout: float = 0.1
     num_labels: Optional[int] = None  # optional classification head
+    # FFN width; 0 = the standard 4*dim
+    ffn_dim: int = 0
+    # LayerNorm epsilon (HF/original BERT uses 1e-12)
+    eps: float = 1e-12
 
     @staticmethod
     def tiny(num_labels: Optional[int] = None) -> "BERTConfig":
@@ -285,9 +299,9 @@ class _BERTBlock(layer.Layer):
         super().__init__(name)
         self.attn = layer.MultiHeadAttention(cfg.num_heads, cfg.dim,
                                              causal=False)
-        self.ln_1 = layer.LayerNorm(cfg.dim)
-        self.mlp = _MLP(4 * cfg.dim, "gelu")
-        self.ln_2 = layer.LayerNorm(cfg.dim)
+        self.ln_1 = layer.LayerNorm(cfg.dim, eps=cfg.eps)
+        self.mlp = _MLP(cfg.ffn_dim or 4 * cfg.dim, "gelu")
+        self.ln_2 = layer.LayerNorm(cfg.dim, eps=cfg.eps)
         self.drop = layer.Dropout(cfg.dropout)
 
     def forward(self, x, mask=None):
@@ -309,7 +323,7 @@ class BERT(model.Model):
         self.wte = layer.Embedding(c.vocab_size, c.dim)
         self.wpe = layer.Embedding(c.max_position, c.dim)
         self.wtype = layer.Embedding(c.type_vocab_size, c.dim)
-        self.ln_emb = layer.LayerNorm(c.dim)
+        self.ln_emb = layer.LayerNorm(c.dim, eps=c.eps)
         self.drop = layer.Dropout(c.dropout)
         self.blocks = [_BERTBlock(c) for _ in range(c.num_layers)]
         self.pooler = layer.Linear(c.dim)

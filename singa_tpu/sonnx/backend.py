@@ -284,9 +284,12 @@ def _h_hardsigmoid(ctx, node, attrs, ins):
 
 @handles("Gelu")
 def _h_gelu(ctx, node, attrs, ins):
-    if attrs.get("approximate", "none") == "tanh":
-        return [_apply(ctx, lambda a: jax.nn.gelu(a, approximate=True), ins[0])]
-    return [autograd.gelu(ctx.tensor(ins[0]))]
+    approx = attrs.get("approximate", "none")
+    if isinstance(approx, bytes):
+        approx = approx.decode()
+    # ONNX default is the exact erf form; only approximate="tanh" maps
+    # to the tanh approximation
+    return [autograd.gelu(ctx.tensor(ins[0]), approximate=(approx == "tanh"))]
 
 
 @handles("PRelu")

@@ -142,7 +142,8 @@ def _e_simple(ex, op, ins, outs):
 
 @_exports(autograd.Gelu)
 def _e_gelu(ex, op, ins, outs):
-    ex.emit("Gelu", ins, _outn(ex, outs))
+    approx = "tanh" if getattr(op, "approximate", True) else "none"
+    ex.emit("Gelu", ins, _outn(ex, outs), approximate=approx)
 
 
 @_exports(autograd.Mod)

@@ -146,3 +146,31 @@ def test_unsupported_rope_scaling_raises():
     hf = transformers.LlamaForCausalLM(cfg).eval()
     with pytest.raises(NotImplementedError, match="yarn"):
         models.from_hf(hf)
+
+
+def test_bert_conversion_matches_masked_typed():
+    """BertForSequenceClassification converts (exact-erf GELU both
+    sides) and matches transformers under padding mask + token types."""
+    torch.manual_seed(0)
+    cfg = transformers.BertConfig(
+        vocab_size=211, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=96,
+        max_position_embeddings=64, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        num_labels=3, attn_implementation="eager")
+    hf = transformers.BertForSequenceClassification(cfg).eval()
+    m = models.from_hf(hf)
+    m.eval()
+    ids = _ids()
+    am = np.ones((2, 16), np.int64)
+    am[:, 12:] = 0
+    tt = np.zeros((2, 16), np.int64)
+    tt[:, 8:] = 1
+    ref = hf(input_ids=torch.tensor(ids.astype(np.int64)),
+             attention_mask=torch.tensor(am),
+             token_type_ids=torch.tensor(tt)).logits.detach().numpy()
+    out = m(tensor.from_numpy(ids),
+            tensor.from_numpy(tt.astype(np.int32)),
+            tensor.from_numpy(am.astype(np.float32)))
+    o0 = (out[0] if isinstance(out, (list, tuple)) else out).to_numpy()
+    np.testing.assert_allclose(o0, ref, rtol=1e-4, atol=1e-5)
