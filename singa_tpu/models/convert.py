@@ -24,7 +24,8 @@ import numpy as np
 from .. import tensor as tensor_mod
 from ..tensor import Tensor
 
-__all__ = ["from_hf", "from_hf_gpt2", "from_hf_llama", "from_hf_bert"]
+__all__ = ["from_hf", "from_hf_gpt2", "from_hf_llama", "from_hf_bert",
+           "to_hf"]
 
 
 def _np(t) -> np.ndarray:
@@ -167,9 +168,13 @@ def from_hf_llama(hf_model, pipeline_stages: int = 0):
     return m
 
 
-def from_hf_bert(hf_model):
+def from_hf_bert(hf_model, **kw):
     """transformers.BertForSequenceClassification -> models.BERT
     (exact-erf GELU on both sides)."""
+    if kw:
+        raise NotImplementedError(
+            f"from_hf_bert takes no options (got {sorted(kw)}); "
+            "pipeline_stages applies to the decoder families only")
     from . import transformer as t
 
     hc = hf_model.config
@@ -242,3 +247,119 @@ def from_hf(hf_model, **kw):
     raise NotImplementedError(
         f"no converter for {name}; supported: GPT2LMHeadModel, "
         "LlamaForCausalLM, BertForSequenceClassification")
+
+
+# ---------------------------------------------------------------------------
+# the reverse direction: our trained models -> transformers instances
+# (save_pretrained-able; the exit path mirroring from_hf's entry path)
+# ---------------------------------------------------------------------------
+
+def _t(arr: np.ndarray):
+    import torch
+    return torch.from_numpy(np.ascontiguousarray(arr))
+
+
+def _np_of(params, name) -> np.ndarray:
+    return params[name].to_numpy().astype(np.float32)
+
+
+def to_hf(model):
+    """Export a models.GPT2 / models.Llama to a fresh transformers
+    model carrying this model's weights (inverse of from_hf; logits
+    match).  Returns the transformers instance — call .save_pretrained
+    on it to produce a standard HF checkpoint."""
+    import transformers
+
+    from . import llama as lm
+    from . import transformer as t
+
+    params = model.get_params()
+    if isinstance(model, t.GPT2):
+        c = model.cfg
+        hf = transformers.GPT2LMHeadModel(transformers.GPT2Config(
+            vocab_size=c.vocab_size, n_positions=c.max_position,
+            n_embd=c.dim, n_layer=c.num_layers, n_head=c.num_heads,
+            resid_pdrop=c.dropout, embd_pdrop=c.dropout,
+            attn_pdrop=c.dropout))
+        sd = {}
+        sd["transformer.wte.weight"] = _t(_np_of(params, "wte.table"))
+        sd["transformer.wpe.weight"] = _t(_np_of(params, "wpe.table"))
+        sd["transformer.ln_f.weight"] = _t(_np_of(params, "ln_f.gamma"))
+        sd["transformer.ln_f.bias"] = _t(_np_of(params, "ln_f.beta"))
+        sd["lm_head.weight"] = sd["transformer.wte.weight"]  # tied
+        for i in range(c.num_layers):
+            our = f"blocks.{i}."
+            hfp = f"transformer.h.{i}."
+            for ln in ("ln_1", "ln_2"):
+                sd[f"{hfp}{ln}.weight"] = _t(_np_of(params,
+                                                    f"{our}{ln}.gamma"))
+                sd[f"{hfp}{ln}.bias"] = _t(_np_of(params,
+                                                  f"{our}{ln}.beta"))
+            # fuse q|k|v back into Conv1D's (in, 3*out) c_attn
+            w = np.concatenate([_np_of(params, f"{our}attn.{p}.W")
+                                for p in ("q_proj", "k_proj", "v_proj")],
+                               axis=1)
+            b = np.concatenate([_np_of(params, f"{our}attn.{p}.b")
+                                for p in ("q_proj", "k_proj", "v_proj")])
+            sd[f"{hfp}attn.c_attn.weight"] = _t(w)
+            sd[f"{hfp}attn.c_attn.bias"] = _t(b)
+            sd[f"{hfp}attn.c_proj.weight"] = _t(
+                _np_of(params, f"{our}attn.out_proj.W"))
+            sd[f"{hfp}attn.c_proj.bias"] = _t(
+                _np_of(params, f"{our}attn.out_proj.b"))
+            sd[f"{hfp}mlp.c_fc.weight"] = _t(
+                _np_of(params, f"{our}mlp.c_fc.W"))
+            sd[f"{hfp}mlp.c_fc.bias"] = _t(
+                _np_of(params, f"{our}mlp.c_fc.b"))
+            sd[f"{hfp}mlp.c_proj.weight"] = _t(
+                _np_of(params, f"{our}mlp.c_proj.W"))
+            sd[f"{hfp}mlp.c_proj.bias"] = _t(
+                _np_of(params, f"{our}mlp.c_proj.b"))
+        hf.load_state_dict(sd, strict=False)
+        hf.tie_weights()
+        return hf.eval()
+
+    if isinstance(model, lm.Llama):
+        c = model.cfg
+        rs = None
+        if c.rope_scaling:
+            rs = {"rope_type": "llama3", "factor": float(c.rope_scaling),
+                  "original_max_position_embeddings":
+                      int(c.rope_scaling_original_max_position),
+                  "low_freq_factor": 1.0, "high_freq_factor": 4.0}
+        hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+            vocab_size=c.vocab_size, hidden_size=c.dim,
+            intermediate_size=c.ffn_dim, num_hidden_layers=c.num_layers,
+            num_attention_heads=c.num_heads,
+            num_key_value_heads=c.num_kv_heads,
+            max_position_embeddings=c.max_position,
+            rope_theta=c.rope_theta, rms_norm_eps=c.eps,
+            rope_scaling=rs, attention_bias=False, mlp_bias=False,
+            tie_word_embeddings=False))
+        sd = {}
+        sd["model.embed_tokens.weight"] = _t(_np_of(params,
+                                                    "tok_emb.table"))
+        sd["model.norm.weight"] = _t(_np_of(params, "norm_f.gamma"))
+        sd["lm_head.weight"] = _t(_np_of(params, "lm_head.W").T)
+        for i in range(c.num_layers):
+            our = f"blocks.{i}."
+            hfp = f"model.layers.{i}."
+            sd[f"{hfp}input_layernorm.weight"] = _t(
+                _np_of(params, f"{our}attn_norm.gamma"))
+            sd[f"{hfp}post_attention_layernorm.weight"] = _t(
+                _np_of(params, f"{our}ffn_norm.gamma"))
+            for theirs, ours in (("self_attn.q_proj", "attn.q_proj"),
+                                 ("self_attn.k_proj", "attn.k_proj"),
+                                 ("self_attn.v_proj", "attn.v_proj"),
+                                 ("self_attn.o_proj", "attn.o_proj"),
+                                 ("mlp.gate_proj", "ffn.gate"),
+                                 ("mlp.up_proj", "ffn.up"),
+                                 ("mlp.down_proj", "ffn.down")):
+                sd[f"{hfp}{theirs}.weight"] = _t(
+                    _np_of(params, f"{our}{ours}.W").T)
+        hf.load_state_dict(sd, strict=False)
+        return hf.eval()
+
+    raise NotImplementedError(
+        f"to_hf supports models.GPT2 and models.Llama, got "
+        f"{type(model).__name__}")

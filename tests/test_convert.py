@@ -174,3 +174,70 @@ def test_bert_conversion_matches_masked_typed():
             tensor.from_numpy(am.astype(np.float32)))
     o0 = (out[0] if isinstance(out, (list, tuple)) else out).to_numpy()
     np.testing.assert_allclose(o0, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestToHF:
+    """The reverse direction: models.to_hf exports our weights into a
+    fresh transformers instance with matching logits (full-circle
+    from_hf(to_hf(m)) == m)."""
+
+    def test_gpt2_to_hf_matches(self):
+        tensor.set_seed(0)
+        ids = _ids()
+        g = models.GPT2(models.GPT2Config(
+            vocab_size=211, max_position=64, dim=48, num_layers=2,
+            num_heads=4, dropout=0.0))
+        g.compile([tensor.from_numpy(ids)], is_train=False,
+                  use_graph=False)
+        g.eval()
+        ours = g(tensor.from_numpy(ids)).to_numpy().reshape(2, 16, 211)
+        hf = models.to_hf(g)
+        ref = hf(input_ids=torch.tensor(ids.astype(np.int64)),
+                 use_cache=False).logits.detach().numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_llama_roundtrip_full_circle(self):
+        tensor.set_seed(1)
+        ids = _ids()
+        m = models.Llama(models.LlamaConfig(
+            vocab_size=211, dim=48, num_layers=2, num_heads=4,
+            num_kv_heads=2, ffn_dim=96, max_position=64,
+            rope_theta=10000.0))
+        m.compile([tensor.from_numpy(ids)], is_train=False,
+                  use_graph=False)
+        m.eval()
+        ours = m(tensor.from_numpy(ids)).to_numpy().reshape(2, 16, 211)
+        hf = models.to_hf(m)
+        ref = hf(input_ids=torch.tensor(ids.astype(np.int64)),
+                 use_cache=False).logits.detach().numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+        back = models.from_hf(hf)
+        back.eval()
+        o2 = back(tensor.from_numpy(ids)).to_numpy().reshape(2, 16, 211)
+        np.testing.assert_allclose(o2, ours, rtol=1e-4, atol=1e-5)
+
+    def test_to_hf_save_pretrained_roundtrip(self, tmp_path):
+        """The exported instance is a real HF model: save_pretrained /
+        from_pretrained round-trips on disk."""
+        tensor.set_seed(2)
+        ids = _ids()
+        m = models.GPT2(models.GPT2Config(
+            vocab_size=211, max_position=64, dim=48, num_layers=2,
+            num_heads=4, dropout=0.0))
+        m.compile([tensor.from_numpy(ids)], is_train=False,
+                  use_graph=False)
+        m.eval()
+        hf = models.to_hf(m)
+        d = str(tmp_path / "hf_ckpt")
+        hf.save_pretrained(d, safe_serialization=False)
+        hf2 = transformers.GPT2LMHeadModel.from_pretrained(d).eval()
+        ids64 = torch.tensor(ids.astype(np.int64))
+        np.testing.assert_allclose(
+            hf(input_ids=ids64, use_cache=False).logits.detach().numpy(),
+            hf2(input_ids=ids64,
+                use_cache=False).logits.detach().numpy(),
+            rtol=1e-5, atol=1e-6)
+
+    def test_to_hf_unsupported_raises(self):
+        with pytest.raises(NotImplementedError, match="to_hf supports"):
+            models.to_hf(models.MLP())

@@ -45,7 +45,10 @@ def _run_session() -> bool:
     between the probe and the session's own backend init, hanging it
     forever).  Success = the headline stage actually produced a result
     in tpu_session.json — not merely rc==0."""
-    budget = float(os.environ.get("SINGA_TPU_SESSION_BUDGET_S", "1900"))
+    budget = float(os.environ.get("SINGA_TPU_SESSION_BUDGET_S", "2600"))
+    # one value governs both processes: export the resolved budget so
+    # tpu_session.py cannot drift from the timeout computed here
+    os.environ["SINGA_TPU_SESSION_BUDGET_S"] = str(budget)
     # a stale results file from an earlier session must not count as
     # this run's success
     results = os.path.join(_REPO, "tpu_session.json")
