@@ -113,9 +113,62 @@ def _pick(logits, temperature: float, rng_key, top_k: Optional[int],
     return jax.random.categorical(rng_key, lg, axis=-1)
 
 
+@functools.partial(jax.jit, static_argnums=(2,))
+def _beam_select(logits, scores, k: int, done=None, eos_id=None):
+    """One beam-search expansion, entirely on device: combine the
+    (B*K, V) next-token logits with the (B, K) running scores, flatten
+    each batch's K*V candidates, and keep the top K.  A finished beam
+    (done mask + eos_id, both traced) admits only eos at zero
+    incremental cost, so its raw score freezes.  Returns
+    (beam_idx (B,K), tok (B,K), new_scores (B,K))."""
+    B, K = scores.shape
+    V = logits.shape[-1]
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    lp = lp.reshape(B, K, V)
+    if done is not None:
+        eos_row = jnp.where(jnp.arange(V) == eos_id, 0.0, -jnp.inf)
+        lp = jnp.where(done[:, :, None], eos_row, lp)
+    cand = scores[:, :, None] + lp
+    top, flat_idx = jax.lax.top_k(cand.reshape(B, K * V), k)
+    return flat_idx // V, flat_idx % V, top
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _beam_reorder(caches, perm):
+    """Gather the KV caches onto the surviving beams (batch axis 0)."""
+    return jax.tree.map(lambda c: jnp.take(c, perm, axis=0), caches)
+
+
 class GenerateMixin:
     """Adds `generate()` to decoder models exposing
     `forward_cached(ids, caches, pos)` and `init_caches(batch, max_len)`."""
+
+    def _gen_setup(self, prompt_ids, max_new_tokens: int, rows_mult: int):
+        """Shared session/validation preamble for generate/generate_beam:
+        normalize the prompt, enforce max_position, fetch-or-compile the
+        (rows, P, S) session, and snapshot params/buffers."""
+        ids = np.asarray(prompt_ids)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        B, P = ids.shape
+        S = P + max_new_tokens
+        max_pos = getattr(getattr(self, "cfg", None), "max_position", None)
+        if max_pos is not None and S > max_pos:
+            # positions past max_position would silently clamp inside jit
+            # (embedding gather / RoPE-table dynamic_slice) — refuse loudly
+            raise ValueError(
+                f"prompt ({P}) + max_new_tokens ({max_new_tokens}) = {S} "
+                f"exceeds the model's max_position ({max_pos})")
+        sessions = getattr(self, "_gen_sessions", None)
+        if sessions is None:
+            sessions = self._gen_sessions = {}
+        key = (B * rows_mult, P, S)
+        sess = sessions.get(key)
+        if sess is None:
+            sess = sessions[key] = _GenSession(self, B * rows_mult, P, S)
+        params = {n: t.data for n, t in self.get_params().items()}
+        buffers = {n: t.data for n, t in self._get_buffers().items()}
+        return ids, B, P, S, sess, params, buffers
 
     def generate(self, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, seed: int = 0,
@@ -129,28 +182,8 @@ class GenerateMixin:
         row has emitted it, decoding stops early and the remaining
         positions are filled with eos_id; per-row truncation is the
         caller's job."""
-        ids = np.asarray(prompt_ids)
-        if ids.ndim == 1:
-            ids = ids[None, :]
-        B, P = ids.shape
-        S = P + max_new_tokens
-        max_pos = getattr(getattr(self, "cfg", None), "max_position", None)
-        if max_pos is not None and S > max_pos:
-            # positions past max_position would silently clamp inside jit
-            # (embedding gather / RoPE-table dynamic_slice) — refuse loudly
-            raise ValueError(
-                f"prompt ({P}) + max_new_tokens ({max_new_tokens}) = {S} "
-                f"exceeds the model's max_position ({max_pos})")
-        key = (B, P, S)
-        sessions = getattr(self, "_gen_sessions", None)
-        if sessions is None:
-            sessions = self._gen_sessions = {}
-        sess = sessions.get(key)
-        if sess is None:
-            sess = sessions[key] = _GenSession(self, B, P, S)
-
-        params = {n: t.data for n, t in self.get_params().items()}
-        buffers = {n: t.data for n, t in self._get_buffers().items()}
+        ids, B, P, S, sess, params, buffers = self._gen_setup(
+            prompt_ids, max_new_tokens, 1)
         rng = jax.random.PRNGKey(seed)
 
         out = np.zeros((B, S), np.int32)
@@ -171,4 +204,91 @@ class GenerateMixin:
                 logits, caches = sess.decode(
                     params, buffers, tok[:, None].astype(jnp.int32),
                     jnp.asarray(P + i, jnp.int32), caches)
+        return out
+
+    def generate_beam(self, prompt_ids, max_new_tokens: int,
+                      num_beams: int = 4, length_penalty: float = 1.0,
+                      eos_id: Optional[int] = None,
+                      return_scores: bool = False):
+        """Beam-search decoding (static shapes: the K beams ride the
+        batch axis, so the same compiled prefill/decode pair as
+        `generate` serves a (B*K)-row batch).  Each step is one jitted
+        expansion (`_beam_select`), one jitted cache gather
+        (`_beam_reorder`), and one decode dispatch.
+
+        Once a beam emits `eos_id` its hypothesis is frozen: its only
+        expansion is eos at zero cost, so its RAW cumulative score stays
+        constant — but it remains in the single K-wide frontier and can
+        still be evicted by K continuing candidates with higher raw
+        scores (no separate finished-hypothesis pool, unlike e.g. the
+        HF implementation).  `length_penalty` is applied only at the
+        END, ranking the K survivors by cumulative logprob /
+        length**length_penalty.  Returns the best survivor per batch
+        row — shape (B, P + max_new_tokens), eos-padded; with
+        `return_scores`, also the (B,) cumulative logprob of each
+        returned hypothesis (its exact sum of chosen-token logprobs)."""
+        K = int(num_beams)
+        if K < 1:
+            raise ValueError(f"num_beams must be >= 1, got {K}")
+        ids, B, P, S, sess, params, buffers = self._gen_setup(
+            prompt_ids, max_new_tokens, K)
+        rep = np.repeat(ids, K, axis=0)                      # (B*K, P)
+        logits, caches = sess.prefill(params, buffers,
+                                      jnp.asarray(rep, jnp.int32))
+        # before the first expansion all K beams are identical: only
+        # beam 0 may seed the frontier
+        scores = jnp.full((B, K), -jnp.inf, jnp.float32).at[:, 0].set(0.0)
+        seqs = np.zeros((B, K, max_new_tokens), np.int32)
+        done = np.zeros((B, K), bool)
+        gen_len = np.zeros((B, K), np.int32)   # tokens before eos
+        offsets = np.arange(B)[:, None] * K
+
+        for i in range(max_new_tokens):
+            if eos_id is not None:
+                # freezing happens inside the jitted select: only the
+                # tiny (B,K) done mask is uploaded, never the logits
+                beam_idx, tok, scores = _beam_select(
+                    logits, scores, K, jnp.asarray(done),
+                    jnp.asarray(eos_id, jnp.int32))
+            else:
+                beam_idx, tok, scores = _beam_select(logits, scores, K)
+            beam_idx = np.asarray(beam_idx)
+            tok = np.asarray(tok)
+            # host bookkeeping follows the surviving beams
+            gather = np.take_along_axis
+            seqs = gather(seqs, beam_idx[:, :, None], axis=1)
+            done = gather(done, beam_idx, axis=1)
+            gen_len = gather(gen_len, beam_idx, axis=1)
+            seqs[:, :, i] = tok
+            if eos_id is not None:
+                newly = (~done) & (tok == eos_id)
+                done |= newly
+                gen_len = np.where(done, gen_len, i + 1)
+                if done.all():
+                    break
+            else:
+                gen_len[:] = i + 1
+            if i + 1 < max_new_tokens:
+                perm = jnp.asarray((beam_idx + offsets).reshape(-1))
+                caches = _beam_reorder(caches, perm)
+                logits, caches = sess.decode(
+                    params, buffers,
+                    jnp.asarray(tok.reshape(-1, 1), jnp.int32),
+                    jnp.asarray(P + i, jnp.int32), caches)
+
+        final = np.asarray(scores) / np.maximum(
+            gen_len, 1).astype(np.float32) ** length_penalty
+        best = final.argmax(axis=1)
+        out = np.full((B, S), eos_id if eos_id is not None else 0,
+                      np.int32)
+        out[:, :P] = ids
+        for b in range(B):
+            n = int(gen_len[b, best[b]]) if eos_id is not None \
+                else max_new_tokens
+            out[b, P:P + n] = seqs[b, best[b], :n]
+            if eos_id is not None and bool(done[b, best[b]]):
+                out[b, P + n:] = eos_id
+        if return_scores:
+            raw = np.asarray(scores)
+            return out, raw[np.arange(B), best]
         return out
