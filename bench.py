@@ -259,11 +259,17 @@ def bench_llama_generate(dev, on_tpu: bool) -> None:
     prompt = np.random.randint(0, cfg.vocab_size, (B, P)).astype(np.int32)
     ids_t = tensor.from_numpy(prompt)
     m.compile([ids_t], is_train=False, use_graph=True)
+    # decode is weight-read bound: bf16 params halve per-token HBM
+    # traffic on TPU (CPU fallback stays f32 — bf16 is slow there)
+    import jax.numpy as jnp
+    pdt = jnp.bfloat16 if on_tpu else None
     t0 = time.perf_counter()
-    m.generate(prompt, max_new_tokens=N)          # compiles prefill+decode
+    m.generate(prompt, max_new_tokens=N,          # compiles prefill+decode
+               param_dtype=pdt)
     t_first = time.perf_counter() - t0
     t0 = time.perf_counter()
-    out = m.generate(prompt, max_new_tokens=N)    # steady state
+    out = m.generate(prompt, max_new_tokens=N,    # steady state
+                     param_dtype=pdt)
     dt = time.perf_counter() - t0
     assert out.shape == (B, P + N)
     assert len(m._gen_sessions) == 1, "decode re-compiled between calls"

@@ -595,3 +595,24 @@ class TestBeamSearch:
         m, prompt = self._model()
         with pytest.raises(ValueError, match="num_beams"):
             m.generate_beam(prompt, max_new_tokens=2, num_beams=0)
+
+
+def test_generate_param_dtype_bf16():
+    """param_dtype casts weights once for decoding (the bf16 weight-read
+    lever); output stays valid and the session still compiles once."""
+    tensor.set_seed(0)
+    np.random.seed(0)
+    cfg = models.LlamaConfig.tiny()
+    m = models.Llama(cfg)
+    prompt = np.random.randint(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+    m.compile([tensor.from_numpy(prompt)], is_train=False, use_graph=True)
+    m.eval()
+    a = m.generate(prompt, max_new_tokens=5, param_dtype=jnp.bfloat16)
+    b = m.generate(prompt, max_new_tokens=5, param_dtype=jnp.bfloat16)
+    assert a.shape == (2, 13)
+    np.testing.assert_array_equal(a, b)          # deterministic
+    assert (a < cfg.vocab_size).all() and (a >= 0).all()
+    assert len(m._gen_sessions) == 1
+    # master weights untouched
+    for p in m.get_params().values():
+        assert p.data.dtype == jnp.float32

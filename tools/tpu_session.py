@@ -306,11 +306,12 @@ def main() -> None:
         prompt = np.random.randint(0, cfg.vocab_size, (B, P)).astype(np.int32)
         gm.compile([tensor.from_numpy(prompt)], is_train=False,
                    use_graph=True)
+        pdt = None if _SMOKE else jnp.bfloat16   # bf16 weight reads
         t0 = time.time()
-        gm.generate(prompt, max_new_tokens=N)
+        gm.generate(prompt, max_new_tokens=N, param_dtype=pdt)
         t_first = time.time() - t0
         t0 = time.perf_counter()
-        out = gm.generate(prompt, max_new_tokens=N)
+        out = gm.generate(prompt, max_new_tokens=N, param_dtype=pdt)
         dt = time.perf_counter() - t0
         assert out.shape == (B, P + N)
         assert len(gm._gen_sessions) == 1
