@@ -649,13 +649,15 @@ class MultiHeadAttention(Layer):
 
 
 class _MoEOp(autograd.Operator):
-    def __init__(self, cf):
+    def __init__(self, cf, top_k=1):
         super().__init__()
         self.cf = cf
+        self.top_k = top_k
 
     def fwd(self, xa, rw, wi, wo):
         from .ops.moe import moe_forward
-        out, aux = moe_forward(xa, rw, wi, wo, self.cf, return_aux=True)
+        out, aux = moe_forward(xa, rw, wi, wo, self.cf, return_aux=True,
+                               top_k=self.top_k)
         return out, aux
 
 
@@ -681,11 +683,16 @@ class MoE(Layer):
     REMAT_SAFE = False
 
     def __init__(self, num_experts: int, ffn_dim: int,
-                 capacity_factor: float = 1.25, name=None):
+                 capacity_factor: float = 1.25, top_k: int = 1,
+                 name=None):
         super().__init__(name)
+        if not 1 <= top_k <= num_experts:
+            raise ValueError(
+                f"top_k={top_k} outside [1, num_experts={num_experts}]")
         self.num_experts = num_experts
         self.ffn_dim = ffn_dim
         self.capacity_factor = capacity_factor
+        self.top_k = top_k
         self._aux_losses: List[Tensor] = []
 
     def initialize(self, x: Tensor):
@@ -703,7 +710,7 @@ class MoE(Layer):
 
     def forward(self, x: Tensor) -> Tensor:
         # router stays f32 master: moe_forward computes routing in f32
-        out, aux = _MoEOp(self.capacity_factor)(
+        out, aux = _MoEOp(self.capacity_factor, self.top_k)(
             x, self.router, self.w_in, self.w_out)
         # accumulate only in training: eval/compile-time dry runs must
         # not leave stale entries (an init-trace tracer here would crash

@@ -251,7 +251,7 @@ class GenerateMixin:
         scores = jnp.full((B, K), -jnp.inf, jnp.float32).at[:, 0].set(0.0)
         seqs = np.zeros((B, K, max_new_tokens), np.int32)
         done = np.zeros((B, K), bool)
-        gen_len = np.zeros((B, K), np.int32)   # tokens before eos
+        gen_len = np.zeros((B, K), np.int32)   # length incl. eos
         offsets = np.arange(B)[:, None] * K
 
         for i in range(max_new_tokens):

@@ -32,25 +32,36 @@ import jax.numpy as jnp
 __all__ = ["moe_dispatch", "moe_forward", "load_balance_loss"]
 
 
-def moe_dispatch(logits, capacity: int):
-    """Top-1 routing with static capacity.
+def moe_dispatch(logits, capacity: int, k: int = 1):
+    """Top-k routing with static capacity (k=1: Switch; k=2: GShard).
 
-    logits: (N, E).  Returns (combine (N, E, C) f32, gate (N,), aux
-    tensors for the balance loss).  combine[n, e, c] is the gate weight
-    of token n at slot c of expert e (0 everywhere else; 0 for dropped
-    tokens)."""
+    logits: (N, E).  Returns (combine (N, E, C) f32, probs (N, E),
+    onehot (N, E) of the FIRST choice — the balance loss follows the
+    primary assignment).  combine[n, e, c] is token n's gate weight at
+    slot c of expert e (0 everywhere else; 0 for dropped assignments).
+    Gates renormalize over the k selected experts; capacity slots fill
+    rank-major (every token's first choice outranks any second choice,
+    the GShard priority)."""
     N, E = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    gate = jnp.max(probs, axis=-1)                     # (N,)
-    expert = jnp.argmax(probs, axis=-1)                # (N,)
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)   # (N, E)
-    # position of each token within its expert's queue
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0    # (N, E), -1 elsewhere
-    pos_in_expert = jnp.sum(pos * onehot, axis=-1)     # (N,)
-    keep = pos_in_expert < capacity
-    slot = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), capacity,
-                          dtype=jnp.float32)
-    combine = (onehot * (gate * keep)[:, None])[:, :, None] * slot[:, None, :]
+    topv, topi = jax.lax.top_k(probs, k)               # (N, k)
+    # Switch (k=1) gates with the RAW top probability (router gradient
+    # flows through the gate); GShard (k>1) renormalizes over the k
+    # selected experts
+    gates = topv if k == 1 else \
+        topv / jnp.sum(topv, axis=-1, keepdims=True)
+    # rank-major flattening: (k*N, E); cumsum gives globally consistent
+    # slot positions with rank-0 assignments filling first
+    oh = jax.nn.one_hot(topi.T.reshape(-1), E, dtype=jnp.float32)
+    pos = jnp.sum(jnp.cumsum(oh, axis=0) * oh - oh, axis=-1)  # (k*N,)
+    keep = pos < capacity
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                          dtype=jnp.float32)           # (k*N, C)
+    gate_flat = gates.T.reshape(-1)                    # (k*N,)
+    contrib = (oh * (gate_flat * keep)[:, None])[:, :, None] \
+        * slot[:, None, :]                             # (k*N, E, C)
+    combine = jnp.sum(contrib.reshape(k, N, E, capacity), axis=0)
+    onehot = jax.nn.one_hot(topi[:, 0], E, dtype=jnp.float32)
     return combine, probs, onehot
 
 
@@ -63,8 +74,8 @@ def load_balance_loss(probs, onehot):
 
 
 def moe_forward(x, router_w, w_in, w_out, capacity_factor: float = 1.25,
-                return_aux: bool = False):
-    """Top-1 MoE FFN over flattened tokens.
+                return_aux: bool = False, top_k: int = 1):
+    """Top-k MoE FFN over flattened tokens (k=1 Switch, k=2 GShard).
 
     x: (..., D); router_w: (D, E); w_in: (E, D, H); w_out: (E, H, D).
     Expert e computes relu(x @ w_in[e]) @ w_out[e].  Shard w_in/w_out's
@@ -74,10 +85,11 @@ def moe_forward(x, router_w, w_in, w_out, capacity_factor: float = 1.25,
     xf = x.reshape(-1, D)
     N = xf.shape[0]
     E = router_w.shape[-1]
-    capacity = max(1, math.ceil(capacity_factor * N / E))
+    # capacity covers the k-fold assignment load at the same factor
+    capacity = max(1, math.ceil(capacity_factor * top_k * N / E))
 
     logits = xf.astype(jnp.float32) @ router_w.astype(jnp.float32)
-    combine, probs, onehot = moe_dispatch(logits, capacity)
+    combine, probs, onehot = moe_dispatch(logits, capacity, top_k)
     dispatch = (combine > 0).astype(xf.dtype)          # (N, E, C)
     # dispatch tokens into per-expert buffers: (E, C, D)
     buf = jnp.einsum("nec,nd->ecd", dispatch, xf)

@@ -192,3 +192,90 @@ def test_layer_declared_rules_suffice():
         assert "expert" in str(ex._param_sh["moe.w_in"].spec)
     finally:
         parallel.set_mesh(None)
+
+
+class TestTopKRouting:
+    """GShard top-2 routing: with ample capacity the MoE output equals
+    the dense sum of the two selected experts weighted by renormalized
+    gates; EP training still composes."""
+
+    def test_top2_matches_dense_reference(self):
+        from singa_tpu.ops.moe import moe_forward
+
+        rng = np.random.RandomState(0)
+        N, D, E, H = 16, 8, 4, 12
+        x = jnp.asarray(rng.randn(N, D).astype(np.float32))
+        rw = jnp.asarray(rng.randn(D, E).astype(np.float32) * 0.5)
+        wi = jnp.asarray(rng.randn(E, D, H).astype(np.float32) * 0.3)
+        wo = jnp.asarray(rng.randn(E, H, D).astype(np.float32) * 0.3)
+        out = moe_forward(x, rw, wi, wo, capacity_factor=8.0, top_k=2)
+
+        probs = np.asarray(jax.nn.softmax(x @ rw, axis=-1))
+        ref = np.zeros((N, D), np.float32)
+        for n in range(N):
+            top2 = np.argsort(probs[n])[::-1][:2]
+            g = probs[n, top2] / probs[n, top2].sum()
+            for gi, e in zip(g, top2):
+                h = np.maximum(np.asarray(x)[n] @ np.asarray(wi)[e], 0)
+                ref[n] += gi * (h @ np.asarray(wo)[e])
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_top2_capacity_priority_is_rank_major(self):
+        """GShard priority: a LATER token's FIRST choice must beat an
+        EARLIER token's SECOND choice for the last capacity slot (a
+        token-major fill would decide the other way)."""
+        from singa_tpu.ops.moe import moe_dispatch
+
+        # token 0: first choice e1, second e0.
+        # token 1: first choice e0, second e1.  capacity 1 per expert.
+        logits = jnp.asarray(np.array([[2.0, 5.0],
+                                       [5.0, 2.0]], np.float32))
+        combine, _, _ = moe_dispatch(logits, capacity=1, k=2)
+        c = np.asarray(combine)
+        # e0's one slot goes to token 1 (its FIRST choice), not token 0
+        # (whose e0 assignment is rank-1 and must drop)
+        assert (c[1, 0] > 0).any() and (c[0, 0] == 0).all()
+        # symmetric for e1: token 0's first choice wins the slot
+        assert (c[0, 1] > 0).any() and (c[1, 1] == 0).all()
+
+    def test_top2_layer_trains_with_ep(self):
+        from singa_tpu import autograd, layer, model, opt, parallel, tensor
+
+        class Net(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.moe = layer.MoE(4, ffn_dim=16, capacity_factor=2.0,
+                                     top_k=2)
+                self.fc = layer.Linear(4)
+
+            def forward(self, x):
+                return self.fc(self.moe(x))
+
+            def train_one_batch(self, x, y):
+                out = self.forward(x)
+                loss = autograd.softmax_cross_entropy(out, y)
+                loss = loss + autograd.mul(self.moe.pop_aux_loss(), 0.01)
+                self.optimizer.backward_and_update(loss)
+                return out, loss
+
+        parallel.set_mesh(parallel.make_mesh({"data": 2, "expert": 4}))
+        try:
+            tensor.set_seed(0)
+            np.random.seed(0)
+            m = Net()
+            m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.05)))
+            x = tensor.from_numpy(np.random.randn(16, 8).astype(np.float32))
+            y = tensor.from_numpy(np.random.randint(0, 4, 16).astype(np.int32))
+            m.compile([x], is_train=True, use_graph=True)
+            losses = [float(m.train_step(x, y)[1].to_numpy())
+                      for _ in range(6)]
+            assert losses[-1] < losses[0], losses
+        finally:
+            parallel.set_mesh(None)
+
+    def test_bad_top_k_raises(self):
+        from singa_tpu import layer
+
+        with pytest.raises(ValueError, match="top_k"):
+            layer.MoE(4, ffn_dim=8, top_k=5)
