@@ -914,6 +914,24 @@ class _PipelineOp(autograd.Operator):
             return jax.lax.with_sharding_constraint(
                 a, mesh_mod.NamedSharding(mesh, spec))
 
+        def constrain_stacked(a, tpl_tensor):
+            """Stacked (S, k, *param) weights: stage axis over 'pipe',
+            trailing param dims under the model's SHARD_RULES (same TP
+            layout the executor pinned on the unstacked params — no
+            per-step all-gather of TP shards).  _pipe_live() guarantees
+            the mesh exists with pipe == stages > 1 whenever this op
+            runs."""
+            from .parallel import spmd as spmd_mod
+            rules = spmd_mod.current_trace_rules()
+            pspec = ()
+            name = getattr(tpl_tensor, "name", "") or ""
+            if rules and name:
+                pspec = tuple(spmd_mod.spec_for(
+                    name, tuple(tpl_tensor.data.shape), rules, mesh))
+            spec = mesh_mod.P("pipe", None, *pspec)
+            return jax.lax.with_sharding_constraint(
+                a, mesh_mod.NamedSharding(mesh, spec))
+
         def apply_block(leaves, h, *ex):
             saved = [(t.data, t.requires_grad, t.stores_grad) for t in tpl]
             saved_key = tensor_mod._rng_key
@@ -944,11 +962,12 @@ class _PipelineOp(autograd.Operator):
                     f"batch {B} not divisible by n_micro={M}")
             mb = B // M
             # stack blocks-major flat leaves into per-param
-            # (S, k, *param_shape) arrays, stage axis sharded over 'pipe'
+            # (S, k, *param_shape) arrays: stage axis sharded over
+            # 'pipe', param dims under the model's TP rules
             stacked = tuple(
-                constrain(
+                constrain_stacked(
                     jnp.stack([leaves[b * n_per + j] for b in range(L)])
-                    .reshape((S, k) + leaves[j].shape), "pipe")
+                    .reshape((S, k) + leaves[j].shape), tpl[j])
                 for j in range(n_per))
             x_micro = x_a.reshape((M, mb) + x_a.shape[1:])
             ex_micro = tuple(e.reshape((M, mb) + e.shape[1:])

@@ -393,6 +393,9 @@ class _StepExecutor:
         saved_data_axis = mesh_mod.current_data_axis()
         mesh_mod.set_data_axis(opt.data_axis if isinstance(opt, DistOpt)
                                else "data")
+        from .parallel import spmd as spmd_mod
+        saved_rules = spmd_mod.current_trace_rules()
+        spmd_mod.set_trace_rules(getattr(self, "_rules", None))
         saved_opt_state = None
         saved_param_data = {n: t.data for n, t in self.param_tensors.items()}
         saved_buffer_data = {n: t.data for n, t in self.buffer_tensors.items()}
@@ -445,6 +448,7 @@ class _StepExecutor:
             tensor_mod._rng_key = saved_key
             autograd.set_training(saved_training)
             mesh_mod.set_data_axis(saved_data_axis)
+            spmd_mod.set_trace_rules(saved_rules)
             for n, t in self.param_tensors.items():
                 t.data = saved_param_data[n]
             for n, t in self.buffer_tensors.items():
@@ -497,6 +501,7 @@ class _StepExecutor:
                     "multi-axis meshes GSPMD chooses the collectives and "
                     "these options are ignored", stacklevel=2)
             rules = spmd.collect_shard_rules(self.model)
+            self._rules = rules   # trace-scoped handoff (_traced_step)
             rep = mesh_mod.NamedSharding(mesh, P())
             p_arrays = {n: t.data for n, t in self.param_tensors.items()}
             b_arrays = {n: t.data for n, t in self.buffer_tensors.items()}

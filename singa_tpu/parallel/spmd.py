@@ -24,6 +24,7 @@ import jax
 from .mesh import Mesh, NamedSharding, P
 
 __all__ = ["spec_for", "param_shardings", "batch_spec", "tree_shardings",
+           "set_trace_rules", "current_trace_rules",
            "collect_shard_rules", "zero1_axis_for"]
 
 
@@ -49,6 +50,23 @@ def zero1_axis_for(optimizer, mesh: Optional[Mesh]) -> Optional[str]:
             "weight update", stacklevel=3)
         return None
     return axis
+
+
+# trace-scoped SHARD_RULES: the graph executor installs the model's
+# merged rules while tracing its step so axis-aware ops deep inside the
+# trace (layer.PipelineStack's stacked block weights) can derive the
+# same per-param specs the executor pinned on the unstacked params —
+# without a structural path from layer to model.
+_trace_rules: Optional[list] = None
+
+
+def set_trace_rules(rules) -> None:
+    global _trace_rules
+    _trace_rules = rules
+
+
+def current_trace_rules() -> Optional[list]:
+    return _trace_rules
 
 
 def collect_shard_rules(model) -> list:
