@@ -649,15 +649,17 @@ class MultiHeadAttention(Layer):
 
 
 class _MoEOp(autograd.Operator):
-    def __init__(self, cf, top_k=1):
+    def __init__(self, cf, top_k=1, swiglu=False):
         super().__init__()
         self.cf = cf
         self.top_k = top_k
+        self.swiglu = swiglu
 
-    def fwd(self, xa, rw, wi, wo):
+    def fwd(self, xa, rw, wi, wo, *wg):
         from .ops.moe import moe_forward
         out, aux = moe_forward(xa, rw, wi, wo, self.cf, return_aux=True,
-                               top_k=self.top_k)
+                               top_k=self.top_k,
+                               w_gate=wg[0] if self.swiglu else None)
         return out, aux
 
 
@@ -675,7 +677,7 @@ class MoE(Layer):
     training loss once per step."""
 
     SHARD_RULES = [
-        (r"\.(w_in|w_out)$", ("expert", None, None)),
+        (r"\.(w_in|w_out|w_gate)$", ("expert", None, None)),
     ]
     # the aux-loss accumulator is a side channel: a forward replayed
     # inside a jax.checkpoint region would leak its tracer (and drop
@@ -684,15 +686,18 @@ class MoE(Layer):
 
     def __init__(self, num_experts: int, ffn_dim: int,
                  capacity_factor: float = 1.25, top_k: int = 1,
-                 name=None):
+                 act: str = "relu", name=None):
         super().__init__(name)
         if not 1 <= top_k <= num_experts:
             raise ValueError(
                 f"top_k={top_k} outside [1, num_experts={num_experts}]")
+        if act not in ("relu", "swiglu"):
+            raise ValueError(f"MoE act must be relu or swiglu, got {act!r}")
         self.num_experts = num_experts
         self.ffn_dim = ffn_dim
         self.capacity_factor = capacity_factor
         self.top_k = top_k
+        self.act = act
         self._aux_losses: List[Tensor] = []
 
     def initialize(self, x: Tensor):
@@ -707,11 +712,17 @@ class MoE(Layer):
         self.w_out = self.register_param(
             "w_out", Tensor((e, h, d), dev, np.float32).gaussian(
                 0.0, (2.0 / (d + h)) ** 0.5))
+        if self.act == "swiglu":
+            self.w_gate = self.register_param(
+                "w_gate", Tensor((e, d, h), dev, np.float32).gaussian(
+                    0.0, (2.0 / (d + h)) ** 0.5))
 
     def forward(self, x: Tensor) -> Tensor:
         # router stays f32 master: moe_forward computes routing in f32
-        out, aux = _MoEOp(self.capacity_factor, self.top_k)(
-            x, self.router, self.w_in, self.w_out)
+        extra = (self.w_gate,) if self.act == "swiglu" else ()
+        out, aux = _MoEOp(self.capacity_factor, self.top_k,
+                          self.act == "swiglu")(
+            x, self.router, self.w_in, self.w_out, *extra)
         # accumulate only in training: eval/compile-time dry runs must
         # not leave stale entries (an init-trace tracer here would crash
         # the first real pop_aux_loss)
@@ -1149,6 +1160,10 @@ class PipelineStack(Layer):
                 if isinstance(l, Dropout) and l.p > 0:
                     return ("Dropout(p>0) inside blocks would draw "
                             "different keys than sequential execution")
+                if not getattr(type(l), "REMAT_SAFE", True):
+                    return (f"{type(l).__name__} layers carry a "
+                            "side-channel (e.g. MoE aux losses) the "
+                            "schedule's pure replay would drop")
         return None
 
 

@@ -76,6 +76,20 @@ class LlamaConfig:
     pipeline_stages: int = 0
     # microbatches per step when pipelining (default: = stages)
     pipeline_microbatches: int = 0
+    # Mixtral-style MoE: >0 replaces every block's SwiGLU FFN with a
+    # top-`moe_top_k` mixture of `num_experts` SwiGLU experts
+    # (layer.MoE, expert weights sharded over the 'expert' mesh axis).
+    # The Switch balance aux losses are summed into the training loss
+    # at weight `moe_aux_weight`.  Incompatible with pipeline_stages
+    # (the router's aux side channel cannot replay inside the
+    # schedule) — the stack falls back to sequential with a warning.
+    # `remat` is likewise inert for MoE blocks: layer.Remat skips
+    # layers whose subtree carries a side channel (REMAT_SAFE=False),
+    # so a remat+MoE config trains at no-remat activation memory.
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @staticmethod
     def llama3_8b() -> "LlamaConfig":
@@ -156,7 +170,12 @@ class _LlamaBlock(layer.Layer):
         self.attn_norm = layer.RMSNorm(cfg.dim, eps=cfg.eps)
         self.attn = _LlamaAttention(cfg)
         self.ffn_norm = layer.RMSNorm(cfg.dim, eps=cfg.eps)
-        self.ffn = _SwiGLU(cfg)
+        if cfg.num_experts:
+            self.ffn = layer.MoE(cfg.num_experts, ffn_dim=cfg.ffn_dim,
+                                 capacity_factor=cfg.moe_capacity_factor,
+                                 top_k=cfg.moe_top_k, act="swiglu")
+        else:
+            self.ffn = _SwiGLU(cfg)
 
     def forward(self, x, cache=None, pos=0):
         if cache is not None:
@@ -223,16 +242,33 @@ class Llama(GenerateMixin, model.Model):
             new_caches.append(nc)
         return self.lm_head(self.norm_f(x)), new_caches
 
+    def _moe_aux_loss(self) -> Optional[Tensor]:
+        """Summed router balance losses of every MoE block (None when
+        dense or nothing accumulated)."""
+        from ..layer import MoE, _walk_layers
+        total = None
+        for l in _walk_layers(self):
+            if isinstance(l, MoE):
+                a = l.pop_aux_loss()
+                if a is not None:
+                    total = a if total is None else total + a
+        return total
+
     def train_one_batch(self, ids: Tensor, labels: Optional[Tensor] = None):
         tgt = labels if labels is not None else ids
         if self.cfg.fused_loss:
             loss = next_token_loss_fused(self.features(ids), self.lm_head,
                                          tgt)
-            self.optimizer(loss)
-            return loss, loss
-        logits = self.forward(ids)
-        loss = next_token_loss(logits, tgt)
+        else:
+            logits = self.forward(ids)
+            loss = next_token_loss(logits, tgt)
+        if self.cfg.num_experts:
+            aux = self._moe_aux_loss()
+            if aux is not None:
+                loss = loss + autograd.mul(aux, self.cfg.moe_aux_weight)
         self.optimizer(loss)
+        if self.cfg.fused_loss:
+            return loss, loss
         return logits, loss
 
     def num_params(self) -> int:

@@ -74,12 +74,15 @@ def load_balance_loss(probs, onehot):
 
 
 def moe_forward(x, router_w, w_in, w_out, capacity_factor: float = 1.25,
-                return_aux: bool = False, top_k: int = 1):
+                return_aux: bool = False, top_k: int = 1, w_gate=None):
     """Top-k MoE FFN over flattened tokens (k=1 Switch, k=2 GShard).
 
     x: (..., D); router_w: (D, E); w_in: (E, D, H); w_out: (E, H, D).
-    Expert e computes relu(x @ w_in[e]) @ w_out[e].  Shard w_in/w_out's
-    leading axis over the 'expert' mesh axis (SHARD_RULES) for EP."""
+    Expert e computes relu(x @ w_in[e]) @ w_out[e] — or, with `w_gate`
+    (E, D, H) given, the SwiGLU form silu(x @ w_gate[e]) * (x @
+    w_in[e]) @ w_out[e] (Mixtral-style experts).  Shard the stacked
+    weights' leading axis over the 'expert' mesh axis (SHARD_RULES)
+    for EP."""
     orig_shape = x.shape
     D = orig_shape[-1]
     xf = x.reshape(-1, D)
@@ -93,7 +96,12 @@ def moe_forward(x, router_w, w_in, w_out, capacity_factor: float = 1.25,
     dispatch = (combine > 0).astype(xf.dtype)          # (N, E, C)
     # dispatch tokens into per-expert buffers: (E, C, D)
     buf = jnp.einsum("nec,nd->ecd", dispatch, xf)
-    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", buf, w_in.astype(xf.dtype)))
+    up = jnp.einsum("ecd,edh->ech", buf, w_in.astype(xf.dtype))
+    if w_gate is not None:
+        h = jax.nn.silu(jnp.einsum("ecd,edh->ech", buf,
+                                   w_gate.astype(xf.dtype))) * up
+    else:
+        h = jax.nn.relu(up)
     y = jnp.einsum("ech,ehd->ecd", h, w_out.astype(xf.dtype))
     # gate-weighted combine back to tokens
     out = jnp.einsum("nec,ecd->nd", combine.astype(xf.dtype), y)

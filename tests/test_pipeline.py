@@ -392,7 +392,6 @@ def test_stacked_block_weights_tp_shard_inside_pipeline():
     from singa_tpu.parallel import spmd
 
     def build(rules_on):
-        jax.config.update("jax_default_matmul_precision", "highest")
         tensor.set_seed(0)
         np.random.seed(0)
         cfg = models.LlamaConfig.tiny()
