@@ -8,7 +8,8 @@ state-dict conversion, no serialization round-trip).
     m = models.from_hf(hf)            # singa_tpu model, same logits
 
 Supported: GPT2LMHeadModel -> models.GPT2, LlamaForCausalLM ->
-models.Llama, BertForSequenceClassification -> models.BERT.
+models.Llama, MixtralForCausalLM -> models.Llama(num_experts=E),
+BertForSequenceClassification -> models.BERT.
 Conversions are pure layout mapping (HF Linear stores
 (out, in) -> ours (in, out); GPT-2's Conv1D already stores (in, out);
 HF's fused c_attn splits into q/k/v).  RoPE needs no permutation: both
@@ -25,7 +26,7 @@ from .. import tensor as tensor_mod
 from ..tensor import Tensor
 
 __all__ = ["from_hf", "from_hf_gpt2", "from_hf_llama", "from_hf_bert",
-           "to_hf"]
+           "from_hf_mixtral", "to_hf"]
 
 
 def _np(t) -> np.ndarray:
@@ -168,6 +169,77 @@ def from_hf_llama(hf_model, pipeline_stages: int = 0):
     return m
 
 
+def from_hf_mixtral(hf_model, **kw):
+    """transformers.MixtralForCausalLM -> models.Llama(num_experts=E)
+    (SwiGLU experts stacked; HF w1=gate, w3=up, w2=down).
+
+    Routing semantics match exactly (full-softmax probs, top-k,
+    renormalize); the converted model's capacity factor is set to E/k
+    so NO token is ever dropped — HF's dense gather has no capacity
+    concept.  Lower moe_capacity_factor afterwards for capacity-bound
+    EP training."""
+    from . import llama as lm
+
+    if kw:
+        raise NotImplementedError(
+            f"from_hf_mixtral takes no options (got {sorted(kw)}); "
+            "pipeline_stages is incompatible with MoE blocks")
+    hc = hf_model.config
+    sw = getattr(hc, "sliding_window", None)
+    if sw is not None and sw < hc.max_position_embeddings:
+        raise NotImplementedError(
+            f"sliding_window={sw} < max_position="
+            f"{hc.max_position_embeddings}: models.Llama attends the "
+            "full causal context, so windowed checkpoints would "
+            "silently diverge past the window")
+    E = hc.num_local_experts
+    k = hc.num_experts_per_tok
+    cfg = lm.LlamaConfig(
+        vocab_size=hc.vocab_size, dim=hc.hidden_size,
+        num_layers=hc.num_hidden_layers,
+        num_heads=hc.num_attention_heads,
+        num_kv_heads=hc.num_key_value_heads,
+        ffn_dim=hc.intermediate_size,
+        max_position=hc.max_position_embeddings,
+        rope_theta=float(hc.rope_theta),
+        eps=float(hc.rms_norm_eps),
+        num_experts=E, moe_top_k=k,
+        moe_capacity_factor=float(E) / k,
+        moe_aux_weight=float(getattr(hc, "router_aux_loss_coef", 0.01)))
+    m = _init(lm.Llama(cfg))
+    params = m.get_params()
+    sd = hf_model.state_dict()
+
+    _set(params, "tok_emb.table", _np(sd["model.embed_tokens.weight"]))
+    _set(params, "norm_f.gamma", _np(sd["model.norm.weight"]))
+    head = sd.get("lm_head.weight",
+                  sd["model.embed_tokens.weight"])   # tied fallback
+    _set(params, "lm_head.W", _np(head).T)
+    for i in range(hc.num_hidden_layers):
+        hfp = f"model.layers.{i}."
+        our = f"blocks.{i}."
+        _set(params, f"{our}attn_norm.gamma",
+             _np(sd[f"{hfp}input_layernorm.weight"]))
+        _set(params, f"{our}ffn_norm.gamma",
+             _np(sd[f"{hfp}post_attention_layernorm.weight"]))
+        for theirs, ours in (("self_attn.q_proj", "attn.q_proj"),
+                             ("self_attn.k_proj", "attn.k_proj"),
+                             ("self_attn.v_proj", "attn.v_proj"),
+                             ("self_attn.o_proj", "attn.o_proj")):
+            _set(params, f"{our}{ours}.W",
+                 _np(sd[f"{hfp}{theirs}.weight"]).T)
+        moe = f"{hfp}block_sparse_moe."
+        _set(params, f"{our}ffn.router",
+             _np(sd[moe + "gate.weight"]).T)
+        _set(params, f"{our}ffn.w_gate", np.stack(
+            [_np(sd[f"{moe}experts.{e}.w1.weight"]).T for e in range(E)]))
+        _set(params, f"{our}ffn.w_in", np.stack(
+            [_np(sd[f"{moe}experts.{e}.w3.weight"]).T for e in range(E)]))
+        _set(params, f"{our}ffn.w_out", np.stack(
+            [_np(sd[f"{moe}experts.{e}.w2.weight"]).T for e in range(E)]))
+    return m
+
+
 def from_hf_bert(hf_model, **kw):
     """transformers.BertForSequenceClassification -> models.BERT
     (exact-erf GELU on both sides)."""
@@ -242,11 +314,14 @@ def from_hf(hf_model, **kw):
         return from_hf_gpt2(hf_model, **kw)
     if name == "LlamaForCausalLM":
         return from_hf_llama(hf_model, **kw)
+    if name == "MixtralForCausalLM":
+        return from_hf_mixtral(hf_model, **kw)
     if name == "BertForSequenceClassification":
         return from_hf_bert(hf_model, **kw)
     raise NotImplementedError(
         f"no converter for {name}; supported: GPT2LMHeadModel, "
-        "LlamaForCausalLM, BertForSequenceClassification")
+        "LlamaForCausalLM, MixtralForCausalLM, "
+        "BertForSequenceClassification")
 
 
 # ---------------------------------------------------------------------------
@@ -321,6 +396,10 @@ def to_hf(model):
 
     if isinstance(model, lm.Llama):
         c = model.cfg
+        if c.num_experts:
+            raise NotImplementedError(
+                "to_hf does not yet export MoE (Mixtral-config) Llama "
+                "models — only dense ones")
         rs = None
         if c.rope_scaling:
             rs = {"rope_type": "llama3", "factor": float(c.rope_scaling),

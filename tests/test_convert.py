@@ -241,3 +241,44 @@ class TestToHF:
     def test_to_hf_unsupported_raises(self):
         with pytest.raises(NotImplementedError, match="to_hf supports"):
             models.to_hf(models.MLP())
+
+
+def test_mixtral_conversion_matches():
+    """MixtralForCausalLM -> models.Llama(num_experts): stacked SwiGLU
+    experts (w1=gate/w3=up/w2=down), identical routing semantics, and a
+    drop-free capacity factor — logits match transformers."""
+    torch.manual_seed(0)
+    cfg = transformers.MixtralConfig(
+        vocab_size=101, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, num_local_experts=4,
+        num_experts_per_tok=2, max_position_embeddings=64,
+        rope_theta=10000.0, rms_norm_eps=1e-5,
+        attn_implementation="eager", use_cache=False)
+    hf = transformers.MixtralForCausalLM(cfg).eval()
+    m = models.from_hf(hf)
+    m.eval()
+    assert m.cfg.num_experts == 4 and m.cfg.moe_top_k == 2
+    assert m.cfg.moe_capacity_factor == 2.0       # E/k: no drops
+    ids = _ids(vocab=101)
+    ref = _hf_logits(hf, ids)
+    out = m(tensor.from_numpy(ids)).to_numpy().reshape(ref.shape)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_mixtral_conversion_finetunes():
+    torch.manual_seed(1)
+    np.random.seed(1)
+    cfg = transformers.MixtralConfig(
+        vocab_size=101, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=4,
+        num_key_value_heads=2, num_local_experts=4,
+        num_experts_per_tok=2, max_position_embeddings=64,
+        attn_implementation="eager", use_cache=False)
+    m = models.from_hf(transformers.MixtralForCausalLM(cfg).eval())
+    m.cfg.fused_loss = False
+    m.set_optimizer(opt.AdamW(lr=1e-3))
+    ids = tensor.from_numpy(_ids(vocab=101, shape=(4, 16)))
+    m.compile([ids], is_train=True, use_graph=True)
+    losses = [float(m.train_step(ids)[1].to_numpy()) for _ in range(8)]
+    assert losses[-1] < losses[0] * 0.95, losses
