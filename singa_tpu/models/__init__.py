@@ -32,7 +32,8 @@ from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 from .transformer import GPT2, BERT, GPT2Config, BERTConfig
 from .llama import Llama, LlamaConfig
 from .convert import (from_hf, from_hf_bert, from_hf_gpt2,
-                      from_hf_llama, from_hf_mixtral, to_hf)
+                      from_hf_llama, from_hf_mistral,
+                      from_hf_mixtral, to_hf)
 
 __all__ = [
     "mlp", "cnn", "resnet", "vgg", "transformer", "llama",
@@ -42,6 +43,6 @@ __all__ = [
     "GPT2", "BERT", "GPT2Config", "BERTConfig",
     "Llama", "LlamaConfig",
     "from_hf", "from_hf_bert", "from_hf_gpt2", "from_hf_llama",
-    "from_hf_mixtral",
+    "from_hf_mistral", "from_hf_mixtral",
     "to_hf",
 ]

@@ -26,7 +26,7 @@ from .. import tensor as tensor_mod
 from ..tensor import Tensor
 
 __all__ = ["from_hf", "from_hf_gpt2", "from_hf_llama", "from_hf_bert",
-           "from_hf_mixtral", "to_hf"]
+           "from_hf_mistral", "from_hf_mixtral", "to_hf"]
 
 
 def _np(t) -> np.ndarray:
@@ -144,12 +144,18 @@ def from_hf_llama(hf_model, pipeline_stages: int = 0):
     params = m.get_params()
     sd = hf_model.state_dict()
 
+    _copy_llama_dense_weights(params, sd, hc.num_hidden_layers)
+    return m
+
+
+def _copy_llama_dense_weights(params, sd, num_layers: int) -> None:
+    """Shared Llama/Mistral state-dict copy (identical layouts)."""
     _set(params, "tok_emb.table", _np(sd["model.embed_tokens.weight"]))
     _set(params, "norm_f.gamma", _np(sd["model.norm.weight"]))
     head = sd.get("lm_head.weight",
                   sd["model.embed_tokens.weight"])   # tied fallback
     _set(params, "lm_head.W", _np(head).T)
-    for i in range(hc.num_hidden_layers):
+    for i in range(num_layers):
         hfp = f"model.layers.{i}."
         our = f"blocks.{i}."
         _set(params, f"{our}attn_norm.gamma",
@@ -166,6 +172,33 @@ def from_hf_llama(hf_model, pipeline_stages: int = 0):
                              ("mlp.down_proj", "ffn.down")):
             _set(params, f"{our}{ours}.W",
                  _np(sd[f"{hfp}{theirs}.weight"]).T)
+
+
+def from_hf_mistral(hf_model, pipeline_stages: int = 0):
+    """transformers.MistralForCausalLM -> models.Llama(sliding_window=W)
+    — the state-dict layout is Llama's; the architectural delta is the
+    sliding-window attention, mapped onto LlamaConfig.sliding_window."""
+    from . import llama as lm
+
+    hc = hf_model.config
+    hd = getattr(hc, "head_dim", None)
+    if hd and hd != hc.hidden_size // hc.num_attention_heads:
+        raise NotImplementedError(
+            f"custom head_dim={hd} != hidden/heads is not supported")
+    cfg = lm.LlamaConfig(
+        vocab_size=hc.vocab_size, dim=hc.hidden_size,
+        num_layers=hc.num_hidden_layers,
+        num_heads=hc.num_attention_heads,
+        num_kv_heads=hc.num_key_value_heads,
+        ffn_dim=hc.intermediate_size,
+        max_position=hc.max_position_embeddings,
+        rope_theta=float(getattr(hc, "rope_theta", 10000.0)),
+        sliding_window=int(hc.sliding_window or 0),
+        eps=float(hc.rms_norm_eps),
+        pipeline_stages=pipeline_stages)
+    m = _init(lm.Llama(cfg))
+    _copy_llama_dense_weights(m.get_params(), hf_model.state_dict(),
+                              hc.num_hidden_layers)
     return m
 
 
@@ -185,13 +218,6 @@ def from_hf_mixtral(hf_model, **kw):
             f"from_hf_mixtral takes no options (got {sorted(kw)}); "
             "pipeline_stages is incompatible with MoE blocks")
     hc = hf_model.config
-    sw = getattr(hc, "sliding_window", None)
-    if sw is not None and sw < hc.max_position_embeddings:
-        raise NotImplementedError(
-            f"sliding_window={sw} < max_position="
-            f"{hc.max_position_embeddings}: models.Llama attends the "
-            "full causal context, so windowed checkpoints would "
-            "silently diverge past the window")
     E = hc.num_local_experts
     k = hc.num_experts_per_tok
     cfg = lm.LlamaConfig(
@@ -204,6 +230,7 @@ def from_hf_mixtral(hf_model, **kw):
         rope_theta=float(hc.rope_theta),
         eps=float(hc.rms_norm_eps),
         num_experts=E, moe_top_k=k,
+        sliding_window=int(getattr(hc, "sliding_window", None) or 0),
         moe_capacity_factor=float(E) / k,
         moe_aux_weight=float(getattr(hc, "router_aux_loss_coef", 0.01)))
     m = _init(lm.Llama(cfg))
@@ -314,13 +341,15 @@ def from_hf(hf_model, **kw):
         return from_hf_gpt2(hf_model, **kw)
     if name == "LlamaForCausalLM":
         return from_hf_llama(hf_model, **kw)
+    if name == "MistralForCausalLM":
+        return from_hf_mistral(hf_model, **kw)
     if name == "MixtralForCausalLM":
         return from_hf_mixtral(hf_model, **kw)
     if name == "BertForSequenceClassification":
         return from_hf_bert(hf_model, **kw)
     raise NotImplementedError(
         f"no converter for {name}; supported: GPT2LMHeadModel, "
-        "LlamaForCausalLM, MixtralForCausalLM, "
+        "LlamaForCausalLM, MistralForCausalLM, MixtralForCausalLM, "
         "BertForSequenceClassification")
 
 

@@ -57,6 +57,11 @@ class LlamaConfig:
     # enforce it loudly.
     rope_scaling: float = 0.0
     rope_scaling_original_max_position: int = 8192
+    # Mistral-style sliding-window attention: query t attends keys in
+    # (t - window, t].  0 = full causal context.  Windowed attention
+    # runs on the XLA fused path (banded mask), not the flash kernel,
+    # and is incompatible with the 'seq' (ring attention) axis.
+    sliding_window: int = 0
     eps: float = 1e-5
     # opt-in chunked fused lm-head+CE loss (never materializes the
     # (B*T, V) logits; autograd.FusedLinearCrossEntropy).  NOTE: with it
@@ -126,6 +131,36 @@ class _LlamaAttention(layer.Layer):
             c.head_dim, c.max_position, c.rope_theta, c.rope_scaling,
             c.rope_scaling_original_max_position)
 
+    def _banded(self, q, k, v, device):
+        """Sliding-window attention: causal AND within the last
+        `sliding_window` keys (banded mask on the XLA fused path)."""
+        import warnings
+
+        import jax.numpy as jnp
+
+        from ..ops.attention import attention as fused_attention
+        from ..parallel import mesh as mesh_mod
+        m_ = mesh_mod.current_mesh()
+        if m_ is not None and m_.shape.get("seq", 1) > 1:
+            raise NotImplementedError(
+                "sliding_window attention does not compose with the "
+                "'seq' (ring attention) mesh axis — drop the seq axis "
+                "or use full causal attention")
+        W = self.cfg.sliding_window
+        Tq, Tk = q.shape[1], k.shape[1]
+        if Tq >= 2048:
+            warnings.warn(
+                f"sliding-window attention at T={Tq} runs on the XLA "
+                "masked path and materializes (B, H, T, T) logits — "
+                "quadratic HBM; a banded flash kernel is not yet "
+                "implemented", stacklevel=3)
+        qpos = jnp.arange(Tq)[:, None]
+        kpos = jnp.arange(Tk)[None, :]
+        band = (kpos <= qpos) & (kpos > qpos - W)
+        m = Tensor(data=band[None, None], device=device,
+                   requires_grad=False)
+        return fused_attention(q, k, v, causal=False, mask=m)
+
     def forward(self, x: Tensor, cache=None, pos=0):
         c = self.cfg
         B, T, _ = x.shape
@@ -135,21 +170,28 @@ class _LlamaAttention(layer.Layer):
         v = self.v_proj(x).reshape((B, T, c.num_kv_heads, c.head_dim))
         q = rope_ops.apply_rope(q, cos, sin, offset=pos)
         k = rope_ops.apply_rope(k, cos, sin, offset=pos)
+        windowed = bool(c.sliding_window) and c.sliding_window < T
         if cache is not None:
             ck, cv = kv_ops.update_cache(cache[0], cache[1],
                                          k.data, v.data, pos)
             if isinstance(pos, int) and pos == 0:
                 # prefill: attend within the prompt through the regular
                 # stack (flash kernel when the shape tiles)
-                o = ring_attention(q, k, v, causal=True)
+                o = self._banded(q, k, v, x.device) if windowed \
+                    else ring_attention(q, k, v, causal=True)
             else:
-                o_arr = kv_ops.cached_sdpa(q.data, ck, cv, limit=pos + T)
+                o_arr = kv_ops.cached_sdpa(
+                    q.data, ck, cv, limit=pos + T,
+                    window=c.sliding_window or None)
                 o = Tensor(data=o_arr, device=x.device, requires_grad=False)
             out = self.o_proj(o.reshape((B, T, c.num_heads * c.head_dim)))
             return out, (ck, cv)
-        # ring attention when a 'seq' mesh axis is installed (cross-chip
-        # context parallelism); fused SDPA otherwise
-        o = ring_attention(q, k, v, causal=True)
+        if windowed:
+            o = self._banded(q, k, v, x.device)
+        else:
+            # ring attention when a 'seq' mesh axis is installed
+            # (cross-chip context parallelism); fused SDPA otherwise
+            o = ring_attention(q, k, v, causal=True)
         return self.o_proj(o.reshape((B, T, c.num_heads * c.head_dim)))
 
 

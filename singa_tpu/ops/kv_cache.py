@@ -41,14 +41,17 @@ def update_cache(ck, cv, k_new, v_new, pos):
     return ck, cv
 
 
-def cached_sdpa(q, ck, cv, limit, scale: float = None, mask=None):
+def cached_sdpa(q, ck, cv, limit, scale: float = None, mask=None,
+                window: int = None):
     """Attention of q (B, T, H, D) against the full cache (B, S, K, D),
     masked to cache positions < `limit` plus bottom-right-aligned
     causality inside the query block (query t attends cache positions
     <= limit - T + t).  GQA (H % K == 0) and the grouped einsums are
     delegated to attention._sdpa_reference — one attention math, two
     entry points.  `mask`: optional (B, 1|H, 1|T, S) boolean padding
-    mask ANDed with the validity window."""
+    mask ANDed with the validity window.  `window`: Mistral-style
+    sliding window — each query also ignores cache positions more than
+    `window - 1` behind it."""
     from .attention import _sdpa_reference
     T = q.shape[1]
     S = ck.shape[1]
@@ -56,6 +59,8 @@ def cached_sdpa(q, ck, cv, limit, scale: float = None, mask=None):
     kpos = jnp.arange(S)[None, :]                       # (1, S)
     qpos = limit - T + jnp.arange(T)[:, None]           # (T, 1)
     valid = (kpos <= qpos)[None, None]                  # (1, 1, T, S)
+    if window is not None:
+        valid = jnp.logical_and(valid, (kpos > qpos - window)[None, None])
     if mask is not None:
         valid = jnp.logical_and(valid, mask)
     return _sdpa_reference(q, ck, cv, False, valid, scale)

@@ -282,3 +282,74 @@ def test_mixtral_conversion_finetunes():
     m.compile([ids], is_train=True, use_graph=True)
     losses = [float(m.train_step(ids)[1].to_numpy()) for _ in range(8)]
     assert losses[-1] < losses[0] * 0.95, losses
+
+
+class TestMistral:
+    """MistralForCausalLM -> models.Llama(sliding_window=W): banded
+    attention matches transformers with the window ACTIVE (T > W), and
+    the windowed KV-cache decode equals the uncached greedy path."""
+
+    def _hf(self, window=6):
+        torch.manual_seed(0)
+        cfg = transformers.MistralConfig(
+            vocab_size=101, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            rope_theta=10000.0, rms_norm_eps=1e-5,
+            sliding_window=window, attn_implementation="eager",
+            use_cache=False)
+        return transformers.MistralForCausalLM(cfg).eval()
+
+    def test_conversion_matches_with_active_window(self):
+        hf = self._hf(window=6)
+        m = models.from_hf(hf)
+        m.eval()
+        assert m.cfg.sliding_window == 6
+        ids = _ids(vocab=101, shape=(2, 24))      # T=24 >> window
+        ref = _hf_logits(hf, ids)
+        out = m(tensor.from_numpy(ids)).to_numpy().reshape(ref.shape)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_window_ge_seq_equals_full_causal(self):
+        tensor.set_seed(0)
+        np.random.seed(0)
+        ids = _ids(vocab=101, shape=(2, 12))
+        cfg = models.LlamaConfig(vocab_size=101, dim=32, num_layers=2,
+                                 num_heads=4, num_kv_heads=2, ffn_dim=64,
+                                 max_position=64, rope_theta=10000.0)
+        tensor.set_seed(3)
+        full = models.Llama(cfg)
+        full.compile([tensor.from_numpy(ids)], is_train=False,
+                     use_graph=False)
+        full.eval()
+        ref = full(tensor.from_numpy(ids)).to_numpy()
+        import dataclasses
+        wcfg = dataclasses.replace(cfg, sliding_window=12)
+        tensor.set_seed(3)
+        win = models.Llama(wcfg)
+        win.compile([tensor.from_numpy(ids)], is_train=False,
+                    use_graph=False)
+        win.eval()
+        out = win(tensor.from_numpy(ids)).to_numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_windowed_cached_decode_equals_uncached(self):
+        m = models.from_hf(self._hf(window=6))
+        m.eval()
+        ids = _ids(vocab=101, shape=(1, 10))
+        gen = m.generate(ids, max_new_tokens=6)
+        for t in range(6):
+            ctx = gen[:, :10 + t].astype(np.int32)
+            logits = m(tensor.from_numpy(ctx)).to_numpy().reshape(
+                1, 10 + t, -1)
+            assert logits[:, -1].argmax(-1)[0] == gen[0, 10 + t], t
+
+    def test_windowed_model_trains(self):
+        np.random.seed(0)
+        m = models.from_hf(self._hf(window=6))
+        m.set_optimizer(opt.AdamW(lr=1e-3))
+        ids = tensor.from_numpy(_ids(vocab=101, shape=(4, 24)))
+        m.compile([ids], is_train=True, use_graph=True)
+        losses = [float(m.train_step(ids)[1].to_numpy())
+                  for _ in range(6)]
+        assert losses[-1] < losses[0] * 0.95, losses
