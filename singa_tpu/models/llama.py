@@ -58,9 +58,9 @@ class LlamaConfig:
     rope_scaling: float = 0.0
     rope_scaling_original_max_position: int = 8192
     # Mistral-style sliding-window attention: query t attends keys in
-    # (t - window, t].  0 = full causal context.  Windowed attention
-    # runs on the XLA fused path (banded mask), not the flash kernel,
-    # and is incompatible with the 'seq' (ring attention) axis.
+    # (t - window, t].  0 = full causal context.  Long sequences run
+    # the chunked banded path (ops.attention.banded_attention — O(T*W)
+    # memory); incompatible with the 'seq' (ring attention) axis.
     sliding_window: int = 0
     eps: float = 1e-5
     # opt-in chunked fused lm-head+CE loss (never materializes the
@@ -133,12 +133,12 @@ class _LlamaAttention(layer.Layer):
 
     def _banded(self, q, k, v, device):
         """Sliding-window attention: causal AND within the last
-        `sliding_window` keys (banded mask on the XLA fused path)."""
-        import warnings
-
+        `sliding_window` keys.  Long sequences run the chunked banded
+        path (O(T*W) memory); short ones the plain masked SDPA."""
         import jax.numpy as jnp
 
         from ..ops.attention import attention as fused_attention
+        from ..ops.attention import banded_attention
         from ..parallel import mesh as mesh_mod
         m_ = mesh_mod.current_mesh()
         if m_ is not None and m_.shape.get("seq", 1) > 1:
@@ -148,12 +148,12 @@ class _LlamaAttention(layer.Layer):
                 "or use full causal attention")
         W = self.cfg.sliding_window
         Tq, Tk = q.shape[1], k.shape[1]
-        if Tq >= 2048:
-            warnings.warn(
-                f"sliding-window attention at T={Tq} runs on the XLA "
-                "masked path and materializes (B, H, T, T) logits — "
-                "quadratic HBM; a banded flash kernel is not yet "
-                "implemented", stacklevel=3)
+        if Tq > 512 and Tq == Tk:
+            from ..ops.attention import pick_band_chunk
+            C = pick_band_chunk(Tq, W)
+            if C is not None:       # degenerate divisors (prime T):
+                return banded_attention(q, k, v, W, chunk=C)
+            # else fall through to the masked path
         qpos = jnp.arange(Tq)[:, None]
         kpos = jnp.arange(Tk)[None, :]
         band = (kpos <= qpos) & (kpos > qpos - W)

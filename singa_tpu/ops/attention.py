@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from .. import autograd
 from ..tensor import Tensor
 
-__all__ = ["attention", "sdpa"]
+__all__ = ["attention", "sdpa", "banded_attention", "banded_sdpa"]
 
 # sequences at least this long route to the flash kernel on TPU
 _FLASH_MIN_LEN = 512
@@ -104,3 +104,103 @@ def sdpa(q, k, v, causal=False, mask=None, scale=None):
         from .flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal, scale=scale)
     return _sdpa_reference(q, k, v, causal, mask, scale)
+
+
+# ---------------------------------------------------------------------------
+# chunked banded (sliding-window) attention — O(T * W) memory
+# ---------------------------------------------------------------------------
+
+def _banded_reference(q, k, v, window: int, scale: float):
+    """Oracle: full (T, T) band mask through _sdpa_reference."""
+    T = q.shape[1]
+    qpos = jnp.arange(T)[:, None]
+    kpos = jnp.arange(T)[None, :]
+    band = (kpos <= qpos) & (kpos > qpos - window)
+    return _sdpa_reference(q, k, v, False, band[None, None], scale)
+
+
+def pick_band_chunk(T: int, window: int) -> Optional[int]:
+    """Largest divisor of T up to ~the window (capped at 512) — the
+    chunk size that keeps (C, C+W) score tiles small.  None when only a
+    degenerate chunk (< 8) divides T: the k/v duplication of tiny
+    chunks would cost more than the full masked path."""
+    cap = max(16, min(window, 512))
+    c = next(c for c in range(min(cap, T), 0, -1) if T % c == 0)
+    return c if c >= 8 else None
+
+
+def banded_sdpa(q, k, v, window: int, scale: Optional[float] = None,
+                chunk: Optional[int] = None):
+    """Sliding-window attention (query t attends keys in (t-W, t])
+    computed in query chunks so only (chunk, chunk+W) score tiles ever
+    materialize — O(T*W) memory instead of the O(T^2) masked path, on
+    any backend, in pure jnp (so jax.vjp differentiates it).
+
+    The relative band is identical for every interior chunk: chunk i's
+    queries [iC, iC+C) need keys [iC-W+1, iC+C), a width-(C+W-1) slice
+    of k/v left-padded by W so edge chunks clamp cleanly; padded keys
+    fall outside the band mask.  vmap over chunks keeps everything one
+    fused program."""
+    T = q.shape[1]
+    scale = scale or (1.0 / math.sqrt(q.shape[-1]))
+    W = int(window)
+    if chunk is None:
+        chunk = pick_band_chunk(T, W)
+        if chunk is None:
+            raise ValueError(
+                f"no usable chunk divides T={T} (all divisors < 8); "
+                "use the masked path instead")
+    C = int(chunk)
+    if T % C:
+        raise ValueError(f"seq len {T} must divide by chunk {C}")
+    n = T // C
+    span = C + W                                    # keys per chunk
+    # left-pad keys/values by W (zeros; masked out below)
+    kp = jnp.pad(k, ((0, 0), (W, 0), (0, 0), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (W, 0), (0, 0), (0, 0)))
+    qc = q.reshape(q.shape[0], n, C, *q.shape[2:])  # (B, n, C, H, D)
+    starts = jnp.arange(n) * C                      # chunk i keys start
+    kc = jax.vmap(lambda s: jax.lax.dynamic_slice_in_dim(kp, s, span, 1),
+                  out_axes=1)(starts)               # (B, n, span, K, D)
+    vc = jax.vmap(lambda s: jax.lax.dynamic_slice_in_dim(vp, s, span, 1),
+                  out_axes=1)(starts)
+    # relative positions are chunk-invariant: query c (0..C-1) sits at
+    # absolute offset c; key j (0..span-1) at absolute offset j - W.
+    # band: 0 <= (c + W - j) < W  i.e.  c < j <= c + W ... in padded
+    # coords: key abs = j - W, query abs = c; causal j - W <= c and
+    # within-window j - W > c - W  =>  c < j <= c + W
+    cpos = jnp.arange(C)[:, None]
+    jpos = jnp.arange(span)[None, :]
+    band = (jpos <= cpos + W) & (jpos > cpos)       # (C, span)
+    # first chunk's left-pad keys are already outside the band only
+    # when j > c holds... padded keys have j < W and represent
+    # negative absolute positions; for chunk 0 they must be masked:
+    # absolute key pos = starts[i] + j - W >= 0  =>  j >= W - starts[i]
+    valid0 = jpos[None] >= (W - starts)[:, None, None]  # (n, 1, span)
+    mask = band[None] & valid0                      # (n, C, span)
+
+    def one_chunk(qi, ki, vi, mi):
+        return _sdpa_reference(qi, ki, vi, False, mi[None, None], scale)
+
+    out = jax.vmap(one_chunk, in_axes=(1, 1, 1, 0), out_axes=1)(
+        qc, kc, vc, mask)                           # (B, n, C, H, D)
+    return out.reshape(q.shape)
+
+
+class BandedSDPA(autograd.Operator):
+    def __init__(self, window: int, scale: Optional[float],
+                 chunk: Optional[int]):
+        super().__init__()
+        self.window = window
+        self.scale = scale
+        self.chunk = chunk
+
+    def fwd(self, q, k, v):
+        return banded_sdpa(q, k, v, self.window, self.scale, self.chunk)
+
+
+def banded_attention(q: Tensor, k: Tensor, v: Tensor, window: int,
+                     scale: Optional[float] = None,
+                     chunk: Optional[int] = None) -> Tensor:
+    """Tape entry point for chunked sliding-window attention."""
+    return BandedSDPA(window, scale, chunk)(q, k, v)

@@ -353,3 +353,22 @@ class TestMistral:
         losses = [float(m.train_step(ids)[1].to_numpy())
                   for _ in range(6)]
         assert losses[-1] < losses[0] * 0.95, losses
+
+
+def test_windowed_long_seq_uses_chunked_path_and_matches():
+    """T=1024 > 512 routes to the chunked banded path (O(T*W) memory);
+    logits must still match transformers exactly."""
+    torch.manual_seed(0)
+    cfg = transformers.MistralConfig(
+        vocab_size=101, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=2048,
+        rope_theta=10000.0, rms_norm_eps=1e-5, sliding_window=64,
+        attn_implementation="eager", use_cache=False)
+    hf = transformers.MistralForCausalLM(cfg).eval()
+    m = models.from_hf(hf)
+    m.eval()
+    ids = _ids(vocab=101, shape=(1, 1024))
+    ref = _hf_logits(hf, ids)
+    out = m(tensor.from_numpy(ids)).to_numpy().reshape(ref.shape)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
