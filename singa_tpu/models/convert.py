@@ -435,15 +435,28 @@ def to_hf(model):
                   "original_max_position_embeddings":
                       int(c.rope_scaling_original_max_position),
                   "low_freq_factor": 1.0, "high_freq_factor": 4.0}
-        hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+        common = dict(
             vocab_size=c.vocab_size, hidden_size=c.dim,
             intermediate_size=c.ffn_dim, num_hidden_layers=c.num_layers,
             num_attention_heads=c.num_heads,
             num_key_value_heads=c.num_kv_heads,
             max_position_embeddings=c.max_position,
             rope_theta=c.rope_theta, rms_norm_eps=c.eps,
-            rope_scaling=rs, attention_bias=False, mlp_bias=False,
-            tie_word_embeddings=False))
+            tie_word_embeddings=False)
+        if c.sliding_window:
+            # the window is load-bearing: exporting as a plain Llama
+            # would silently attend the full context in HF
+            if rs:
+                raise NotImplementedError(
+                    "sliding_window + rope_scaling has no matching HF "
+                    "architecture to export to")
+            hf = transformers.MistralForCausalLM(
+                transformers.MistralConfig(
+                    sliding_window=c.sliding_window, **common))
+        else:
+            hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+                rope_scaling=rs, attention_bias=False, mlp_bias=False,
+                **common))
         sd = {}
         sd["model.embed_tokens.weight"] = _t(_np_of(params,
                                                     "tok_emb.table"))

@@ -372,3 +372,30 @@ def test_windowed_long_seq_uses_chunked_path_and_matches():
     ref = _hf_logits(hf, ids)
     out = m(tensor.from_numpy(ids)).to_numpy().reshape(ref.shape)
     np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_to_hf_windowed_exports_mistral():
+    """A sliding_window model must export as MistralForCausalLM (the
+    window is load-bearing; a plain Llama export would silently attend
+    the full context) — full circle from_hf(to_hf(m)) == m."""
+    torch.manual_seed(0)
+    tensor.set_seed(0)
+    ids = _ids(vocab=101, shape=(2, 24))
+    cfg = models.LlamaConfig(vocab_size=101, dim=32, num_layers=1,
+                             num_heads=4, num_kv_heads=2, ffn_dim=64,
+                             max_position=64, rope_theta=10000.0,
+                             sliding_window=6)
+    m = models.Llama(cfg)
+    m.compile([tensor.from_numpy(ids)], is_train=False, use_graph=False)
+    m.eval()
+    ours = m(tensor.from_numpy(ids)).to_numpy().reshape(2, 24, 101)
+    hf = models.to_hf(m)
+    assert type(hf).__name__ == "MistralForCausalLM"
+    assert hf.config.sliding_window == 6
+    ref = hf(input_ids=torch.tensor(ids.astype(np.int64)),
+             use_cache=False).logits.detach().numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+    back = models.from_hf(hf)
+    back.eval()
+    o2 = back(tensor.from_numpy(ids)).to_numpy().reshape(2, 24, 101)
+    np.testing.assert_allclose(o2, ours, rtol=1e-4, atol=1e-5)
