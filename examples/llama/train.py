@@ -49,6 +49,13 @@ def main():
                         "means the relative-step schedule")
     p.add_argument("--force-host-devices", type=int, default=0,
                    help="virtual CPU devices for meshes without hardware")
+    p.add_argument("--window", type=int, default=0,
+                   help="sliding-window attention (Mistral-style; "
+                        "chunked O(T*W) path for long sequences)")
+    p.add_argument("--experts", type=int, default=0,
+                   help="Mixtral-style MoE: SwiGLU experts per block "
+                        "(use with --ep ways via the 'expert' axis)")
+    p.add_argument("--ep", type=int, default=1, help="expert-parallel ways")
     p.add_argument("--opt", default="adamw",
                    choices=["adamw", "adafactor", "sgd"],
                    help="adafactor = factored second moment (r+c floats "
@@ -89,10 +96,27 @@ def main():
     if args.pp > 1:
         cfg.pipeline_stages = args.pp
         cfg.pipeline_microbatches = args.micro
+    if args.window:
+        if args.window < 1:
+            p.error(f"--window must be positive, got {args.window}")
+        if args.sp > 1:
+            p.error("--window does not compose with --sp (ring attention)")
+        cfg.sliding_window = args.window
+    if args.experts:
+        cfg.num_experts = args.experts
+        cfg.moe_top_k = min(cfg.moe_top_k, args.experts)
+    if args.ep > 1:
+        if not args.experts:
+            p.error("--ep needs --experts (an 'expert' axis with no MoE "
+                    "replicates weights and wastes devices)")
+        if args.experts % args.ep:
+            p.error(f"--experts {args.experts} must divide by --ep "
+                    f"{args.ep} (otherwise expert weights silently "
+                    "replicate instead of sharding)")
 
     axes = {k: v for k, v in
             (("data", args.dp), ("model", args.tp), ("seq", args.sp),
-             ("pipe", args.pp))
+             ("pipe", args.pp), ("expert", args.ep))
             if v > 1} or {"data": 1}
     mesh = parallel.make_mesh(axes)
     parallel.set_mesh(mesh)
