@@ -357,3 +357,20 @@ def test_singa_alias_deep_imports():
     assert b1 is b2
     # the alias must not clobber the real module's spec/loader
     assert singa_tpu.sonnx.__spec__.name == "singa_tpu.sonnx"
+
+
+def test_singa_alias_exposes_round4_surface():
+    """The frozen singa.* shim must carry every round-4 addition: HF
+    interop, Adafactor, PipelineStack, window/MoE configs, recurrent
+    ONNX ops, beam search."""
+    import singa
+
+    assert callable(singa.models.from_hf)
+    assert callable(singa.models.from_hf_mixtral)
+    assert callable(singa.models.to_hf)
+    assert singa.opt.Adafactor is not None
+    assert singa.layer.PipelineStack is not None
+    cfg = singa.models.LlamaConfig.tiny()
+    assert hasattr(cfg, "sliding_window") and hasattr(cfg, "num_experts")
+    assert {"LSTM", "GRU", "RNN"} <= set(singa.sonnx.supported_ops())
+    assert hasattr(singa.models.Llama(cfg), "generate_beam")
