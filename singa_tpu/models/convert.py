@@ -60,6 +60,12 @@ def from_hf_gpt2(hf_model, pipeline_stages: int = 0, dropout=None):
     from . import transformer as t
 
     hc = hf_model.config
+    act = getattr(hc, "activation_function", "gelu_new")
+    if act not in ("gelu_new", "gelu_pytorch_tanh"):
+        raise NotImplementedError(
+            f"activation_function={act!r}; models.GPT2 implements the "
+            "tanh-gelu (gelu_new) GPT-2 — converting would silently "
+            "change the activation")
     if dropout is None:
         dropout = float(getattr(hc, "resid_pdrop", 0.0) or 0.0)
     cfg = t.GPT2Config(
@@ -220,6 +226,12 @@ def from_hf_mixtral(hf_model, **kw):
     hc = hf_model.config
     E = hc.num_local_experts
     k = hc.num_experts_per_tok
+    if k < 2:
+        raise NotImplementedError(
+            "num_experts_per_tok=1: HF renormalizes the selected "
+            "gate to 1.0 while this framework's k=1 path keeps the "
+            "Switch raw-probability gate — logits would silently "
+            "diverge")
     cfg = lm.LlamaConfig(
         vocab_size=hc.vocab_size, dim=hc.hidden_size,
         num_layers=hc.num_hidden_layers,
