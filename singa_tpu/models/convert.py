@@ -8,7 +8,8 @@ state-dict conversion, no serialization round-trip).
     m = models.from_hf(hf)            # singa_tpu model, same logits
 
 Supported: GPT2LMHeadModel -> models.GPT2, LlamaForCausalLM ->
-models.Llama, MixtralForCausalLM -> models.Llama(num_experts=E),
+models.Llama, MistralForCausalLM -> models.Llama(sliding_window=W),
+MixtralForCausalLM -> models.Llama(num_experts=E),
 BertForSequenceClassification -> models.BERT.
 Conversions are pure layout mapping (HF Linear stores
 (out, in) -> ours (in, out); GPT-2's Conv1D already stores (in, out);
