@@ -48,15 +48,19 @@ def _launch_world(world: int, tmpdir: str, steps: int = _STEPS,
             for r in range(world)]
 
 
-def _single_process_reference(steps: int = _STEPS):
-    """Same workload, one process, full batch, plain SGD."""
+def _single_process_reference(steps: int = _STEPS,
+                              adafactor: bool = False):
+    """Same workload, one process, full batch, plain optimizer."""
     import singa_tpu as st
     from singa_tpu import models, opt, tensor
 
     st.parallel.set_mesh(None)
     tensor.set_seed(0)
     m = models.MLP(perceptron_size=(32,), num_classes=4)
-    m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+    m.set_optimizer(opt.Adafactor(lr=1e-2,
+                                  multiply_by_parameter_scale=False,
+                                  min_dim_size_to_factor=8)
+                    if adafactor else opt.SGD(lr=0.1, momentum=0.9))
     rng = np.random.RandomState(123)
     X = rng.randn(8, 16).astype(np.float32)
     Y = rng.randint(0, 4, (8,)).astype(np.int32)
@@ -113,6 +117,18 @@ def test_two_process_resume_equals_uninterrupted(tmp_path):
     ref_losses, ref_params = _single_process_reference(steps=6)
     _assert_matches_reference(results, ref_losses, ref_params,
                               "after resume")
+
+
+def test_two_process_adafactor_resume(tmp_path):
+    """Adafactor's DICT slots (factored vr/vc) checkpoint and resume
+    across 2 REAL processes, reproducing the uninterrupted big-batch
+    trajectory (round-4 optimizer + the proc-0-write/barrier path)."""
+    results = _launch_world(2, str(tmp_path), steps=6,
+                            mode="adafactor_resume")
+    ref_losses, ref_params = _single_process_reference(steps=6,
+                                                       adafactor=True)
+    _assert_matches_reference(results, ref_losses, ref_params,
+                              "adafactor resume")
 
 
 def test_two_process_zero1_matches_big_batch(tmp_path):
