@@ -399,3 +399,26 @@ def test_to_hf_windowed_exports_mistral():
     back.eval()
     o2 = back(tensor.from_numpy(ids)).to_numpy().reshape(2, 24, 101)
     np.testing.assert_allclose(o2, ours, rtol=1e-4, atol=1e-5)
+
+
+def test_mixtral_active_window_plus_moe_matches():
+    """The window x MoE combination (real Mixtral shape: banded
+    attention AND expert routing in the same block) matches
+    transformers with the window ACTIVE."""
+    torch.manual_seed(0)
+    cfg = transformers.MixtralConfig(
+        vocab_size=101, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, num_local_experts=4,
+        num_experts_per_tok=2, sliding_window=8,
+        max_position_embeddings=64, rope_theta=10000.0,
+        rms_norm_eps=1e-5, attn_implementation="eager",
+        use_cache=False)
+    hf = transformers.MixtralForCausalLM(cfg).eval()
+    m = models.from_hf(hf)
+    m.eval()
+    assert m.cfg.sliding_window == 8 and m.cfg.num_experts == 4
+    ids = _ids(vocab=101, shape=(2, 24))
+    ref = _hf_logits(hf, ids)
+    out = m(tensor.from_numpy(ids)).to_numpy().reshape(ref.shape)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
