@@ -133,11 +133,10 @@ class _LlamaAttention(layer.Layer):
 
     def _banded(self, q, k, v, device):
         """Sliding-window attention: causal AND within the last
-        `sliding_window` keys.  Long sequences run the chunked banded
-        path (O(T*W) memory); short ones the plain masked SDPA."""
-        import jax.numpy as jnp
-
-        from ..ops.attention import attention as fused_attention
+        `sliding_window` keys.  All backend selection lives in the
+        BandedSDPA op (Pallas banded kernel on TPU, chunked O(T*W) jnp
+        elsewhere, full-mask reference for degenerate chunkings)."""
+        del device
         from ..ops.attention import banded_attention
         from ..parallel import mesh as mesh_mod
         m_ = mesh_mod.current_mesh()
@@ -146,20 +145,7 @@ class _LlamaAttention(layer.Layer):
                 "sliding_window attention does not compose with the "
                 "'seq' (ring attention) mesh axis — drop the seq axis "
                 "or use full causal attention")
-        W = self.cfg.sliding_window
-        Tq, Tk = q.shape[1], k.shape[1]
-        if Tq > 512 and Tq == Tk:
-            from ..ops.attention import pick_band_chunk
-            C = pick_band_chunk(Tq, W)
-            if C is not None:       # degenerate divisors (prime T):
-                return banded_attention(q, k, v, W, chunk=C)
-            # else fall through to the masked path
-        qpos = jnp.arange(Tq)[:, None]
-        kpos = jnp.arange(Tk)[None, :]
-        band = (kpos <= qpos) & (kpos > qpos - W)
-        m = Tensor(data=band[None, None], device=device,
-                   requires_grad=False)
-        return fused_attention(q, k, v, causal=False, mask=m)
+        return banded_attention(q, k, v, self.cfg.sliding_window)
 
     def forward(self, x: Tensor, cache=None, pos=0):
         c = self.cfg

@@ -111,10 +111,12 @@ def sdpa(q, k, v, causal=False, mask=None, scale=None):
 # ---------------------------------------------------------------------------
 
 def _banded_reference(q, k, v, window: int, scale: float):
-    """Oracle: full (T, T) band mask through _sdpa_reference."""
-    T = q.shape[1]
-    qpos = jnp.arange(T)[:, None]
-    kpos = jnp.arange(T)[None, :]
+    """Oracle: full (Tq, Tk) band mask through _sdpa_reference
+    (bottom-right aligned when Tk > Tq, matching the causal
+    convention)."""
+    Tq, Tk = q.shape[1], k.shape[1]
+    qpos = jnp.arange(Tq)[:, None] + (Tk - Tq)
+    kpos = jnp.arange(Tk)[None, :]
     band = (kpos <= qpos) & (kpos > qpos - window)
     return _sdpa_reference(q, k, v, False, band[None, None], scale)
 
@@ -188,6 +190,10 @@ def banded_sdpa(q, k, v, window: int, scale: Optional[float] = None,
 
 
 class BandedSDPA(autograd.Operator):
+    """Backend selection mirrors SDPA: the Pallas banded kernel on TPU
+    (below-band tiles skipped entirely), the chunked jnp path
+    elsewhere, the full-mask reference for degenerate chunkings."""
+
     def __init__(self, window: int, scale: Optional[float],
                  chunk: Optional[int]):
         super().__init__()
@@ -196,7 +202,17 @@ class BandedSDPA(autograd.Operator):
         self.chunk = chunk
 
     def fwd(self, q, k, v):
-        return banded_sdpa(q, k, v, self.window, self.scale, self.chunk)
+        scale = self.scale or (1.0 / math.sqrt(q.shape[-1]))
+        W = self.window
+        if self.chunk is None and _use_flash(q, k):
+            from .flash_attention import flash_attention
+            # falls back to the banded reference internally when the
+            # shape doesn't tile
+            return flash_attention(q, k, v, causal=True, scale=scale,
+                                   window=W)
+        if self.chunk is None and pick_band_chunk(q.shape[1], W) is None:
+            return _banded_reference(q, k, v, W, scale)
+        return banded_sdpa(q, k, v, W, scale, self.chunk)
 
 
 def banded_attention(q: Tensor, k: Tensor, v: Tensor, window: int,

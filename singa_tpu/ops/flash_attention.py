@@ -57,7 +57,7 @@ def _causal_ids(qi, kj, block_q, block_k, off):
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, scale, causal, block_q, block_k, off):
+                *, scale, causal, block_q, block_k, off, window=None):
     qi, kj = pl.program_id(2), pl.program_id(3)
     nkv = pl.num_programs(3)
 
@@ -67,10 +67,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # causal: skip tiles where even the last q row precedes the first key
+    # causal: skip tiles where even the last q row precedes the first
+    # key; window: also skip tiles entirely below the band (every key
+    # older than first-query-pos - W)
     live = True
     if causal:
         live = (qi * block_q + block_q - 1 + off) >= kj * block_k
+    if window is not None:
+        live = live & (kj * block_k + block_k - 1
+                       > qi * block_q + off - window)
 
     @pl.when(live)
     def _():
@@ -78,9 +83,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        if causal:
+        if causal or window is not None:
             qpos, kpos = _causal_ids(qi, kj, block_q, block_k, off)
-            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+            if causal:
+                s = jnp.where(qpos >= kpos, s, _NEG_INF)
+            if window is not None:
+                s = jnp.where(kpos > qpos - window, s, _NEG_INF)
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -106,7 +114,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale, causal, block_q, block_k, off):
+                   dq_acc, *, scale, causal, block_q, block_k, off,
+                   window=None):
     qi, kj = pl.program_id(2), pl.program_id(3)
     nkv = pl.num_programs(3)
 
@@ -117,6 +126,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     live = True
     if causal:
         live = (qi * block_q + block_q - 1 + off) >= kj * block_k
+    if window is not None:
+        live = live & (kj * block_k + block_k - 1
+                       > qi * block_q + off - window)
 
     @pl.when(live)
     def _():
@@ -128,9 +140,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         delta = delta_ref[0, 0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse)
-        if causal:
+        if causal or window is not None:
             qpos, kpos = _causal_ids(qi, kj, block_q, block_k, off)
-            p = jnp.where(qpos >= kpos, p, 0.0)
+            if causal:
+                p = jnp.where(qpos >= kpos, p, 0.0)
+            if window is not None:
+                p = jnp.where(kpos > qpos - window, p, 0.0)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
         dq_acc[:] = dq_acc[:] + jnp.dot(ds, k,
@@ -143,7 +158,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    block_q, block_k, off):
+                    block_q, block_k, off, window=None):
     kj, qi = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
 
@@ -155,6 +170,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     live = True
     if causal:
         live = (qi * block_q + block_q - 1 + off) >= kj * block_k
+    if window is not None:
+        live = live & (kj * block_k + block_k - 1
+                       > qi * block_q + off - window)
 
     @pl.when(live)
     def _():
@@ -166,9 +184,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse)                              # (BQ, BK)
-        if causal:
+        if causal or window is not None:
             qpos, kpos = _causal_ids(qi, kj, block_q, block_k, off)
-            p = jnp.where(qpos >= kpos, p, 0.0)
+            if causal:
+                p = jnp.where(qpos >= kpos, p, 0.0)
+            if window is not None:
+                p = jnp.where(kpos > qpos - window, p, 0.0)
         dv_acc[:] = dv_acc[:] + jnp.dot(p.T, do,
                                         preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
@@ -205,13 +226,14 @@ def _block_sizes(seq_q, seq_k):
     return bq, bk
 
 
-def _fwd(q, k, v, causal, scale, interpret):
+def _fwd(q, k, v, causal, scale, interpret, window=None):
     B, H, Tq, D = q.shape
     K, Tk = k.shape[1], k.shape[2]
     G = H // K
     bq, bk = _block_sizes(Tq, Tk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=bq, block_k=bk, off=Tk - Tq)
+                               block_q=bq, block_k=bk, off=Tk - Tq,
+                               window=window)
     o, lse = pl.pallas_call(
         kernel,
         grid=(B, H, Tq // bq, Tk // bk),
@@ -240,7 +262,8 @@ def _fwd(q, k, v, causal, scale, interpret):
     return o, lse
 
 
-def _bwd(q, k, v, o, lse, do, causal, scale, interpret, dlse=None):
+def _bwd(q, k, v, o, lse, do, causal, scale, interpret, dlse=None,
+         window=None):
     B, H, Tq, D = q.shape
     K, Tk = k.shape[1], k.shape[2]
     G = H // K
@@ -255,7 +278,7 @@ def _bwd(q, k, v, o, lse, do, causal, scale, interpret, dlse=None):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, off=off),
+                          block_q=bq, block_k=bk, off=off, window=window),
         grid=(B, H, Tq // bq, Tk // bk),
         in_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
@@ -277,7 +300,7 @@ def _bwd(q, k, v, o, lse, do, causal, scale, interpret, dlse=None):
     # outside the kernel — avoids cross-program accumulation
     dk_p, dv_p = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, off=off),
+                          block_q=bq, block_k=bk, off=off, window=window),
         grid=(B, H, Tk // bk, Tq // bq),
         in_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
@@ -315,28 +338,29 @@ def _bwd(q, k, v, o, lse, do, causal, scale, interpret, dlse=None):
 # custom-vjp core in (B, H, T, D) layout
 # ---------------------------------------------------------------------------
 
-def _flash_core(q, k, v, causal, scale, interpret):
+def _flash_core(q, k, v, causal, scale, interpret, window=None):
     """o-only view over the (o, lse) core; the lse cotangent is zeros,
     which _bwd folds in for free (delta - 0)."""
-    return _flash_core_lse(q, k, v, causal, scale, interpret)[0]
+    return _flash_core_lse(q, k, v, causal, scale, interpret, window)[0]
 
 
 # -- (o, lse) core: also the building block for cross-chip ring attention --
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_core_lse(q, k, v, causal, scale, interpret):
-    return _fwd(q, k, v, causal, scale, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core_lse(q, k, v, causal, scale, interpret, window=None):
+    return _fwd(q, k, v, causal, scale, interpret, window)
 
 
-def _flash_core_lse_fwd(q, k, v, causal, scale, interpret):
-    o, lse = _fwd(q, k, v, causal, scale, interpret)
+def _flash_core_lse_fwd(q, k, v, causal, scale, interpret, window=None):
+    o, lse = _fwd(q, k, v, causal, scale, interpret, window)
     return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_core_lse_bwd(causal, scale, interpret, res, cots):
+def _flash_core_lse_bwd(causal, scale, interpret, window, res, cots):
     q, k, v, o, lse = res
     do, dlse = cots
-    return _bwd(q, k, v, o, lse, do, causal, scale, interpret, dlse=dlse)
+    return _bwd(q, k, v, o, lse, do, causal, scale, interpret, dlse=dlse,
+                window=window)
 
 
 _flash_core_lse.defvjp(_flash_core_lse_fwd, _flash_core_lse_bwd)
@@ -367,18 +391,31 @@ def _tileable(Tq, Tk, D) -> bool:
 
 
 def flash_attention(q, k, v, causal: bool = False, scale: float = None,
-                    interpret: bool = None):
+                    interpret: bool = None, window: int = None):
     """(B, T, H, D) attention; k/v may have fewer heads (GQA, H % K == 0)
     or a longer sequence (KV cache; causal is bottom-right aligned).
+    `window`: Mistral-style sliding window — banded tiles below the
+    band are skipped entirely (requires causal=True).
 
     Uses the Pallas kernel when shapes tile onto the hardware, else the
     XLA-fused reference (same math, O(T^2) logits)."""
     from .attention import _sdpa_reference
 
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True (the band is "
+                             "causal by definition)")
+        if window < 1:
+            raise ValueError(
+                f"window must be >= 1, got {window} (0 would mask every "
+                "key; use window=None for full causal attention)")
     scale = scale or (1.0 / math.sqrt(q.shape[-1]))
     B, Tq, H, D = q.shape
     Tk, K = k.shape[1], k.shape[2]
     if not _tileable(Tq, Tk, D) or H % K != 0:
+        if window is not None:
+            from .attention import _banded_reference
+            return _banded_reference(q, k, v, window, scale)
         return _sdpa_reference(q, k, v, causal, None, scale)
     if interpret is None:
         interpret = not _on_tpu()
@@ -386,5 +423,6 @@ def flash_attention(q, k, v, causal: bool = False, scale: float = None,
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)
-    o = _flash_core(qh, kh, vh, causal, float(scale), bool(interpret))
+    o = _flash_core(qh, kh, vh, causal, float(scale), bool(interpret),
+                    None if window is None else int(window))
     return jnp.swapaxes(o, 1, 2)
