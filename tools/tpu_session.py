@@ -130,6 +130,25 @@ def main() -> None:
 
     flash()
 
+    @stage("flash_banded_fwd_bwd", 120)
+    def flash_banded():
+        # the sliding-window kernel mode (Mistral-family models):
+        # below-band kv tiles skipped; must compile+run on real Mosaic
+        from singa_tpu.ops.flash_attention import flash_attention
+        q = jnp.zeros((1, 128, 2, 32) if _SMOKE else (8, 2048, 8, 64),
+                      jnp.bfloat16)
+        W = 16 if _SMOKE else 512
+        f = jax.jit(lambda q: flash_attention(q, q, q, causal=True,
+                                              window=W))
+        jax.block_until_ready(f(q))
+        g = jax.jit(jax.grad(
+            lambda q: flash_attention(q, q, q, causal=True, window=W)
+            .astype(jnp.float32).sum()))
+        jax.block_until_ready(g(q))
+        return f"banded flash fwd+bwd compiled+ran (W={W})"
+
+    flash_banded()
+
     import numpy as np
 
     from singa_tpu import device, models, opt, tensor
