@@ -1,0 +1,69 @@
+"""Dispatch-latency probe: is the chip slow, or is each dispatch taxed?
+
+Times (a) a trivial jitted add, (b) one matmul per dispatch x K, and
+(c) a lax.scan of K matmuls inside ONE dispatch.  If (c)'s per-matmul
+time is far below (b)'s, step time is dominated by fixed per-dispatch
+overhead and multi-step scan dispatch will recover throughput.
+
+Usage: python tools/dispatch_probe.py
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def timed(fn, *args, n=5):
+    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) else \
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    dev = jax.devices()[0]
+    print("device:", dev, flush=True)
+
+    tiny = jnp.ones((8, 8), jnp.float32)
+    add = jax.jit(lambda x: x + 1)
+    t_add = timed(add, tiny, n=10)
+    print(f"trivial add dispatch: {t_add*1e3:.2f} ms", flush=True)
+
+    # 2048^3 bf16 matmul: ~17.2 GFLOP -> ~0.09 ms at 197 TFLOP/s peak
+    x = jnp.ones((2048, 2048), jnp.bfloat16)
+    mm = jax.jit(lambda a: a @ a)
+    t_mm = timed(mm, x, n=10)
+    print(f"single matmul dispatch: {t_mm*1e3:.2f} ms "
+          f"({17.18/t_mm/1e3:.1f} TFLOP/s)", flush=True)
+
+    for k in (16, 64):
+        scan_mm = jax.jit(
+            lambda a, k=k: lax.scan(lambda c, _: (c @ c * 0 + c @ a, None),
+                                    a, None, length=k)[0])
+        t_scan = timed(scan_mm, x, n=3)
+        # each iter does TWO matmuls (c@c and c@a)
+        per = t_scan / (2 * k)
+        print(f"scan of {k}x2 matmuls in ONE dispatch: {t_scan*1e3:.1f} ms "
+              f"total, {per*1e3:.3f} ms/matmul ({17.18/per/1e3:.1f} TFLOP/s)",
+              flush=True)
+
+    # K separate dispatches of the same matmul
+    k = 16
+    t0 = time.perf_counter()
+    out = x
+    for _ in range(k):
+        out = mm(out)
+    jax.block_until_ready(out)
+    t_sep = (time.perf_counter() - t0) / k
+    print(f"{k} separate matmul dispatches: {t_sep*1e3:.2f} ms each",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Probe 2: true-fence timings to localize the per-step overhead.
+
+Fences with an actual host fetch (np.asarray of a scalar) instead of
+block_until_ready.  Measures: matmul completion, scan-of-matmuls,
+and a fake train step with ~200 donated param buffers (the shape of
+Model.train_step) — enqueue time vs completion time.
+
+Usage: python tools/dispatch_probe2.py
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def fence(x):
+    return np.asarray(jax.tree_util.tree_leaves(x)[0]).ravel()[0]
+
+
+def main():
+    print("device:", jax.devices()[0], flush=True)
+    x = jnp.ones((2048, 2048), jnp.bfloat16)
+
+    mm = jax.jit(lambda a: (a @ a).astype(jnp.bfloat16))
+    fence(mm(x))
+    t0 = time.perf_counter(); fence(mm(x)); t1 = time.perf_counter()
+    print(f"matmul, true fence: {(t1-t0)*1e3:.2f} ms", flush=True)
+
+    k = 64
+    scan_mm = jax.jit(
+        lambda a: lax.scan(lambda c, _: ((c @ a).astype(jnp.bfloat16), None),
+                           a, None, length=k)[0])
+    fence(scan_mm(x))
+    t0 = time.perf_counter(); fence(scan_mm(x)); t1 = time.perf_counter()
+    print(f"scan of {k} matmuls, true fence: {(t1-t0)*1e3:.1f} ms total, "
+          f"{(t1-t0)/k*1e3:.3f} ms/matmul", flush=True)
+
+    # fake train step: 200 param buffers (~400 MB), donated, few matmuls
+    n_p = 200
+    params = [jnp.ones((512, 2048), jnp.bfloat16) for _ in range(n_p)]
+
+    @jax.jit
+    def step(ps, inp):
+        h = inp
+        for i in range(0, 8):
+            h = (h @ ps[i].T @ ps[i]).astype(jnp.bfloat16)
+        loss = jnp.sum(h.astype(jnp.float32))
+        new = [(p * 0.999).astype(jnp.bfloat16) for p in ps]
+        return new, loss
+
+    step = jax.jit(step.__wrapped__, donate_argnums=(0,))
+    inp = jnp.ones((256, 2048), jnp.bfloat16)
+    params, l = step(params, inp); fence(l)
+    for trial in range(3):
+        t0 = time.perf_counter()
+        params, l = step(params, inp)
+        t_enq = time.perf_counter() - t0
+        fence(l)
+        t_tot = time.perf_counter() - t0
+        print(f"fake train step ({n_p} donated params): enqueue "
+              f"{t_enq*1e3:.1f} ms, complete {t_tot*1e3:.1f} ms", flush=True)
+
+    # same but scan 8 steps inside one dispatch
+    @jax.jit
+    def step8(ps, inp):
+        def body(c, _):
+            new, loss = step.__wrapped__(c, inp)
+            return new, loss
+        return lax.scan(body, ps, None, length=8)
+
+    params2 = [jnp.ones((512, 2048), jnp.bfloat16) for _ in range(n_p)]
+    out = step8(params2, inp); fence(out[1])
+    t0 = time.perf_counter()
+    out = step8(params2, inp); fence(out[1])
+    t_tot = time.perf_counter() - t0
+    print(f"scan of 8 fake train steps, ONE dispatch: {t_tot*1e3:.1f} ms "
+          f"total, {t_tot/8*1e3:.1f} ms/step", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import os
+import statistics
 import sys
 import time
 
@@ -190,24 +191,41 @@ def main() -> None:
             out = m(ids)
             jax.block_until_ready(out.data)
         t_compile = time.time() - t0
-        t0 = time.perf_counter()
+        # fence EVERY step and take the median: the tunnel chip shows
+        # 200x step-to-step weather (r4 probe: one 45 s step amid
+        # 250 ms neighbours), so a block-timed window reports outliers,
+        # not the steady state
+        times = []
         for _ in range(steps):
+            t0 = time.perf_counter()
             if train:
                 out = m.train_step(ids)
             else:
                 out = m(ids)
-        jax.block_until_ready(out[-1].data if train else out.data)
-        dt = (time.perf_counter() - t0) / steps
+            jax.block_until_ready(out[-1].data if train else out.data)
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        dt = statistics.median(times)
         g = m.graph
         ca = g.cost_analysis() if g is not None else {}
         flops = float(ca.get("flops", 0.0))
         byts = float(ca.get("bytes accessed", 0.0))
+        # primary MFU from the analytic formula (6N + attention): XLA
+        # cost_analysis counts a scan body once (the chunked CE) and
+        # sees no FLOPs inside the Pallas kernel — see bench.py
+        fl_analytic = (m.flops_per_token(seqlen) * batch * seqlen
+                       if train and hasattr(m, "flops_per_token") else 0.0)
         row = {
             "tag": tag, "batch": batch, "seq": seqlen,
             "init_s": round(t_init, 1), "compile_s": round(t_compile, 1),
             "step_ms": round(dt * 1e3, 2),
+            "step_ms_min": round(times[0] * 1e3, 2),
+            "step_ms_max": round(times[-1] * 1e3, 2),
             "tokens_per_s": round(batch * seqlen / dt, 1),
-            "mfu": round(flops / dt / peak, 4) if flops else None,
+            "mfu": round(fl_analytic / dt / peak, 4) if fl_analytic
+            else (round(flops / dt / peak, 4) if flops else None),
+            "mfu_cost_analysis": round(flops / dt / peak, 4) if flops
+            else None,
             "compiled_tflops": round(flops / 1e12, 3),
             "bytes_gb": round(byts / 1e9, 3),
             "roofline_compute_ms": round(flops / peak * 1e3, 2),
@@ -268,11 +286,13 @@ def main() -> None:
         m.compile([x], is_train=True, use_graph=True)
         out = m.train_step(x, y)
         jax.block_until_ready(out[-1].data)
-        t0 = time.perf_counter()
+        times = []
         for _ in range(10):
+            t0 = time.perf_counter()
             out = m.train_step(x, y)
-        jax.block_until_ready(out[-1].data)
-        dt = (time.perf_counter() - t0) / 10
+            jax.block_until_ready(out[-1].data)
+            times.append(time.perf_counter() - t0)
+        dt = statistics.median(times)
         g = m.graph
         fl = g.flops() if g is not None else 0.0
         return {"step_ms": round(dt * 1e3, 1),
@@ -301,11 +321,13 @@ def main() -> None:
         rep.compile([ids], is_train=True, use_graph=True)
         out = rep.train_step(ids, labels)
         jax.block_until_ready(out[-1].data)
-        t0 = time.perf_counter()
+        times = []
         for _ in range(10):
+            t0 = time.perf_counter()
             out = rep.train_step(ids, labels)
-        jax.block_until_ready(out[-1].data)
-        dt = (time.perf_counter() - t0) / 10
+            jax.block_until_ready(out[-1].data)
+            times.append(time.perf_counter() - t0)
+        dt = statistics.median(times)
         return {"step_ms": round(dt * 1e3, 1),
                 "samples_per_s": round(b / dt, 1)}
 
@@ -329,9 +351,13 @@ def main() -> None:
         t0 = time.time()
         gm.generate(prompt, max_new_tokens=N, param_dtype=pdt)
         t_first = time.time() - t0
-        t0 = time.perf_counter()
-        out = gm.generate(prompt, max_new_tokens=N, param_dtype=pdt)
-        dt = time.perf_counter() - t0
+        # best-of-3: one bad weather window inside a 128-step decode
+        # loop would otherwise dominate the number
+        dt = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = gm.generate(prompt, max_new_tokens=N, param_dtype=pdt)
+            dt = min(dt, time.perf_counter() - t0)
         assert out.shape == (B, P + N)
         assert len(gm._gen_sessions) == 1
         return {"batch": B, "prompt": P, "new_tokens": N,
@@ -412,23 +438,28 @@ def _write_perf_notes(rows, dev_kind) -> None:
     ls = by.get("train+flash+fused+seq4k")
     if ls:
         lines.append(
-            f"- long context: seq 4096 (batch 4) runs {ls['step_ms']} "
-            f"ms/step, {ls['tokens_per_s']} tok/s, MFU {ls['mfu']} — "
-            "the flash kernel's O(T) memory is what fits this on one "
-            "chip.")
+            f"- long context: seq {ls['seq']} (batch {ls['batch']}) runs "
+            f"{ls['step_ms']} ms/step, {ls['tokens_per_s']} tok/s, MFU "
+            f"{ls['mfu']} — the flash kernel's O(T) memory is what fits "
+            "this on one chip.")
     b32 = by.get("train+flash+fused+b32")
     if h and b32:
         lines.append(
-            f"- batch 32 vs 16: MFU {h['mfu']} -> {b32['mfu']} "
-            f"({h['tokens_per_s']} -> {b32['tokens_per_s']} tok/s).")
+            f"- batch {b32['batch']} vs {h['batch']}: MFU {h['mfu']} -> "
+            f"{b32['mfu']} ({h['tokens_per_s']} -> {b32['tokens_per_s']} "
+            "tok/s).")
     if h:
+        # both sides of the ceiling-vs-achieved comparison on the
+        # cost-analysis basis (roofline_*_ms are CA-derived; the
+        # analytic-basis MFU is the 'mfu' key in the table)
         bound = max(h["roofline_compute_ms"], h["roofline_memory_ms"])
         ceil = (h["roofline_compute_ms"] / bound) if bound else None
-        lines.append(f"- roofline: step >= max(compute "
-                     f"{h['roofline_compute_ms']} ms, memory "
+        lines.append(f"- roofline (cost-analysis basis): step >= "
+                     f"max(compute {h['roofline_compute_ms']} ms, memory "
                      f"{h['roofline_memory_ms']} ms); ceiling MFU "
                      f"{round(ceil, 4) if ceil else '?'} — achieved "
-                     f"{h['mfu']}.")
+                     f"{h.get('mfu_cost_analysis')} (analytic-basis "
+                     f"achieved: {h['mfu']}).")
     lines += ["", "(Regenerate with `python tools/tpu_session.py` on the "
               "chip; raw JSON in tpu_session.json.)"]
     with open(out, "w") as f:
