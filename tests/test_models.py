@@ -264,9 +264,13 @@ class TestKVCacheGeneration:
         prompt = np.random.RandomState(2).randint(0, 256, (1, 8)).astype(np.int32)
         m.compile([tensor.from_numpy(prompt)], is_train=False, use_graph=False)
         m.generate(prompt, max_new_tokens=10)
+        m.generate(prompt, max_new_tokens=10)   # same controls: no retrace
         sess = next(iter(m._gen_sessions.values()))
-        assert sess.decode._cache_size() == 1, \
-            "decode re-compiled: per-token cost depends on position"
+        assert len(sess._decode_all_cache) == 1, \
+            "decode_all re-built for identical sampling controls"
+        fn = next(iter(sess._decode_all_cache.values()))
+        assert fn._cache_size() == 1, \
+            "decode_all re-compiled: generation cost depends on state"
 
     def test_sampled_generation_shape_and_determinism(self):
         tensor.set_seed(0)
@@ -557,6 +561,12 @@ class TestBeamSearch:
         np.testing.assert_array_equal(
             m.generate(prompt, max_new_tokens=6),
             m.generate_beam(prompt, max_new_tokens=6, num_beams=1))
+        # beam search drives sess.decode per step from the host: its
+        # per-token program must compile exactly once (a static `pos`
+        # would retrace per position — O(N) compiles)
+        sess = next(s for (b, _, _), s in m._gen_sessions.items() if b == 2)
+        assert sess.decode._cache_size() == 1, \
+            "beam decode re-compiled: per-token cost depends on position"
 
     def test_single_step_beam_is_exact_argmax(self):
         """With one decode step the K-wide frontier IS the exact top-1:
