@@ -204,11 +204,13 @@ def bench_resnet50(dev, on_tpu: bool) -> None:
     tensor.set_seed(0)
     np.random.seed(0)
     if on_tpu:
-        # batch 16 keeps v5e compile+run inside the budget (BENCH_r02:
-        # batch 32 at 224^2 never finished); images/sec/chip is still the
-        # honest per-chip metric at this size
+        # batch 512: step time on the tunnel chip is dominated by a
+        # per-op tax that is independent of tensor size (r4 probes), so
+        # images/sec scales ~linearly with batch until HBM runs out —
+        # 16 -> 512 measured 110 -> 3,335 img/s at an unchanged ~150 ms
+        # step (compile ~55 s, well inside the budget)
         m = models.resnet50(num_classes=1000, cifar_stem=False)
-        batch, hw, steps, warmup, name = 16, 224, 10, 2, "resnet50"
+        batch, hw, steps, warmup, name = 512, 224, 8, 2, "resnet50"
     else:
         m = models.resnet18(num_classes=10, cifar_stem=True)
         batch, hw, steps, warmup, name = 4, 32, 3, 1, "resnet18-cifar(cpu)"
@@ -221,12 +223,23 @@ def bench_resnet50(dev, on_tpu: bool) -> None:
     dt, out = _timed_steps(m, (x, y), steps, warmup)
     g = m.graph
     peak = peak_flops(getattr(dev, "device_kind", None) or dev.platform)
-    mfu = (g.flops() / dt / peak) if (g is not None and g.flops()) else 0.0
+    mfu_ca = (g.flops() / dt / peak) if (g is not None and g.flops()) \
+        else 0.0
+    # analytic MFU: XLA cost_analysis undercounts convs ~9x here (r4:
+    # 22.8 GFLOP counted vs ~197 true per b16 step).  ResNet-50 @224^2
+    # forward = 4.09 GFLOP/image (the standard published count);
+    # training ~= 3x forward (fwd + 2x in backward).
+    if on_tpu:
+        flops_step = 3 * 4.09e9 * batch
+        mfu = flops_step / dt / peak
+    else:
+        mfu = mfu_ca
     _detail("resnet50_train", {
         "model": name, "batch": batch, "image": hw,
         "step_ms": round(dt * 1e3, 1),
         "images_per_s": round(batch / dt, 1),
-        "mfu_cost_analysis": round(mfu, 4),
+        "mfu_analytic": round(mfu, 4),
+        "mfu_cost_analysis": round(mfu_ca, 4),
         # conv workload against the same 45% bar the Llama headline
         # reports (BASELINE.json:5) — convs can tell a different story
         # than matmuls (VERDICT r3 weak #4)
@@ -245,8 +258,10 @@ def bench_bert_sonnx(dev, on_tpu: bool) -> None:
     tensor.set_seed(0)
     np.random.seed(0)
     if on_tpu:
+        # batch 256 amortizes the tunnel chip's per-op tax (see
+        # bench_resnet50): 16 -> 256 measured 112 -> 1,136 samples/s
         cfg = models.BERTConfig(num_labels=2)
-        batch, seq, steps, warmup = 16, 128, 10, 2
+        batch, seq, steps, warmup = 256, 128, 8, 2
     else:
         cfg = models.BERTConfig.tiny(num_labels=2)
         batch, seq, steps, warmup = 2, 16, 3, 1
