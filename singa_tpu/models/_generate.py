@@ -56,21 +56,24 @@ def _bound(model, params: Dict, buffers: Dict):
 
 
 class _GenSession:
-    """Compiled prefill + decode pair for one (batch, prompt, total) shape.
+    """Compiled prefill + whole-generation programs for one
+    (batch, prompt, total) shape.
 
-    `decode` is the single-token program (used per-step by beam search);
-    `decode_all_fn` returns a whole-generation program — pick + decode
-    for all N tokens under ONE lax.scan, so greedy/sampled generation is
-    exactly two dispatches (prefill, decode_all) and one host fetch.
-    The per-token host round-trip the old loop paid (fetch tok, enqueue
-    next step) dominates on a remote-attached device (r4 measurement:
-    74 ms/token of ~70 ms tunnel RTT)."""
+    `decode` is the single-token program (a building block for custom
+    host-driven loops); `decode_all_fn` / `beam_all_fn` return
+    whole-generation programs — pick/select + decode for all N tokens
+    under ONE lax.scan, so generation is exactly two dispatches
+    (prefill, decode_all) and one host fetch.  The per-token host
+    round-trip a host-driven loop pays (fetch tok, enqueue next step)
+    dominates on a remote-attached device (r4 measurement: 74 ms/token
+    of ~70 ms tunnel RTT)."""
 
     def __init__(self, model, batch: int, prompt_len: int, total_len: int):
         self.model = model
         self.prompt_len = prompt_len
         self.total_len = total_len
         self._decode_all_cache: Dict = {}
+        self._beam_all_cache: Dict = {}
 
         def prefill(params, buffers, ids):
             with _bound(model, params, buffers):
@@ -154,6 +157,106 @@ class _GenSession:
         self._decode_all_cache[key] = fn
         return fn
 
+    def beam_all_fn(self, n: int, num_beams: int, eos_id: Optional[int]):
+        """Jitted (params, buffers, logits0, caches) ->
+        (seqs (B,K,n), scores (B,K), done (B,K), gen_len (B,K)): the
+        full beam-search loop — select, beam bookkeeping, cache
+        reorder, decode — as one lax.scan.  Semantics mirror the old
+        host-driven loop exactly: frozen beams expand only to eos at
+        zero incremental score, the cache gather is skipped (runtime
+        lax.cond) when every beam kept its slot, and once every beam of
+        every row is done the remaining ticks are no-ops."""
+        key = (n, num_beams, eos_id)
+        fn = self._beam_all_cache.get(key)
+        if fn is not None:
+            return fn
+        model, P, K = self.model, self.prompt_len, num_beams
+
+        def beam_all(params, buffers, logits0, caches):
+            BK = logits0.shape[0]
+            B = BK // K
+            offsets = (jnp.arange(B)[:, None] * K).astype(jnp.int32)
+            arangeK = jnp.arange(K, dtype=jnp.int32)
+
+            def tick(carry, i):
+                logits, scores, caches, seqs, done, gen_len, stopped = carry
+                beam_idx, tok, scores = _beam_select(
+                    logits, scores, K,
+                    done if eos_id is not None else None,
+                    eos_id)
+                gather = jnp.take_along_axis
+                seqs = gather(seqs, beam_idx[:, :, None], axis=1)
+                done = gather(done, beam_idx, axis=1)
+                gen_len = gather(gen_len, beam_idx, axis=1)
+                seqs = seqs.at[:, :, i].set(tok.astype(jnp.int32))
+                if eos_id is not None:
+                    # length counts the eos token itself, then freezes
+                    gen_len = jnp.where(done, gen_len, i + 1)
+                    done = done | (tok == eos_id)
+                else:
+                    gen_len = jnp.full_like(gen_len, i + 1)
+
+                def advance(args):
+                    logits, caches = args
+
+                    def reorder(caches):
+                        perm = (beam_idx + offsets).reshape(-1)
+                        return _beam_reorder(caches, perm)
+
+                    # skip the full-cache gather when every beam kept
+                    # its own slot (always true at K=1)
+                    caches = jax.lax.cond(
+                        jnp.any(beam_idx != arangeK[None, :]),
+                        reorder, lambda c: c, caches)
+                    with _bound(model, params, buffers):
+                        t = Tensor(data=tok.reshape(-1, 1).astype(
+                            jnp.int32), device=_dev(model),
+                            requires_grad=False)
+                        nxt, caches = model.forward_cached(
+                            t, caches=caches, pos=P + i)
+                    return nxt.data[:, 0, :].astype(jnp.float32), caches
+
+                if eos_id is not None:
+                    # every beam of every row just finished: skip the
+                    # reorder + decode, like the old host loop's break
+                    stopped = jnp.all(done)
+                    logits, caches = jax.lax.cond(
+                        stopped, lambda args: args, advance,
+                        (logits, caches))
+                else:
+                    logits, caches = advance((logits, caches))
+                return (logits, scores, caches, seqs, done, gen_len,
+                        stopped), None
+
+            def body(carry, i):
+                if eos_id is None:
+                    return tick(carry, i)
+                # all beams of all rows finished: every remaining tick
+                # is a no-op (the old host loop broke here)
+                stopped = carry[-1]
+                carry, _ = jax.lax.cond(
+                    stopped, lambda c, _i: (c, None), tick, carry, i)
+                stopped = jnp.all(carry[4])
+                return carry[:-1] + (stopped,), None
+
+            # before the first expansion all K beams are identical:
+            # only beam 0 may seed the frontier
+            scores0 = jnp.full((B, K), -jnp.inf,
+                               jnp.float32).at[:, 0].set(0.0)
+            carry = (logits0.astype(jnp.float32), scores0, caches,
+                     jnp.zeros((B, K, n), jnp.int32),
+                     jnp.zeros((B, K), bool),
+                     jnp.zeros((B, K), jnp.int32),
+                     jnp.asarray(False))
+            carry, _ = jax.lax.scan(body, carry,
+                                    jnp.arange(n, dtype=jnp.int32))
+            _, scores, _, seqs, done, gen_len, _ = carry
+            return seqs, scores, done, gen_len
+
+        fn = jax.jit(beam_all)
+        self._beam_all_cache[key] = fn
+        return fn
+
 
 def _dev(model):
     from ..model import model_device
@@ -185,12 +288,11 @@ def _pick_impl(logits, temperature: float, rng_key, top_k: Optional[int],
     return jax.random.categorical(rng_key, lg, axis=-1)
 
 
-@functools.partial(jax.jit, static_argnums=(2,))
 def _beam_select(logits, scores, k: int, done=None, eos_id=None):
-    """One beam-search expansion, entirely on device: combine the
-    (B*K, V) next-token logits with the (B, K) running scores, flatten
-    each batch's K*V candidates, and keep the top K.  A finished beam
-    (done mask + eos_id, both traced) admits only eos at zero
+    """One beam-search expansion (traced inside beam_all_fn's scan):
+    combine the (B*K, V) next-token logits with the (B, K) running
+    scores, flatten each batch's K*V candidates, and keep the top K.  A
+    finished beam (done mask + eos_id) admits only eos at zero
     incremental cost, so its raw score freezes.  Returns
     (beam_idx (B,K), tok (B,K), new_scores (B,K))."""
     B, K = scores.shape
@@ -205,9 +307,9 @@ def _beam_select(logits, scores, k: int, done=None, eos_id=None):
     return flat_idx // V, flat_idx % V, top
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
 def _beam_reorder(caches, perm):
-    """Gather the KV caches onto the surviving beams (batch axis 0)."""
+    """Gather the KV caches onto the surviving beams (batch axis 0);
+    traced inside beam_all_fn's scan."""
     return jax.tree.map(lambda c: jnp.take(c, perm, axis=0), caches)
 
 
@@ -297,10 +399,11 @@ class GenerateMixin:
                       eos_id: Optional[int] = None,
                       return_scores: bool = False, param_dtype=None):
         """Beam-search decoding (static shapes: the K beams ride the
-        batch axis, so the same compiled prefill/decode pair as
-        `generate` serves a (B*K)-row batch).  Each step is one jitted
-        expansion (`_beam_select`), one jitted cache gather
-        (`_beam_reorder`), and one decode dispatch.
+        batch axis, so the same compiled prefill as `generate` serves a
+        (B*K)-row batch).  The whole search — expansion, beam
+        bookkeeping, cache reorder, decode — runs as ONE jitted
+        lax.scan (sess.beam_all_fn): two dispatches and one host fetch
+        per search, independent of max_new_tokens.
 
         Once a beam emits `eos_id` its hypothesis is frozen: its only
         expansion is eos at zero cost, so its RAW cumulative score stays
@@ -321,50 +424,9 @@ class GenerateMixin:
         rep = np.repeat(ids, K, axis=0)                      # (B*K, P)
         logits, caches = sess.prefill(params, buffers,
                                       jnp.asarray(rep, jnp.int32))
-        # before the first expansion all K beams are identical: only
-        # beam 0 may seed the frontier
-        scores = jnp.full((B, K), -jnp.inf, jnp.float32).at[:, 0].set(0.0)
-        seqs = np.zeros((B, K, max_new_tokens), np.int32)
-        done = np.zeros((B, K), bool)
-        gen_len = np.zeros((B, K), np.int32)   # length incl. eos
-        offsets = np.arange(B)[:, None] * K
-
-        for i in range(max_new_tokens):
-            if eos_id is not None:
-                # freezing happens inside the jitted select: only the
-                # tiny (B,K) done mask is uploaded, never the logits
-                beam_idx, tok, scores = _beam_select(
-                    logits, scores, K, jnp.asarray(done),
-                    jnp.asarray(eos_id, jnp.int32))
-            else:
-                beam_idx, tok, scores = _beam_select(logits, scores, K)
-            beam_idx = np.asarray(beam_idx)
-            tok = np.asarray(tok)
-            # host bookkeeping follows the surviving beams
-            gather = np.take_along_axis
-            seqs = gather(seqs, beam_idx[:, :, None], axis=1)
-            done = gather(done, beam_idx, axis=1)
-            gen_len = gather(gen_len, beam_idx, axis=1)
-            seqs[:, :, i] = tok
-            if eos_id is not None:
-                # length counts the eos token itself (standard
-                # normalization), then the beam freezes
-                gen_len = np.where(done, gen_len, i + 1)
-                done |= (tok == eos_id)
-                if done.all():
-                    break
-            else:
-                gen_len[:] = i + 1
-            if i + 1 < max_new_tokens:
-                if (beam_idx != np.arange(K)).any():
-                    # skip the full-cache gather when every beam kept
-                    # its own slot (always true at K=1)
-                    perm = jnp.asarray((beam_idx + offsets).reshape(-1))
-                    caches = _beam_reorder(caches, perm)
-                logits, caches = sess.decode(
-                    params, buffers,
-                    jnp.asarray(tok.reshape(-1, 1), jnp.int32),
-                    jnp.asarray(P + i, jnp.int32), caches)
+        fn = sess.beam_all_fn(max_new_tokens, K, eos_id)
+        seqs, scores, done, gen_len = (np.asarray(a) for a in fn(
+            params, buffers, logits, caches))
 
         final = np.asarray(scores) / np.maximum(
             gen_len, 1).astype(np.float32) ** length_penalty

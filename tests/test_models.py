@@ -272,6 +272,31 @@ class TestKVCacheGeneration:
         assert fn._cache_size() == 1, \
             "decode_all re-compiled: generation cost depends on state"
 
+    def test_session_decode_single_token_hook(self):
+        """sess.decode is the public building block for custom
+        host-driven decoding loops: one token in, next-token logits +
+        updated caches out, position as a traced scalar."""
+        import jax
+        import jax.numpy as jnp
+        tensor.set_seed(0)
+        m = models.Llama(models.LlamaConfig.tiny())
+        prompt = np.random.RandomState(2).randint(0, 256, (1, 8)).astype(
+            np.int32)
+        m.compile([tensor.from_numpy(prompt)], is_train=False,
+                  use_graph=False)
+        ref = m.generate(prompt, max_new_tokens=2)
+        sess = next(iter(m._gen_sessions.values()))
+        params = {n: t.data for n, t in m.get_params().items()}
+        buffers = {n: t.data for n, t in m._get_buffers().items()}
+        logits, caches = sess.prefill(params, buffers,
+                                      jnp.asarray(prompt, jnp.int32))
+        tok0 = np.argmax(np.asarray(logits), axis=-1).astype(np.int32)
+        logits, _ = sess.decode(params, buffers, tok0[:, None],
+                                jnp.asarray(8, jnp.int32), caches)
+        tok1 = np.argmax(np.asarray(logits), axis=-1).astype(np.int32)
+        np.testing.assert_array_equal(ref[:, 8], tok0)
+        np.testing.assert_array_equal(ref[:, 9], tok1)
+
     def test_sampled_generation_shape_and_determinism(self):
         tensor.set_seed(0)
         m = models.GPT2(models.GPT2Config.tiny())
@@ -561,12 +586,14 @@ class TestBeamSearch:
         np.testing.assert_array_equal(
             m.generate(prompt, max_new_tokens=6),
             m.generate_beam(prompt, max_new_tokens=6, num_beams=1))
-        # beam search drives sess.decode per step from the host: its
-        # per-token program must compile exactly once (a static `pos`
-        # would retrace per position — O(N) compiles)
+        # the whole search is one scanned program: per-(n,K,eos) build,
+        # compiled exactly once across repeated calls
+        m.generate_beam(prompt, max_new_tokens=6, num_beams=1)
         sess = next(s for (b, _, _), s in m._gen_sessions.items() if b == 2)
-        assert sess.decode._cache_size() == 1, \
-            "beam decode re-compiled: per-token cost depends on position"
+        assert len(sess._beam_all_cache) == 1, \
+            "beam_all re-built for identical search controls"
+        assert next(iter(sess._beam_all_cache.values()))._cache_size() == 1, \
+            "beam_all re-compiled: search cost depends on state"
 
     def test_single_step_beam_is_exact_argmax(self):
         """With one decode step the K-wide frontier IS the exact top-1:
