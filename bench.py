@@ -277,10 +277,19 @@ def bench_bert_sonnx(dev, on_tpu: bool) -> None:
         np.random.randint(0, 2, (batch,)).astype(np.int32))
     rep.compile([ids], is_train=True, use_graph=True)
     dt, out = _timed_steps(rep, (ids, labels), steps, warmup)
+    # analytic MFU (BERT.flops_per_token: 6N + attention, embeddings
+    # excluded): BERT-base is one of the two models the 45% bar names
+    # (BASELINE.json:5)
+    from singa_tpu.utils.metrics import peak_flops
+    flops_step = native.flops_per_token(seq) * batch * seq
+    peak = peak_flops(getattr(dev, "device_kind", None) or dev.platform)
+    mfu = flops_step / dt / peak if on_tpu else None
     _detail("bert_sonnx_train", {
         "layers": cfg.num_layers, "dim": cfg.dim, "batch": batch, "seq": seq,
         "step_ms": round(dt * 1e3, 1),
         "samples_per_s": round(batch / dt, 1),
+        "mfu_analytic": round(mfu, 4) if mfu else None,
+        "mfu_vs_45pct_bar": round(mfu / 0.45, 4) if mfu else None,
         "step_stats_ms": dict(LAST_STEP_STATS),
         "loss": round(float(out[-1].to_numpy()), 4)})
 

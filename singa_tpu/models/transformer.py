@@ -358,3 +358,17 @@ class BERT(model.Model):
         loss = autograd.softmax_cross_entropy(out, labels)
         self.optimizer(loss)
         return out, loss
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Training FLOPs/token ≈ 6·N_matmul + 12·L·dim·T — same
+        accounting as Llama.flops_per_token, EXCEPT the embedding
+        tables are excluded from N: a classification BERT has no
+        vocab-sized output matmul, so (unlike a tied-embedding LM)
+        those ~24M params never hit the MXU.  Gather/scatter of the
+        embedding rows is memory traffic, not FLOPs."""
+        c = self.cfg
+        n_embed = (c.vocab_size + c.max_position
+                   + c.type_vocab_size) * c.dim
+        n_total = sum(p.size for p in self.get_params().values())
+        return (6 * (n_total - n_embed)
+                + 12 * c.num_layers * c.dim * seq_len)

@@ -336,8 +336,12 @@ def main() -> None:
             jax.block_until_ready(out[-1].data)
             times.append(time.perf_counter() - t0)
         dt = statistics.median(times)
+        # analytic MFU (BERT.flops_per_token, same basis as bench.py)
+        fl = native.flops_per_token(seq) * b * seq
         return {"step_ms": round(dt * 1e3, 1),
-                "samples_per_s": round(b / dt, 1)}
+                "samples_per_s": round(b / dt, 1),
+                "mfu_analytic": None if _SMOKE
+                else round(fl / dt / peak, 4)}
 
     bert()
 
