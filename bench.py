@@ -64,6 +64,16 @@ def _budget_left() -> float:
     return _BUDGET_S - (time.time() - _T0)
 
 
+#: ResNet-50 TPU bench batch, shared with tools/tpu_session.py.
+#: Step time on the tunnel chip is ~flat in batch (r4 on-chip sweep,
+#: median-of-fenced-steps: b16 110 img/s -> b512 3,335 -> b768 5,409 ->
+#: b1024 7,126 -> b1536 10,911 -> b2048 14,935 img/s, all at ~140 ms),
+#: so throughput scales with batch until HBM runs out.  1536 stays a
+#: step back from the edge (b2048 ran but compiles 2x slower; BERT
+#: OOMs at b512xseq128 show the HBM ceiling is real).  The next
+#: tpu_session run re-measures this config into tpu_session.json.
+RESNET50_TPU_BATCH = 1536
+
 #: per-step stats of the most recent _timed_steps call (ms):
 #: {"min": .., "median": .., "mean": .., "max": .., "n": ..}
 LAST_STEP_STATS: dict = {}
@@ -204,13 +214,9 @@ def bench_resnet50(dev, on_tpu: bool) -> None:
     tensor.set_seed(0)
     np.random.seed(0)
     if on_tpu:
-        # batch 512: step time on the tunnel chip is dominated by a
-        # per-op tax that is independent of tensor size (r4 probes), so
-        # images/sec scales ~linearly with batch until HBM runs out —
-        # 16 -> 512 measured 110 -> 3,335 img/s at an unchanged ~150 ms
-        # step (compile ~55 s, well inside the budget)
         m = models.resnet50(num_classes=1000, cifar_stem=False)
-        batch, hw, steps, warmup, name = 512, 224, 8, 2, "resnet50"
+        batch, hw, steps, warmup, name = (RESNET50_TPU_BATCH, 224, 8, 2,
+                                          "resnet50")
     else:
         m = models.resnet18(num_classes=10, cifar_stem=True)
         batch, hw, steps, warmup, name = 4, 32, 3, 1, "resnet18-cifar(cpu)"

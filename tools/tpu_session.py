@@ -277,10 +277,10 @@ def main() -> None:
             m = models.resnet18(num_classes=10, cifar_stem=True)
             b, hw = 2, 32
         else:
-            # batch 512 amortizes the per-op tax (bench_resnet50 note):
-            # step time is ~flat in batch, so img/s scales with it
+            # shared with bench.py — see RESNET50_TPU_BATCH's sweep note
+            from bench import RESNET50_TPU_BATCH
             m = models.resnet50(num_classes=1000, cifar_stem=False)
-            b, hw = 512, 224
+            b, hw = RESNET50_TPU_BATCH, 224
         m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9, weight_decay=1e-4))
         x = tensor.from_numpy(
             np.random.randn(b, 3, hw, hw).astype(np.float32))
