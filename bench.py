@@ -199,9 +199,43 @@ def bench_llama(dev, on_tpu: bool) -> dict:
         else None,
         "step_stats_ms": dict(LAST_STEP_STATS),
         "loss": round(loss, 4)})
-    return {"metric": "llama_train_tokens_per_sec",
-            "value": round(tok_per_s, 2), "unit": "tokens/s",
-            "vs_baseline": round(mfu / 0.45, 4)}
+    out = {"metric": "llama_train_tokens_per_sec",
+           "value": round(tok_per_s, 2), "unit": "tokens/s",
+           "vs_baseline": round(mfu / 0.45, 4)}
+    named = _named_models_vs_bar()
+    if named:
+        # the >=45% bar names ResNet-50 and BERT-base
+        # (BASELINE.json:2,5); vs_baseline stays the live flagship
+        # measurement for cross-round continuity, and this field
+        # carries the named models' committed on-chip numbers
+        out["named_models_mfu_vs_bar"] = named
+    return out
+
+
+def _named_models_vs_bar():
+    """ResNet-50 / BERT analytic-MFU vs the 0.45 bar, from the
+    committed tpu_session.json record (same chip, same methodology).
+    The `source` key makes the provenance explicit: these are the
+    committed record's numbers, not re-measured in this bench run —
+    the live bench emits its own resnet50_train/bert_sonnx_train
+    detail lines to compare against."""
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "tpu_session.json")) as f:
+            st = json.load(f).get("stages", {})
+        rn = ((st.get("resnet50") or {}).get("result") or {}).get("mfu")
+        bt = ((st.get("bert_sonnx") or {}).get("result")
+              or {}).get("mfu_analytic")
+        out = {}
+        if rn:
+            out["resnet50"] = round(rn / 0.45, 4)
+        if bt:
+            out["bert_base"] = round(bt / 0.45, 4)
+        if out:
+            out["source"] = "tpu_session.json committed record"
+        return out or None
+    except Exception:  # noqa: BLE001 - informational field, never fatal
+        return None
 
 
 def bench_resnet50(dev, on_tpu: bool) -> None:

@@ -303,13 +303,22 @@ class Llama(GenerateMixin, model.Model):
         return sum(p.size for p in self.get_params().values())
 
     def flops_per_token(self, seq_len: int) -> float:
-        """Training FLOPs/token ≈ 6N + 12·L·dim·T (qk^T and probs·v matmuls
-        fwd+bwd at sequence length T) — honest MFU accounting,
-        SURVEY.md §7.3 item 6.  The fused chunked loss recomputes the
-        lm-head matmul in backward: + 2·dim·V."""
+        """Training FLOPs/token ≈ 6N_active + 12·L·dim·T (qk^T and
+        probs·v matmuls fwd+bwd at sequence length T) — honest MFU
+        accounting, SURVEY.md §7.3 item 6.  The fused chunked loss
+        recomputes the lm-head matmul in backward: + 2·dim·V.  For MoE
+        configs N counts only the ACTIVE parameters per token (top-k of
+        num_experts expert FFNs), not the full expert bank."""
         n = self.num_params()
         c = self.cfg
-        f = 6 * n + 12 * c.num_layers * c.dim * seq_len
+        if c.num_experts:
+            # each expert FFN: 3 SwiGLU matmuls of dim x ffn_dim
+            expert_p = 3 * c.dim * c.ffn_dim
+            n -= c.num_layers * (c.num_experts - c.moe_top_k) * expert_p
+        # sliding-window attention computes only min(T, W) keys/query
+        attn_span = min(seq_len, c.sliding_window) if c.sliding_window \
+            else seq_len
+        f = 6 * n + 12 * c.num_layers * c.dim * attn_span
         if c.fused_loss:
             f += 2 * c.dim * c.vocab_size
         return f

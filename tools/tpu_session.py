@@ -162,7 +162,8 @@ def main() -> None:
     hbm = peak_hbm_bw(dev_kind)
 
     def llama_run(tag: str, fused: bool, flash_on: bool, train: bool,
-                  batch: int = 16, seqlen: int = 1024, steps: int = 15):
+                  batch: int = 16, seqlen: int = 1024, steps: int = 15,
+                  cfg_extra: dict | None = None):
         if _SMOKE:
             batch, seqlen, steps = 2, 64, 2
         if flash_on:
@@ -175,6 +176,8 @@ def main() -> None:
             else models.LlamaConfig.small()
         cfg.max_position = max(cfg.max_position, seqlen)
         cfg.fused_loss = fused
+        for k, v in (cfg_extra or {}).items():
+            setattr(cfg, k, v)
         m = models.Llama(cfg)
         m.set_optimizer(opt.SGD(lr=0.01, momentum=0.9))
         ids = tensor.from_numpy(np.random.randint(
@@ -392,6 +395,30 @@ def main() -> None:
         return r
 
     batch32()
+
+    @stage("llama_moe", 240)
+    def moe():
+        # Mixtral-style MoE Llama (SwiGLU experts, top-2 routing, aux
+        # loss folded in): hardware evidence for the expert path on one
+        # chip (EP-mesh execution is covered by the 8-device dryrun)
+        r = llama_run("train+flash+fused+moe4", True, True, True,
+                      steps=8, cfg_extra={"num_experts": 4})
+        rows.append(r)
+        return r
+
+    moe()
+
+    @stage("llama_windowed", 240)
+    def windowed():
+        # Mistral-style sliding-window attention: the banded Pallas
+        # flash path under training, on chip (window 256 over seq 1024)
+        r = llama_run("train+flash+fused+win256", True, True, True,
+                      steps=8, cfg_extra={"sliding_window": 256}
+                      if not _SMOKE else {"sliding_window": 16})
+        rows.append(r)
+        return r
+
+    windowed()
 
     @stage("llama_longseq", 300)
     def longseq():
