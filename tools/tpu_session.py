@@ -67,9 +67,15 @@ def stage(name: str, need_s: float):
                 mark(f"DONE {name} in {time.time() - t0:.1f}s: {out}")
                 return out
             except Exception as e:  # noqa: BLE001 - session must continue
+                # first line, ANSI-stripped, capped: a remote-compile
+                # failure can embed a multi-KB escape-laden helper log
+                import re
+                msg = re.sub(r"\x1b\[[0-9;]*m", "",
+                             str(e).splitlines()[0] if str(e) else "")[:300]
                 _RESULTS["stages"][name] = {"ok": False,
-                                            "error": f"{type(e).__name__}: {e}"}
-                mark(f"FAIL {name}: {type(e).__name__}: {e}")
+                                            "error": f"{type(e).__name__}: "
+                                                     f"{msg}"}
+                mark(f"FAIL {name}: {type(e).__name__}: {msg}")
                 return None
         return run
     return deco
@@ -400,9 +406,13 @@ def main() -> None:
     def moe():
         # Mixtral-style MoE Llama (SwiGLU experts, top-2 routing, aux
         # loss folded in): hardware evidence for the expert path on one
-        # chip (EP-mesh execution is covered by the 8-device dryrun)
+        # chip (EP-mesh execution is covered by the 8-device dryrun).
+        # b8 x seq512: the tunnel's compile helper crashes (HTTP 500)
+        # on the routing pattern at 16k tokens; 4k tokens compiles and
+        # trains (r4 bisect)
         r = llama_run("train+flash+fused+moe4", True, True, True,
-                      steps=8, cfg_extra={"num_experts": 4})
+                      batch=8, seqlen=512, steps=8,
+                      cfg_extra={"num_experts": 4})
         rows.append(r)
         return r
 
