@@ -155,7 +155,10 @@ def bench_llama(dev, on_tpu: bool) -> dict:
         # batch 16 amortizes weight reads over 2x the tokens (MFU lever;
         # 16x1024 bf16 activations are tiny next to v5e's 16 GB); the
         # measured tpu_session b16-vs-b32 comparison can bump it
-        batch, seqlen, steps, warmup = _best_llama_batch(16), 1024, 15, 2
+        # 30 measured steps (~6 s steady-state): the tunnel's weather
+        # comes in multi-second bursts, so a wider window keeps one
+        # congested patch from dominating the median
+        batch, seqlen, steps, warmup = _best_llama_batch(16), 1024, 30, 2
     else:
         cfg = models.LlamaConfig.tiny()
         batch, seqlen, steps, warmup = 4, 64, 5, 1
