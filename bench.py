@@ -138,7 +138,7 @@ def _best_llama_batch(default: int = 16) -> int:
         if (h.get("mfu") and b32.get("mfu")
                 and b32["mfu"] > h["mfu"] and b32.get("batch") == 32):
             return 32
-    except (OSError, ValueError, TypeError):
+    except Exception:  # noqa: BLE001 - advisory lookup, never fatal
         pass
     return default
 
