@@ -255,6 +255,24 @@ class Conv2d(Layer):
     def initialize(self, x: Tensor):
         c_axis = -1 if self.data_format == "NHWC" else 1
         in_c = self.in_channels or x.shape[c_axis]
+        # layout tripwire: a (N, 3, H, W) image fed to an NHWC conv is
+        # silently read as a 3-pixel-tall W-channel image — shapes stay
+        # consistent, loss still falls, and the network is garbage
+        # (exactly what the r1-r4 ResNet bench measured).  Warn loudly
+        # when the other axis looks far more channel-like.
+        if len(x.shape) == 4 and self.in_channels is None:
+            other = x.shape[1 if self.data_format == "NHWC" else -1]
+            # 1/3 = gray/RGB; deeper feature maps legitimately shrink to
+            # tiny spatial dims, so 2/4 etc. stay silent
+            if other in (1, 3) and in_c > 8:
+                import warnings
+                warnings.warn(
+                    f"Conv2d(data_format={self.data_format!r}) sees input "
+                    f"shape {tuple(x.shape)}: axis {c_axis} ({in_c} "
+                    f"channels) looks spatial while the other layout's "
+                    f"channel axis has {other} — is the input "
+                    f"{'NCHW' if self.data_format == 'NHWC' else 'NHWC'}?",
+                    stacklevel=2)
         self.in_channels = in_c
         kh, kw = self.kernel_size
         fan_in = in_c * kh * kw // self.groups

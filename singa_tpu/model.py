@@ -553,9 +553,13 @@ class _StepExecutor:
         m = self.model
         params = {n: t.data for n, t in self.param_tensors.items()}
         buffers = {n: t.data for n, t in self.buffer_tensors.items()}
-        step = jnp.asarray(
-            self.opt.step_counter if self.opt is not None else m._step_count,
-            jnp.int32)
+        # resolve the counter to a host int ONCE, before any device work:
+        # the post-step advance must not read the device scalar back
+        # (int() of a device array is a blocking D2H round trip — on the
+        # tunneled TPU that serialized ~RTT into every step, r5 probe 3)
+        step_host = int(self.opt.step_counter if self.opt is not None
+                        else m._step_count)
+        step = jnp.asarray(step_host, jnp.int32)
         rng = jax.random.fold_in(m._base_key, m._step_count)
         place = _place
         if self.dist:
@@ -626,7 +630,7 @@ class _StepExecutor:
         self.slots = new_slots
         m._step_count += 1
         if self.opt is not None:
-            self.opt.step_counter = int(step) + 1
+            self.opt.step_counter = step_host + 1
             # mirror compiled-step slots into the optimizer's eager store
             # (reference assignment, no copy) so save_states always sees
             # the live moments regardless of execution mode

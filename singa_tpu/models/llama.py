@@ -68,6 +68,12 @@ class LlamaConfig:
     # on, train_one_batch returns (loss, loss) instead of (logits, loss)
     # -- hence opt-in; the bench/dryrun/example enable it explicitly
     fused_loss: bool = False
+    # rows per chunk of the fused loss's lax.scan.  Bigger chunks =
+    # fewer scan iterations (the tunnel chip taxes every scan iteration
+    # ~1 ms — r5 probe 5b) and fewer lm-head weight re-reads, at the
+    # cost of a (chunk, V) logits block live per iteration
+    # (4096 x 32k x bf16 = 256 MB)
+    fused_loss_chunk: int = 512
     # activation checkpointing per transformer block (layer.Remat):
     # block internals recomputed in backward — O(layers) less activation
     # HBM for one extra forward; param paths unchanged
@@ -111,6 +117,18 @@ class LlamaConfig:
         """~110M-param config for single-chip benchmarking."""
         return LlamaConfig(vocab_size=32000, dim=768, num_layers=12,
                            num_heads=12, num_kv_heads=4, ffn_dim=2048,
+                           max_position=2048)
+
+    @staticmethod
+    def base() -> "LlamaConfig":
+        """~0.9B-param flagship bench config for one v5e chip, sized so
+        the MXU dominates: honest MFU 0.65 on-chip vs 0.39 for small()
+        at the same methodology (r5 flagship sweep,
+        tools/flagship_sweep.py).  dim 2048 x 24 layers (1.26B) fails
+        the tunnel's compile helper; 16 layers is the largest that
+        builds there."""
+        return LlamaConfig(vocab_size=32000, dim=2048, num_layers=16,
+                           num_heads=16, num_kv_heads=8, ffn_dim=5632,
                            max_position=2048)
 
     @property
@@ -286,7 +304,8 @@ class Llama(GenerateMixin, model.Model):
         tgt = labels if labels is not None else ids
         if self.cfg.fused_loss:
             loss = next_token_loss_fused(self.features(ids), self.lm_head,
-                                         tgt)
+                                         tgt,
+                                         chunk_rows=self.cfg.fused_loss_chunk)
         else:
             logits = self.forward(ids)
             loss = next_token_loss(logits, tgt)
@@ -303,18 +322,32 @@ class Llama(GenerateMixin, model.Model):
         return sum(p.size for p in self.get_params().values())
 
     def flops_per_token(self, seq_len: int) -> float:
-        """Training FLOPs/token ≈ 6N_active + 12·L·dim·T (qk^T and
+        """Training FLOPs/token ≈ 6·N_matmul + 12·L·dim·T (qk^T and
         probs·v matmuls fwd+bwd at sequence length T) — honest MFU
-        accounting, SURVEY.md §7.3 item 6.  The fused chunked loss
-        recomputes the lm-head matmul in backward: + 2·dim·V.  For MoE
-        configs N counts only the ACTIVE parameters per token (top-k of
-        num_experts expert FFNs), not the full expert bank."""
-        n = self.num_params()
+        accounting, SURVEY.md §7.3 item 6.  N_matmul EXCLUDES the
+        token-embedding table: its lookup is a gather, not a matmul
+        (same convention as BERT.flops_per_token; r1-r4 included it,
+        over-counting ~19% at the `small` config — caught in r5 by
+        walking the compiled step's jaxpr, which this formula now
+        matches to <1%: utils.flops.jaxpr_matmul_conv_flops).  The
+        lm-head stays IN: its projection is a real matmul.  The fused
+        chunked loss recomputes the lm-head matmul in backward:
+        + 2·dim·V.  For MoE configs N counts only the ACTIVE
+        parameters per token (top-k of num_experts expert FFNs), not
+        the full expert bank."""
         c = self.cfg
+        n = self.num_params()
+        if n:
+            n -= c.vocab_size * c.dim        # tok_emb gather
         if c.num_experts:
-            # each expert FFN: 3 SwiGLU matmuls of dim x ffn_dim
+            # each expert FFN: 3 SwiGLU matmuls of dim x ffn_dim.
+            # Clamped at 0: before the first forward num_params() is 0
+            # (lazy init) and the subtraction would go negative.  The
+            # active-FLOPs basis also ignores the capacity-factor
+            # over-compute (padded expert slots) — conservative for MFU.
             expert_p = 3 * c.dim * c.ffn_dim
-            n -= c.num_layers * (c.num_experts - c.moe_top_k) * expert_p
+            n = max(n - c.num_layers * (c.num_experts - c.moe_top_k)
+                    * expert_p, 0)
         # sliding-window attention computes only min(T, W) keys/query
         attn_span = min(seq_len, c.sliding_window) if c.sliding_window \
             else seq_len

@@ -7,12 +7,16 @@ GShard/Switch static-shape recipe, which is what XLA partitions well:
 
   * router: (N, D) -> (N, E) logits -> top-1 gate with a static expert
     capacity C = ceil(cf * N / E);
-  * dispatch: a one-hot (N, E, C) combine tensor built with cumsum
-    position indexing — NO dynamic shapes, dropped tokens (over
-    capacity) pass through with zero expert contribution;
+  * dispatch: two equivalent token-movement formulations sharing one
+    router (`_route`): gather/SCATTER into the (E, C, D) buffers
+    (O(k*N*D) memory ops — the single-chip default; the one-hot
+    einsums cost O(cf*k*N^2*D) MAC, quadratic in tokens, and were the
+    whole 0.16-MFU story on chip in r4) and the one-hot EINSUM form
+    (the EP default: GSPMD partitions it into all-to-alls over ICI).
+    NO dynamic shapes in either; dropped tokens (over capacity) pass
+    through with zero expert contribution;
   * expert compute: (E, C, D) batched einsums over stacked expert
-    weights — sharding the leading E axis over the 'expert' mesh axis
-    turns the dispatch/combine einsums into XLA all-to-alls over ICI;
+    weights, leading E axis sharded over the 'expert' mesh axis;
   * combine: gate-weighted gather back to (N, D).
 
 Everything is pure jnp (fwd differentiates via jax.vjp), so the whole
@@ -43,25 +47,15 @@ def moe_dispatch(logits, capacity: int, k: int = 1):
     rank-major (every token's first choice outranks any second choice,
     the GShard priority)."""
     N, E = logits.shape
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    topv, topi = jax.lax.top_k(probs, k)               # (N, k)
-    # Switch (k=1) gates with the RAW top probability (router gradient
-    # flows through the gate); GShard (k>1) renormalizes over the k
-    # selected experts
-    gates = topv if k == 1 else \
-        topv / jnp.sum(topv, axis=-1, keepdims=True)
-    # rank-major flattening: (k*N, E); cumsum gives globally consistent
-    # slot positions with rank-0 assignments filling first
-    oh = jax.nn.one_hot(topi.T.reshape(-1), E, dtype=jnp.float32)
-    pos = jnp.sum(jnp.cumsum(oh, axis=0) * oh - oh, axis=-1)  # (k*N,)
-    keep = pos < capacity
+    # one router for both dispatch formulations (_route): identical
+    # softmax/top-k/gating/rank-major slot positions as the scatter path
+    e_flat, gate_flat, pos, keep, probs, onehot = _route(logits, capacity, k)
+    oh = jax.nn.one_hot(e_flat, E, dtype=jnp.float32)  # (k*N, E)
     slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
                           dtype=jnp.float32)           # (k*N, C)
-    gate_flat = gates.T.reshape(-1)                    # (k*N,)
     contrib = (oh * (gate_flat * keep)[:, None])[:, :, None] \
         * slot[:, None, :]                             # (k*N, E, C)
     combine = jnp.sum(contrib.reshape(k, N, E, capacity), axis=0)
-    onehot = jax.nn.one_hot(topi[:, 0], E, dtype=jnp.float32)
     return combine, probs, onehot
 
 
@@ -73,8 +67,40 @@ def load_balance_loss(probs, onehot):
     return E * jnp.sum(frac * prob)
 
 
+def _route(logits, capacity: int, k: int):
+    """Shared top-k routing state, rank-major (GShard priority: every
+    token's first choice outranks any second choice for a slot).
+
+    Returns (e_flat (k*N,) expert ids, gate_flat (k*N,) f32 gates,
+    pos (k*N,) slot index within the expert, keep (k*N,) bool,
+    probs (N, E), onehot (N, E) of the first choice)."""
+    N, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    topv, topi = jax.lax.top_k(probs, k)               # (N, k)
+    gates = topv if k == 1 else \
+        topv / jnp.sum(topv, axis=-1, keepdims=True)
+    e_flat = topi.T.reshape(-1)                        # rank-major (k*N,)
+    oh = jax.nn.one_hot(e_flat, E, dtype=jnp.float32)
+    pos = jnp.sum(jnp.cumsum(oh, axis=0) * oh - oh, axis=-1)  # (k*N,)
+    keep = pos < capacity
+    onehot = jax.nn.one_hot(topi[:, 0], E, dtype=jnp.float32)
+    return e_flat, gates.T.reshape(-1), pos, keep, probs, onehot
+
+
+def _expert_ffn(buf, w_in, w_out, w_gate):
+    """(E, C, D) expert buffers -> (E, C, D) outputs (relu or SwiGLU)."""
+    up = jnp.einsum("ecd,edh->ech", buf, w_in.astype(buf.dtype))
+    if w_gate is not None:
+        h = jax.nn.silu(jnp.einsum("ecd,edh->ech", buf,
+                                   w_gate.astype(buf.dtype))) * up
+    else:
+        h = jax.nn.relu(up)
+    return jnp.einsum("ech,ehd->ecd", h, w_out.astype(buf.dtype))
+
+
 def moe_forward(x, router_w, w_in, w_out, capacity_factor: float = 1.25,
-                return_aux: bool = False, top_k: int = 1, w_gate=None):
+                return_aux: bool = False, top_k: int = 1, w_gate=None,
+                dispatch_mode: str = "auto"):
     """Top-k MoE FFN over flattened tokens (k=1 Switch, k=2 GShard).
 
     x: (..., D); router_w: (D, E); w_in: (E, D, H); w_out: (E, H, D).
@@ -82,7 +108,21 @@ def moe_forward(x, router_w, w_in, w_out, capacity_factor: float = 1.25,
     (E, D, H) given, the SwiGLU form silu(x @ w_gate[e]) * (x @
     w_in[e]) @ w_out[e] (Mixtral-style experts).  Shard the stacked
     weights' leading axis over the 'expert' mesh axis (SHARD_RULES)
-    for EP."""
+    for EP.
+
+    dispatch_mode:
+      * 'scatter' — gather/scatter token movement: O(k*N*D) memory ops
+        into the (E, C, D) buffers and back.  Default off-mesh: the
+        one-hot einsums below cost O(cf*k*N^2*D) MAC each — quadratic
+        in token count and pure overhead (r4 on-chip MoE MFU 0.1585;
+        scatter dispatch removed the einsums' N^2 term, r5).
+      * 'einsum' — GShard one-hot dispatch/combine einsums.  Default
+        when an 'expert' mesh axis is live: GSPMD partitions einsums
+        over E into all-to-alls cleanly, which is the EP wire format.
+      * 'auto' — scatter without an EP axis, einsum with one.
+
+    Both modes share `_route` (identical routing, gating, capacity
+    drops) and are equivalence-tested against each other."""
     orig_shape = x.shape
     D = orig_shape[-1]
     xf = x.reshape(-1, D)
@@ -92,20 +132,35 @@ def moe_forward(x, router_w, w_in, w_out, capacity_factor: float = 1.25,
     capacity = max(1, math.ceil(capacity_factor * top_k * N / E))
 
     logits = xf.astype(jnp.float32) @ router_w.astype(jnp.float32)
-    combine, probs, onehot = moe_dispatch(logits, capacity, top_k)
-    dispatch = (combine > 0).astype(xf.dtype)          # (N, E, C)
-    # dispatch tokens into per-expert buffers: (E, C, D)
-    buf = jnp.einsum("nec,nd->ecd", dispatch, xf)
-    up = jnp.einsum("ecd,edh->ech", buf, w_in.astype(xf.dtype))
-    if w_gate is not None:
-        h = jax.nn.silu(jnp.einsum("ecd,edh->ech", buf,
-                                   w_gate.astype(xf.dtype))) * up
+    if dispatch_mode == "auto":
+        from ..parallel import mesh as mesh_mod
+        m = mesh_mod.current_mesh()
+        ep = m is not None and m.shape.get("expert", 1) > 1
+        dispatch_mode = "einsum" if ep else "scatter"
+
+    if dispatch_mode == "scatter":
+        e_flat, gate_flat, pos, keep, probs, onehot = _route(
+            logits, capacity, top_k)
+        # dropped assignments write out of bounds -> mode='drop' elides
+        pos_i = jnp.where(keep, pos, capacity).astype(jnp.int32)
+        tok = jnp.tile(jnp.arange(N), top_k)
+        xs = xf[tok]                                   # (k*N, D)
+        buf = jnp.zeros((E, capacity, D), xf.dtype) \
+            .at[e_flat, pos_i].set(xs, mode="drop")
+        y = _expert_ffn(buf, w_in, w_out, w_gate)      # (E, C, D)
+        # combine: gather each assignment's expert output, gate, sum k
+        w = (gate_flat * keep).astype(xf.dtype)
+        out_a = y[e_flat, jnp.clip(pos_i, 0, capacity - 1)] * w[:, None]
+        out = jnp.sum(out_a.reshape(top_k, N, D), axis=0)
     else:
-        h = jax.nn.relu(up)
-    y = jnp.einsum("ech,ehd->ecd", h, w_out.astype(xf.dtype))
-    # gate-weighted combine back to tokens
-    out = jnp.einsum("nec,ecd->nd", combine.astype(xf.dtype), y)
-    out = out.reshape(orig_shape)
+        combine, probs, onehot = moe_dispatch(logits, capacity, top_k)
+        dispatch = (combine > 0).astype(xf.dtype)      # (N, E, C)
+        # dispatch tokens into per-expert buffers: (E, C, D)
+        buf = jnp.einsum("nec,nd->ecd", dispatch, xf)
+        y = _expert_ffn(buf, w_in, w_out, w_gate)
+        # gate-weighted combine back to tokens
+        out = jnp.einsum("nec,ecd->nd", combine.astype(xf.dtype), y)
+    out = out.astype(xf.dtype).reshape(orig_shape)
     if return_aux:
         return out, load_balance_loss(probs, onehot)
     return out

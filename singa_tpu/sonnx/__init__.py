@@ -13,6 +13,7 @@ import training-capable (BERT-base / GPT-2 fine-tuning, BASELINE.json:9).
 """
 
 from . import proto
+from .checker import CheckError, check_graph, check_model
 from .backend import SingaBackend, SingaRep, prepare, supported_ops
 from .export import export, to_onnx
 from .proto import (AttributeProto, GraphProto, ModelProto, NodeProto,
@@ -22,6 +23,7 @@ from .proto import (AttributeProto, GraphProto, ModelProto, NodeProto,
 
 __all__ = [
     "prepare", "SingaBackend", "SingaRep", "supported_ops",
+    "check_model", "check_graph", "CheckError",
     "to_onnx", "export", "load", "save", "load_model_from_string",
     "proto", "ModelProto", "GraphProto", "NodeProto", "TensorProto",
     "AttributeProto", "make_node", "make_graph", "make_model",

@@ -34,7 +34,15 @@ class DataLoader:
         world_size-1 trailing samples are dropped per epoch).  The
         reference DistOpt workflow partitions input by rank the same
         way.  Defaults keep single-process behavior bit-identical."""
-        x = np.asarray(x, np.float32)
+        x = np.asarray(x)
+        if np.issubdtype(x.dtype, np.integer):
+            # token-id streams (LLM training) stay integral; the native
+            # loader's buffers are f32-typed, so the int path uses the
+            # python pipeline
+            x = x.astype(np.int32, copy=False)
+            use_native = False
+        else:
+            x = x.astype(np.float32, copy=False)
         y = np.asarray(y, np.int32) if y is not None else None
         if world_size < 1:
             raise ValueError(f"world_size must be >= 1, got {world_size}")

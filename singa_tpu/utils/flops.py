@@ -1,0 +1,91 @@
+"""Analytic FLOP counting from a model's OWN traced operations.
+
+Walks the jaxpr of a forward pass and sums matmul/conv FLOPs
+(2 * output elements * reduction length), recursing into scan/cond
+sub-jaxprs (scan bodies multiplied by their static trip count — the
+thing XLA's cost_analysis gets wrong, which is why the benches use
+this counter for MFU).
+
+Born of an r5 audit: the ResNet bench had fed NCHW images to the
+zoo's NHWC convs for four rounds, and the hard-coded "published
+4.09 GFLOP/image" numerator silently described a network that wasn't
+running.  Counting from the traced graph makes the numerator match
+the executed architecture by construction; tests pin the zoo models
+to their published counts.
+"""
+
+from __future__ import annotations
+
+from math import prod
+
+__all__ = ["jaxpr_matmul_conv_flops", "model_forward_flops"]
+
+
+def jaxpr_matmul_conv_flops(jaxpr) -> float:
+    """Sum matmul/conv FLOPs (2*MACs) over a jaxpr, recursing into
+    sub-jaxprs; a scan body is multiplied by its trip count."""
+    total = 0.0
+    for eqn in jaxpr.eqns:
+        p = eqn.primitive.name
+        if p == "dot_general":
+            (lc, _), _ = eqn.params["dimension_numbers"]
+            lhs = eqn.invars[0].aval.shape
+            out = eqn.outvars[0].aval.shape
+            red = prod(lhs[i] for i in lc) if lc else 1
+            total += 2.0 * prod(out) * red
+        elif p == "conv_general_dilated":
+            rhs = eqn.invars[1].aval.shape   # kernel
+            out = eqn.outvars[0].aval.shape
+            dn = eqn.params["dimension_numbers"]
+            cout = rhs[dn.rhs_spec[0]]
+            red = prod(rhs) // cout          # Kh*Kw*Cin_per_group
+            total += 2.0 * prod(out) * red
+        elif p == "cond":
+            # one branch executes; charge the costliest (upper bound —
+            # a data-dependent choice is unknowable statically)
+            branches = eqn.params.get("branches", ())
+            total += max((jaxpr_matmul_conv_flops(b.jaxpr)
+                          for b in branches), default=0.0)
+        else:
+            for sub in eqn.params.values():
+                subs = sub if isinstance(sub, (tuple, list)) else (sub,)
+                for sj in subs:
+                    if hasattr(sj, "jaxpr"):
+                        inner = jaxpr_matmul_conv_flops(sj.jaxpr)
+                        if p == "scan":
+                            inner *= eqn.params.get("length", 1)
+                        total += inner
+    return total
+
+
+def model_forward_flops(model, x) -> float:
+    """Forward matmul+conv FLOPs per SINGLE example of `model` on input
+    shaped like `x` (a Tensor or array; only x[:1] is traced — no
+    device work).  Eval-mode trace with state snapshot/restore so
+    counting can never leak tracers into the live model."""
+    import jax
+
+    from .. import autograd
+    from ..tensor import Tensor
+
+    data = x.data if isinstance(x, Tensor) else x
+    data = data[:1]
+    dev = x.device if isinstance(x, Tensor) else None
+
+    saved_training = autograd.is_training()
+    autograd.set_training(False)
+    snap_p = {n: t.data for n, t in model.get_params().items()}
+    snap_b = {n: t.data for n, t in model._get_buffers().items()}
+    try:
+        def fwd(a):
+            return model.forward(
+                Tensor(data=a, device=dev, requires_grad=False)).data
+
+        closed = jax.make_jaxpr(fwd)(data)
+        return jaxpr_matmul_conv_flops(closed.jaxpr)
+    finally:
+        autograd.set_training(saved_training)
+        for n, t in model.get_params().items():
+            t.data = snap_p[n]
+        for n, t in model._get_buffers().items():
+            t.data = snap_b[n]

@@ -1,0 +1,164 @@
+"""Step-timing methodology for tunnel-attached TPU benchmarking.
+
+Two measurements, different questions:
+
+``windowed_steps`` — training throughput: windows of K back-to-back
+dispatches with ONE fence at the window end, median over windows.
+This is how a real training loop runs (nothing fences per step), so it
+is the honest throughput number.  r5 probe 3 (tools/dispatch_probe3.py)
+showed per-step-fenced timing carries ~30 ms/step of host dispatch
+overhead on the tunneled chip that pipelined execution fully hides:
+fenced 186.8 ms vs 8-step windows 156.4 ms vs 8 steps compiled into ONE
+lax.scan program 160.3 ms — windows and the single-program scan agree,
+so the remainder is genuine device time, not dispatch artifact.
+
+``fenced_steps`` — per-dispatch latency diagnostic: every step fenced
+individually, median.  Includes the dispatch overhead by construction;
+kept for cross-round comparability (the r1-r4 committed numbers used
+this) and for spotting weather (a 45 s outlier shows up as max).
+
+Both report medians: the tunnel chip has 200x run-to-run weather (one
+committed 45 s step amid 250 ms neighbours, r4), so means are
+meaningless and a single block-timed window reports outliers.  With
+windows, one congested window inflates one sample and the median over
+>=5 windows discards it.
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+from typing import Callable, Optional
+
+__all__ = ["windowed_steps", "fenced_steps"]
+
+
+def _block(x) -> None:
+    """TRUE fence: fetch the value to the host when `x` is small (the
+    loss scalar), fall back to block_until_ready otherwise.
+
+    block_until_ready alone is NOT a reliable fence on the tunneled
+    axon backend — r5 probe 3/4 caught it returning in microseconds
+    for programs whose FLOPs could not have finished (a 17-GFLOP
+    matmul "done" in 25 us; a windowed ResNet read that implied 492
+    TFLOP/s on a 197-peak chip).  np.asarray of a scalar is a real
+    D2H round trip and cannot lie about completion."""
+    import jax
+    import numpy as np
+    size = getattr(x, "size", None)
+    if size is not None and size <= 16:
+        np.asarray(x)
+        return
+    if size is not None and getattr(x, "ndim", 0) > 0:
+        # large array (e.g. eval logits): fetch ONE element — the slice
+        # depends on the whole buffer being computed, so it is a true
+        # fence at 4 bytes of transfer instead of the full tensor
+        try:
+            np.asarray(x[(0,) * x.ndim])
+            return
+        except Exception:  # pragma: no cover - exotic array types
+            pass
+    jax.block_until_ready(x)
+
+
+def windowed_steps(step: Callable[[], object], *, windows: int = 6,
+                   window_len: int = 8, warmup: int = 2,
+                   budget_left: Optional[Callable[[], float]] = None,
+                   min_budget_s: float = 30.0):
+    """Median per-step seconds over `windows` windows of `window_len`
+    back-to-back un-fenced steps (true fence at each window end only).
+
+    `step()` runs one training/eval step and returns the object to
+    fence on (a jax array — e.g. the loss tensor's ``.data``).
+    Returns ``(per_step_seconds, stats)`` where stats carries the raw
+    window times and the derived per-step min/median/max in ms.
+
+    The budget is consulted after every dispatch and at window ends —
+    on a trip the current window is fenced immediately and kept only
+    if no complete window exists (scaled by its actual step count).
+    Honest limit: dispatches are async, so a fully-stalled window is
+    only detected at its closing fence — worst case one window of
+    weather (~8 x the stall) is spent before the trip, vs one step
+    under the old per-step-fenced loop.  The median over windows keeps
+    such a window out of the reported number either way."""
+    out = None
+    tripped = False
+    for _ in range(warmup):
+        out = step()
+        if budget_left is not None and budget_left() < min_budget_s:
+            tripped = True
+            break
+    if out is not None:
+        _block(out)
+    wtimes = []
+    partial = None          # (seconds, steps) of an aborted window
+    done_steps = 0
+    for _ in range(windows):
+        # honor the budget only once at least one window exists: the
+        # caller (bench.py's driver-parsed headline) must ALWAYS get a
+        # number, even if weather drained the budget during warmup
+        if tripped and (wtimes or partial):
+            break
+        t0 = time.perf_counter()
+        k = 0
+        for _ in range(window_len):
+            out = step()
+            k += 1
+            if budget_left is not None and budget_left() < min_budget_s:
+                tripped = True
+                break
+        _block(out)
+        dt = time.perf_counter() - t0
+        done_steps += k
+        if k == window_len:
+            wtimes.append(dt)
+        else:
+            partial = (dt, k)
+    if not wtimes and partial is not None and partial[1] > 0:
+        wtimes = [partial[0] / partial[1] * window_len]
+    if not wtimes:
+        raise RuntimeError("budget exhausted before any timed window")
+    wtimes.sort()
+    med = statistics.median(wtimes)
+    stats = {
+        "method": "windowed",
+        "window_len": window_len,
+        "windows": len(wtimes),
+        "n": done_steps,
+        "window_ms": [round(t * 1e3, 1) for t in wtimes],
+        "min": round(wtimes[0] / window_len * 1e3, 1),
+        "median": round(med / window_len * 1e3, 1),
+        "max": round(wtimes[-1] / window_len * 1e3, 1),
+    }
+    return med / window_len, stats
+
+
+def fenced_steps(step: Callable[[], object], *, steps: int = 8,
+                 warmup: int = 1,
+                 budget_left: Optional[Callable[[], float]] = None,
+                 min_budget_s: float = 30.0):
+    """Median per-step seconds with EVERY step individually fenced
+    (per-dispatch latency, r1-r4 methodology).  Returns
+    ``(per_step_seconds, stats)``."""
+    out = None
+    for _ in range(warmup):
+        out = step()
+    if out is not None:
+        _block(out)
+    times = []
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        _block(step())
+        times.append(time.perf_counter() - t0)
+        if budget_left is not None and budget_left() < min_budget_s:
+            break
+    times.sort()
+    stats = {
+        "method": "fenced",
+        "n": len(times),
+        "min": round(times[0] * 1e3, 1),
+        "median": round(statistics.median(times) * 1e3, 1),
+        "mean": round(sum(times) / len(times) * 1e3, 1),
+        "max": round(times[-1] * 1e3, 1),
+    }
+    return statistics.median(times), stats

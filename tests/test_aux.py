@@ -374,3 +374,20 @@ def test_singa_alias_exposes_round4_surface():
     assert hasattr(cfg, "sliding_window") and hasattr(cfg, "num_experts")
     assert {"LSTM", "GRU", "RNN"} <= set(singa.sonnx.supported_ops())
     assert hasattr(singa.models.Llama(cfg), "generate_beam")
+
+
+def test_dataloader_preserves_token_dtype():
+    """Integer datasets (LLM token streams) must come back int32 — the
+    loader used to force-cast x to f32, which broke embedding lookups
+    downstream (r5 hostfed stage)."""
+    from singa_tpu.utils.data import DataLoader
+
+    toks = np.random.RandomState(0).randint(0, 1000, (40, 16))
+    dl = DataLoader(toks, batch_size=8, shuffle=True, drop_last=True)
+    xb, yb = next(iter(dl))
+    assert xb.dtype == np.int32 and xb.shape == (8, 16)
+    assert yb is None
+    # float path unchanged
+    dl2 = DataLoader(np.random.RandomState(1).rand(10, 4), batch_size=5)
+    xb2, _ = next(iter(dl2))
+    assert xb2.dtype == np.float32

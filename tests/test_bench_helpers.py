@@ -30,10 +30,14 @@ class TestNamedModelsVsBar:
 
 
 class TestTimedStepsStats:
-    def test_median_and_stats(self, monkeypatch):
-        """_timed_steps fences every step and reports the median; the
-        stats land in LAST_STEP_STATS (the r4 outlier-robustness
-        contract — one 45 s step must not poison the headline)."""
+    def test_windowed_median_and_stats(self, monkeypatch):
+        """_timed_steps measures windows of 8 back-to-back steps (fence
+        at window end; how a real training loop runs — r5 probe 3) and
+        reports the median over windows; a short individually-fenced
+        pass lands in stats["fenced"] as the per-dispatch diagnostic.
+        The median over windows is the r4 outlier-robustness contract's
+        successor — one 45 s weather step inflates one window and the
+        median discards it."""
         # isolate from the process-global soft budget (stamped at
         # bench import; a long suite run could otherwise trip it)
         monkeypatch.setattr(bench, "_T0", time.time())
@@ -48,13 +52,63 @@ class TestTimedStepsStats:
             def train_step(self, *a):
                 return (FakeLoss(),)
 
-        dt, out = bench._timed_steps(FakeModel(), (None,), steps=7,
+        dt, out = bench._timed_steps(FakeModel(), (None,), steps=32,
                                      warmup=1)
         s = bench.LAST_STEP_STATS
-        assert s["n"] == 7
+        assert s["method"] == "windowed"
+        assert s["window_len"] == 8
+        # steps=32 -> 4 windows of 8 = 32 total back-to-back steps
+        assert s["windows"] == 4 and s["n"] == 32
+        assert len(s["window_ms"]) == 4
         assert s["min"] <= s["median"] <= s["max"]
-        # stats are rounded to 0.1 ms for the detail line
+        # per-step median = median window time / window length
         assert abs(dt * 1e3 - s["median"]) <= 0.05 + 1e-9
+        # fenced diagnostic pass present with its own median
+        assert s["fenced"]["method"] == "fenced"
+        assert s["fenced"]["n"] == 8
+
+    def test_windowed_steps_median_math(self):
+        """utils.timing.windowed_steps: median over windows, not mean —
+        one slow window must not move the reported per-step time."""
+        from singa_tpu.utils.timing import windowed_steps
+
+        calls = {"n": 0}
+        sleeps = [0.0, 0.0, 0.05, 0.0, 0.0]   # one "weather" window
+
+        def step():
+            import jax.numpy as jnp
+            w = calls["n"] // 4
+            if calls["n"] % 4 == 0 and w < len(sleeps):
+                time.sleep(sleeps[w])
+            calls["n"] += 1
+            return jnp.zeros(())
+
+        dt, stats = windowed_steps(step, windows=4, window_len=4,
+                                   warmup=4)
+        assert stats["windows"] == 4 and stats["n"] == 16
+        # the 50 ms window is the max, not the median
+        assert stats["max"] >= 10.0
+        assert stats["median"] < 10.0
+
+
+class TestAxesFor:
+    """__graft_entry__._axes_for — the driver-contract mesh factoring
+    must be exact for ANY device count (r4 VERDICT weak #8)."""
+
+    def test_products_are_exact(self):
+        from __graft_entry__ import _axes_for
+        import math
+        for n in range(1, 33):
+            axes = _axes_for(n)
+            assert math.prod(axes.values()) == n, (n, axes)
+
+    def test_known_factorings(self):
+        from __graft_entry__ import _axes_for
+        assert _axes_for(8) == {"data": 2, "model": 2, "seq": 2}
+        assert _axes_for(6) == {"data": 3, "model": 2}
+        assert _axes_for(12) == {"data": 3, "model": 2, "seq": 2}
+        assert _axes_for(7) == {"data": 7}
+        assert _axes_for(1) == {"data": 1}
 
 
 class TestAnalyticFlopsAccounting:
@@ -74,7 +128,11 @@ class TestAnalyticFlopsAccounting:
         moe(ids)
         f_dense = dense.flops_per_token(8)
         f_moe = moe.flops_per_token(8)
-        full_bank = 6 * moe.num_params() + 12 * cfg.num_layers * cfg.dim * 8
+        # the matmul-param bank: embeddings excluded (their lookup is a
+        # gather — r5 accounting correction)
+        n_emb = cfg.vocab_size * cfg.dim
+        full_bank = (6 * (moe.num_params() - n_emb)
+                     + 12 * cfg.num_layers * cfg.dim * 8)
         # active counts top-2 of 4: strictly less than charging the
         # whole bank, strictly more than the 1-FFN dense model
         assert f_dense < f_moe < full_bank

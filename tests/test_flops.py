@@ -1,0 +1,149 @@
+"""Traced-FLOPs counter (utils.flops) + architecture pins for the zoo.
+
+Regression armor for the r5 audit finding: the ResNet bench had fed
+NCHW images to the NHWC zoo for four rounds — shapes stayed
+consistent, loss fell, and the network silently computed 5x fewer
+FLOPs than ResNet-50.  Pinning each vision model's traced count to
+its published number makes any layout/architecture drift loud."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import models, tensor
+from singa_tpu.utils.flops import jaxpr_matmul_conv_flops, model_forward_flops
+
+
+def _fwd_gflop(m, shape):
+    x = tensor.from_numpy(np.random.RandomState(0)
+                          .randn(*shape).astype(np.float32))
+    m.compile([x], is_train=False, use_graph=False)
+    return model_forward_flops(m, x) / 1e9
+
+
+class TestZooArchitecturePins:
+    def test_resnet50_imagenet_matches_published(self):
+        """torchvision ResNet-50 v1.5 @224^2 = 4.09 GMACs/image fwd =
+        8.18 GFLOP on the 2-FLOPs-per-MAC convention this counter (and
+        the TPU's quoted peak TFLOP/s) uses — the number the bench's
+        analytic MFU rests on."""
+        g = _fwd_gflop(models.resnet50(num_classes=1000, cifar_stem=False),
+                       (1, 224, 224, 3))
+        assert abs(g - 8.18) / 8.18 < 0.05, g
+
+    def test_resnet18_cifar_matches_published(self):
+        """CIFAR ResNet-18 @32^2 ~= 0.556 GMACs = 1.11 GFLOP/image fwd."""
+        g = _fwd_gflop(models.resnet18(num_classes=10, cifar_stem=True),
+                       (1, 32, 32, 3))
+        assert abs(g - 1.11) / 1.11 < 0.06, g
+
+    def test_first_conv_consumes_rgb(self):
+        """The stem kernel must see 3 input channels — the exact axis
+        the NCHW-feed bug got wrong (it saw 224)."""
+        m = models.resnet50(num_classes=1000, cifar_stem=False)
+        x = tensor.from_numpy(np.zeros((1, 224, 224, 3), np.float32))
+        m.compile([x], is_train=False, use_graph=False)
+        kh, kw, cin, cout = m.get_params()["conv1.W"].shape
+        assert (kh, kw, cin, cout) == (7, 7, 3, 64)
+
+    def test_nchw_feed_trips_the_layout_warning(self):
+        m = models.resnet18(num_classes=10, cifar_stem=True)
+        x = tensor.from_numpy(np.zeros((2, 3, 32, 32), np.float32))
+        with pytest.warns(UserWarning, match="NCHW"):
+            m.compile([x], is_train=False, use_graph=False)
+
+
+class TestLlamaFormulaMatchesTracedStep:
+    def test_formula_vs_traced_jaxpr(self):
+        """Llama.flops_per_token (the headline MFU numerator) must
+        match the matmul FLOPs of the COMPILED train step's jaxpr —
+        the r5 correction that caught a ~19% over-count (the 6N
+        formula was charging the embedding table's gather as matmul
+        work)."""
+        from singa_tpu import model as model_mod
+        from singa_tpu import models, opt, tensor
+        import jax
+
+        tensor.set_seed(0)
+        np.random.seed(0)
+        cfg = models.LlamaConfig.tiny()
+        cfg.fused_loss = True
+        m = models.Llama(cfg)
+        m.set_optimizer(opt.SGD(lr=0.01, momentum=0.9))
+        B, T = 2, 32
+        ids = tensor.from_numpy(np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (B, T)).astype(np.int32))
+        m.compile([ids], is_train=True, use_graph=True)
+        # abstract-trace the full step (fwd+bwd+opt) without running it
+        ex = model_mod._StepExecutor(m, "train", m._train_body,
+                                     (ids.data,))
+        fn = ex._jitted.__wrapped__
+        params = {n: t.data for n, t in ex.param_tensors.items()}
+        buffers = {n: t.data for n, t in ex.buffer_tensors.items()}
+        closed = jax.make_jaxpr(fn)(params, buffers, ex.slots,
+                                    np.int32(0), jax.random.PRNGKey(0),
+                                    ids.data)
+        traced = jaxpr_matmul_conv_flops(closed.jaxpr)
+        formula = m.flops_per_token(T) * B * T
+        assert abs(traced - formula) / formula < 0.02, (traced, formula)
+
+
+class TestCounter:
+    def test_matmul_count_exact(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(a, b):
+            return a @ b
+
+        closed = jax.make_jaxpr(f)(jnp.zeros((8, 16)), jnp.zeros((16, 4)))
+        # 2 * M*N*K
+        assert jaxpr_matmul_conv_flops(closed.jaxpr) == 2 * 8 * 4 * 16
+
+    def test_scan_body_multiplied_by_length(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        def f(a):
+            return lax.scan(lambda c, _: (c @ a, None), a, None, length=5)[0]
+
+        closed = jax.make_jaxpr(f)(jnp.zeros((8, 8)))
+        assert jaxpr_matmul_conv_flops(closed.jaxpr) == 5 * 2 * 8 * 8 * 8
+
+    def test_counting_does_not_perturb_the_model(self):
+        """model_forward_flops must not leak tracers into live state or
+        flip the training flag."""
+        from singa_tpu import autograd
+
+        m = models.resnet18(num_classes=10, cifar_stem=True)
+        x = tensor.from_numpy(np.random.RandomState(1)
+                              .randn(2, 32, 32, 3).astype(np.float32))
+        m.compile([x], is_train=True, use_graph=False)
+        before = {n: np.asarray(t.data)
+                  for n, t in list(m.get_params().items())[:3]}
+        flag = autograd.is_training()
+        model_forward_flops(m, x)
+        assert autograd.is_training() == flag
+        for n, v in before.items():
+            np.testing.assert_array_equal(np.asarray(m.get_params()[n].data),
+                                          v)
+        out = m(x)          # still runs normally
+        assert out.shape == (2, 10)
+
+
+def test_cond_branches_counted_at_max():
+    """lax.cond FLOPs must not vanish: the counter charges the
+    costliest branch (one executes; data-dependent choice is
+    statically unknowable)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def f(pred, a):
+        return lax.cond(pred,
+                        lambda x: x @ x @ x,    # 2 matmuls
+                        lambda x: x @ x,        # 1 matmul
+                        a)
+
+    closed = jax.make_jaxpr(f)(True, jnp.zeros((8, 8)))
+    assert jaxpr_matmul_conv_flops(closed.jaxpr) == 2 * 2 * 8 * 8 * 8

@@ -347,7 +347,7 @@ class TestBf16ComputePath:
         np.random.seed(0)
         m = models.resnet18(num_classes=10, cifar_stem=True)
         m.set_optimizer(opt.SGD(lr=0.01, momentum=0.9))
-        x = tensor.Tensor(data=np.random.randn(4, 3, 32, 32).astype(np.float32),
+        x = tensor.Tensor(data=np.random.randn(4, 32, 32, 3).astype(np.float32),
                           device=dev)
         y = tensor.Tensor(data=np.random.randint(0, 10, 4).astype(np.int32),
                           device=dev)

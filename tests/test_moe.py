@@ -104,6 +104,69 @@ def _batch(n=32, d=8, seed=1):
             tensor.from_numpy(rng.randint(0, 4, n).astype(np.int32)))
 
 
+class TestDispatchModes:
+    """The scatter (single-chip) and einsum (EP wire format) dispatch
+    paths share one router and must be numerically equivalent —
+    including capacity drops and gradients (r4 VERDICT item 4: the
+    faster path must not change the math)."""
+
+    CASES = [
+        dict(capacity_factor=4.0, top_k=1, gate=False),   # ample, Switch
+        dict(capacity_factor=0.5, top_k=1, gate=False),   # tight: drops
+        dict(capacity_factor=4.0, top_k=2, gate=False),   # GShard top-2
+        dict(capacity_factor=0.6, top_k=2, gate=True),    # drops + SwiGLU
+    ]
+
+    @pytest.mark.parametrize("case", CASES)
+    def test_forward_and_grads_match(self, case):
+        x, rw, wi, wo = _toy(N=24, seed=7)
+        wg = (np.random.RandomState(9).randn(*wi.shape).astype(np.float32)
+              * 0.3) if case["gate"] else None
+
+        def run(mode):
+            def loss(rw, wi, wo):
+                out = moe_forward(
+                    jnp.asarray(x), rw, wi, wo,
+                    capacity_factor=case["capacity_factor"],
+                    top_k=case["top_k"],
+                    w_gate=None if wg is None else jnp.asarray(wg),
+                    dispatch_mode=mode)
+                return jnp.sum(out ** 2), out
+            (l, out), g = jax.value_and_grad(loss, argnums=(0, 1, 2),
+                                             has_aux=True)(
+                jnp.asarray(rw), jnp.asarray(wi), jnp.asarray(wo))
+            return np.asarray(out), [np.asarray(gi) for gi in g]
+
+        out_s, g_s = run("scatter")
+        out_e, g_e = run("einsum")
+        np.testing.assert_allclose(out_s, out_e, rtol=1e-5, atol=1e-6)
+        for a, b in zip(g_s, g_e):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    def test_auto_mode_selects_by_mesh(self):
+        """auto = scatter off-mesh, einsum under an 'expert' axis; both
+        agree with each other so auto is safe either way — this pins the
+        selection itself via the jaxpr (scatter primitives present)."""
+        x, rw, wi, wo = _toy()
+
+        def jaxpr_of(mode):
+            return str(jax.make_jaxpr(
+                lambda x, rw, wi, wo: moe_forward(x, rw, wi, wo, 2.0,
+                                                  dispatch_mode=mode))(
+                jnp.asarray(x), jnp.asarray(rw), jnp.asarray(wi),
+                jnp.asarray(wo)))
+
+        assert "scatter" in jaxpr_of("scatter")
+        assert "scatter" not in jaxpr_of("einsum")
+        # no mesh installed -> auto resolves to scatter
+        assert "scatter" in jaxpr_of("auto")
+        parallel.set_mesh(parallel.make_mesh({"expert": 4}))
+        try:
+            assert "scatter" not in jaxpr_of("auto")
+        finally:
+            parallel.set_mesh(None)
+
+
 class TestMoELayer:
     def test_trains_single_device(self):
         tensor.set_seed(0)

@@ -1,0 +1,56 @@
+"""CE-chunk sweep on chip: the fused-loss lax.scan runs 16384/chunk
+iterations and this platform taxes each ~1 ms (probe 5b), so bigger
+chunks should buy back most of that tax.
+
+Usage: nohup setsid python tools/ce_chunk_sweep.py > /tmp/ce_sweep.out 2>&1 &
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from singa_tpu import device, models, opt, tensor
+    from singa_tpu.utils.timing import windowed_steps
+
+    device.set_default_device(device.create_tpu_device())
+    for chunk in (512, 2048, 4096, 8192, 16384):
+        tensor.set_seed(0)
+        np.random.seed(0)
+        cfg = models.LlamaConfig.small()
+        cfg.fused_loss = True
+        cfg.fused_loss_chunk = chunk
+        m = models.Llama(cfg)
+        m.set_optimizer(opt.SGD(lr=0.01, momentum=0.9))
+        ids = tensor.from_numpy(np.random.randint(
+            0, cfg.vocab_size, (16, 1024)).astype(np.int32))
+        t0 = time.time()
+        m.compile([ids], is_train=True, use_graph=True)
+        out = m.train_step(ids)
+        np.asarray(out[-1].data)
+        t_compile = time.time() - t0
+
+        holder = {}
+
+        def one():
+            holder["out"] = m.train_step(ids)
+            return holder["out"][-1].data
+
+        dt, stats = windowed_steps(one, windows=3, window_len=8, warmup=1)
+        print(f"chunk {chunk:5d}: {dt*1e3:7.2f} ms/step "
+              f"({16384/dt:,.0f} tok/s)  compile {t_compile:.1f}s  "
+              f"windows {stats['window_ms']}", flush=True)
+        del m, holder
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,182 @@
+"""Probe 3 (r5): where do the headline's ~78 ms over roofline go?
+
+r4 established a "per-executed-op tax (~0.3-3.3 ms)" but never separated
+  (a) fixed per-DISPATCH overhead (tunnel RTT + runtime),
+  (b) per-EXECUTED-op overhead inside one compiled program,
+  (c) per-STATIC-op overhead (program size).
+These imply different fixes: (a) -> amortize dispatches (multi-step
+programs / unfenced windows); (b) -> fewer, fatter ops (fused QKV,
+bigger CE chunks); (c) -> scan-over-blocks (smaller program).
+
+Experiments (all medians of individually fenced calls unless noted):
+  1 null        : trivial jitted add                       -> dispatch floor
+  2 mm1         : one 2048^3 bf16 matmul                   -> floor + 0.09 ms
+  3 scan64      : lax.scan of 64 matmuls, ONE dispatch     (static 1, executed 64)
+  4 unroll64    : 64 chained matmuls, ONE dispatch         (static 64, executed 64)
+  5 unroll64s   : 64 chained 256^2 matmuls, ONE dispatch   (size-independence)
+  6 llama fenced: real small-llama train step, per-step fence (r4 headline method)
+  7 llama win8  : 8 back-to-back train_step calls, fence at end  -> per-step
+  8 llama scan8 : 8 steps inside ONE jitted lax.scan program     -> device floor
+
+Usage: cd /root/repo && nohup setsid python tools/dispatch_probe3.py \
+           > /tmp/probe3.out 2>&1 &
+"""
+from __future__ import annotations
+
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def med_fenced(fn, n=15):
+    jax.block_until_ready(fn())
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return {"med_ms": round(statistics.median(ts) * 1e3, 3),
+            "min_ms": round(ts[0] * 1e3, 3),
+            "max_ms": round(ts[-1] * 1e3, 3), "n": n}
+
+
+def say(tag, d):
+    print(f"{tag:14s} {d}", flush=True)
+
+
+def main():
+    print("device:", jax.devices()[0], flush=True)
+
+    # 1: dispatch floor
+    tiny = jnp.ones((8, 8), jnp.float32)
+    add = jax.jit(lambda x: x + 1)
+    say("null", med_fenced(lambda: add(tiny)))
+
+    # 2: one big matmul (2048^3 bf16 = 17.2 GFLOP -> 0.087 ms @197T)
+    x = jnp.ones((2048, 2048), jnp.bfloat16)
+    mm = jax.jit(lambda a: (a @ a).astype(jnp.bfloat16))
+    say("mm1", med_fenced(lambda: mm(x)))
+
+    # 3: scan of 64 matmuls, one dispatch (static 1 / executed 64)
+    scan_mm = jax.jit(lambda a: lax.scan(
+        lambda c, _: ((c @ a).astype(jnp.bfloat16), None),
+        a, None, length=64)[0])
+    d = med_fenced(lambda: scan_mm(x), n=8)
+    d["per_mm_ms"] = round(d["med_ms"] / 64, 3)
+    say("scan64", d)
+
+    # 4: 64 chained matmuls unrolled, one dispatch (static 64 / executed 64)
+    def unroll(a):
+        c = a
+        for _ in range(64):
+            c = (c @ a).astype(jnp.bfloat16)
+        return c
+    unroll_mm = jax.jit(unroll)
+    d = med_fenced(lambda: unroll_mm(x), n=8)
+    d["per_mm_ms"] = round(d["med_ms"] / 64, 3)
+    say("unroll64", d)
+
+    # 5: 64 chained SMALL matmuls (256^2: 0.03 GFLOP each — pure op tax)
+    xs = jnp.ones((256, 256), jnp.bfloat16)
+    unroll_s = jax.jit(lambda a: unroll(a))
+    d = med_fenced(lambda: unroll_s(xs), n=8)
+    d["per_mm_ms"] = round(d["med_ms"] / 64, 3)
+    say("unroll64s", d)
+
+    # --- real model: headline config -----------------------------------
+    from singa_tpu import device, models, opt, tensor
+
+    device.set_default_device(device.create_tpu_device())
+    tensor.set_seed(0)
+    np.random.seed(0)
+    cfg = models.LlamaConfig.small()
+    cfg.fused_loss = True
+    m = models.Llama(cfg)
+    m.set_optimizer(opt.SGD(lr=0.01, momentum=0.9))
+    ids = tensor.from_numpy(np.random.randint(
+        0, cfg.vocab_size, (16, 1024)).astype(np.int32))
+    t0 = time.time()
+    m.compile([ids], is_train=True, use_graph=True)
+    out = m.train_step(ids)
+    jax.block_until_ready(out[-1].data)
+    print(f"compile+first step: {time.time()-t0:.1f}s", flush=True)
+
+    # compiled-program size: executed-op proxy
+    try:
+        txt = m.graph.compiled.as_text()
+        n_instr = txt.count(" = ")
+        n_fusion = txt.count(" fusion(")
+        ent = txt.find("ENTRY")
+        n_entry = txt[ent:].split("\n\n")[0].count(" = ") if ent >= 0 else -1
+        print(f"hlo: total_instr={n_instr} fusions={n_fusion} "
+              f"entry_instr={n_entry}", flush=True)
+    except Exception as e:
+        print("hlo text unavailable:", type(e).__name__, e, flush=True)
+
+    # 6: per-step fenced (the r4 headline methodology)
+    def one():
+        o = m.train_step(ids)
+        return o[-1].data
+    say("llama_fenced", med_fenced(one, n=15))
+
+    # 7: windows of 8 back-to-back steps, fence only at the end
+    def win8():
+        for _ in range(8):
+            o = m.train_step(ids)
+        return o[-1].data
+    d = med_fenced(win8, n=6)
+    d["per_step_ms"] = round(d["med_ms"] / 8, 2)
+    say("llama_win8", d)
+
+    # 8: 8 steps inside ONE compiled program (lax.scan over the step fn)
+    ex = next(iter(m._executors.values()))
+    fn = ex._jitted.__wrapped__        # (params,buffers,slots,step,rng,*b)
+    K = 8
+
+    def multi(params, buffers, slots, step, rng, batch):
+        def body(c, _):
+            p, b, s, st = c
+            outs, p2, b2, s2 = fn(p, b, s, st, rng, *batch)
+            return (p2, b2, s2, st + 1), outs[-1]
+        (p, b, s, st), losses = lax.scan(
+            body, (params, buffers, slots, step), None, length=K)
+        return losses, p, b, s
+
+    jmulti = jax.jit(multi, donate_argnums=(0, 1, 2))
+    params = {n: t.data for n, t in ex.param_tensors.items()}
+    buffers = {n: t.data for n, t in ex.buffer_tensors.items()}
+    slots = ex.slots
+    step = jnp.asarray(0, jnp.int32)
+    rng = jax.random.PRNGKey(0)
+    t0 = time.time()
+    losses, params, buffers, slots = jmulti(params, buffers, slots, step,
+                                            rng, (ids.data,))
+    jax.block_until_ready(losses)
+    print(f"scan8 compile: {time.time()-t0:.1f}s", flush=True)
+    ts = []
+    for _ in range(8):
+        t0 = time.perf_counter()
+        losses, params, buffers, slots = jmulti(params, buffers, slots,
+                                                step, rng, (ids.data,))
+        jax.block_until_ready(losses)
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    med = statistics.median(ts)
+    print(f"llama_scan8    med {med*1e3:.1f} ms total, "
+          f"{med/K*1e3:.2f} ms/step  (min {ts[0]*1e3:.1f}, "
+          f"max {ts[-1]*1e3:.1f}) losses[-1]={float(losses[-1]):.4f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,157 @@
+"""Probe 4 (r5): validate the windowed methodology against un-fakeable
+single-program timing.
+
+Probe 3 found block_until_ready returning implausibly fast for small
+repeat-call programs on this backend, and the first windowed bench run
+produced a ResNet-50 number (38 ms for an 18.85-TFLOP step = 492
+TFLOP/s on a 197-peak chip) the silicon cannot do.  The arbiter here:
+K train steps compiled into ONE lax.scan program, wall-clocked over a
+single call with a TRUE host fetch (np.asarray of the scalar loss) —
+nothing to pipeline, nothing to mis-fence.
+
+For llama + resnet50 (bench shapes):
+  fenced_block   per-step, block_until_ready each step   (r1-r4 method)
+  fenced_fetch   per-step, np.asarray(loss) each step    (true fence)
+  win8_block     8 back-to-back, block_until_ready at end
+  win8_fetch     8 back-to-back, np.asarray at end
+  scanK          K steps in ONE program, np.asarray fence
+
+Usage: cd /root/repo && nohup setsid python tools/dispatch_probe4.py \
+           > /tmp/probe4.out 2>&1 &
+"""
+from __future__ import annotations
+
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def fetch(x):
+    return np.asarray(x).ravel()[0]
+
+
+def med(ts):
+    return statistics.median(ts)
+
+
+def time_model(name, m, batch, K=16, reps=6):
+    def one():
+        return m.train_step(*batch)[-1].data
+
+    # warmup (ensures compiled + steady)
+    fetch(one())
+
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(one())
+        ts.append(time.perf_counter() - t0)
+    print(f"{name} fenced_block : {med(ts)*1e3:8.1f} ms/step "
+          f"(min {min(ts)*1e3:.1f} max {max(ts)*1e3:.1f})", flush=True)
+
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fetch(one())
+        ts.append(time.perf_counter() - t0)
+    print(f"{name} fenced_fetch : {med(ts)*1e3:8.1f} ms/step "
+          f"(min {min(ts)*1e3:.1f} max {max(ts)*1e3:.1f})", flush=True)
+
+    for fname, fence in (("win8_block", jax.block_until_ready),
+                         ("win8_fetch", fetch)):
+        ts = []
+        for _ in range(4):
+            t0 = time.perf_counter()
+            for _ in range(8):
+                out = one()
+            fence(out)
+            ts.append(time.perf_counter() - t0)
+        print(f"{name} {fname:12s} : {med(ts)/8*1e3:8.1f} ms/step "
+              f"(windows {[round(t*1e3) for t in sorted(ts)]})", flush=True)
+
+    # K steps in ONE program
+    ex = next(iter(m._executors.values()))
+    fn = ex._jitted.__wrapped__
+    arrays = tuple(b.data for b in batch)
+
+    def multi(params, buffers, slots, step, rng, arrays):
+        def body(c, _):
+            p, b, s, st = c
+            outs, p2, b2, s2 = fn(p, b, s, st, rng, *arrays)
+            return (p2, b2, s2, st + 1), outs[-1]
+        (p, b, s, st), losses = lax.scan(
+            body, (params, buffers, slots, step), None, length=K)
+        return losses, p, b, s
+
+    jm = jax.jit(multi, donate_argnums=(0, 1, 2))
+    params = {n: t.data for n, t in ex.param_tensors.items()}
+    buffers = {n: t.data for n, t in ex.buffer_tensors.items()}
+    slots = ex.slots
+    step = jnp.asarray(0, jnp.int32)
+    rng = jax.random.PRNGKey(0)
+    t0 = time.time()
+    losses, params, buffers, slots = jm(params, buffers, slots, step, rng,
+                                        arrays)
+    fetch(losses)
+    print(f"{name} scan{K} compile+first: {time.time()-t0:.1f}s", flush=True)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        losses, params, buffers, slots = jm(params, buffers, slots, step,
+                                            rng, arrays)
+        fetch(losses)
+        ts.append(time.perf_counter() - t0)
+    print(f"{name} scan{K}       : {med(ts)/K*1e3:8.1f} ms/step "
+          f"(calls {[round(t*1e3) for t in sorted(ts)]}, "
+          f"loss[0]={float(losses[0]):.4f} loss[-1]={float(losses[-1]):.4f})",
+          flush=True)
+
+
+def main():
+    print("device:", jax.devices()[0], flush=True)
+    from singa_tpu import device, models, opt, tensor
+
+    device.set_default_device(device.create_tpu_device())
+
+    # --- llama headline shape ---
+    tensor.set_seed(0)
+    np.random.seed(0)
+    cfg = models.LlamaConfig.small()
+    cfg.fused_loss = True
+    m = models.Llama(cfg)
+    m.set_optimizer(opt.SGD(lr=0.01, momentum=0.9))
+    ids = tensor.from_numpy(np.random.randint(
+        0, cfg.vocab_size, (16, 1024)).astype(np.int32))
+    t0 = time.time()
+    m.compile([ids], is_train=True, use_graph=True)
+    fetch(m.train_step(ids)[-1].data)
+    print(f"llama compile: {time.time()-t0:.1f}s", flush=True)
+    time_model("llama", m, (ids,), K=16)
+
+    # --- resnet50 bench shape ---
+    tensor.set_seed(0)
+    np.random.seed(0)
+    r = models.resnet50(num_classes=1000, cifar_stem=False)
+    r.set_optimizer(opt.SGD(lr=0.1, momentum=0.9, weight_decay=1e-4))
+    # NHWC — the zoo's layout (the NCHW feed here was the r1-r4 bug)
+    x = tensor.from_numpy(np.random.randn(1536, 224, 224, 3)
+                          .astype(np.float32))
+    y = tensor.from_numpy(np.random.randint(0, 10, (1536,)).astype(np.int32))
+    t0 = time.time()
+    r.compile([x], is_train=True, use_graph=True)
+    fetch(r.train_step(x, y)[-1].data)
+    print(f"resnet compile: {time.time()-t0:.1f}s", flush=True)
+    time_model("resnet", r, (x, y), K=8)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""Probe 5 (r5): why did the matmul microbench sustain only 9.5 TFLOP/s
+when the llama step sustains ~92 analytic?
+
+Variants (all true-fenced with a host fetch, inputs VARIED across calls
+to defeat the repeat-call memoization probe 3 exposed):
+  mm4096        one 4096^3 bf16 matmul               (137.4 GFLOP)
+  mm8192        one 8192^3 bf16 matmul               (1.1 TFLOP)
+  mm16384       one 16384^3 bf16 matmul              (8.8 TFLOP; r4
+                measured 136 TFLOP/s at this size)
+  unroll16      16 chained 4096^3, one program
+  scan64        lax.scan of 64 chained 4096^3        (the microbench)
+  scan64_f32acc same but preferred_element_type f32
+
+Usage: nohup setsid python tools/dispatch_probe5.py > /tmp/probe5.out 2>&1 &
+"""
+from __future__ import annotations
+
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def fetch(x):
+    return np.asarray(x).ravel()[0]
+
+
+def bench(tag, f, xs, flops, reps=6):
+    fetch(f(xs[0]))
+    ts = []
+    for i in range(reps):
+        x = xs[i % len(xs)]
+        t0 = time.perf_counter()
+        fetch(f(x))
+        ts.append(time.perf_counter() - t0)
+    dt = statistics.median(ts)
+    print(f"{tag:16s} {dt*1e3:9.2f} ms  {flops/dt/1e12:7.1f} TFLOP/s "
+          f"(min {min(ts)*1e3:.2f} max {max(ts)*1e3:.2f})", flush=True)
+
+
+def mk(n, k=3):
+    rng = np.random.RandomState(0)
+    base = (rng.randn(n, n) / np.sqrt(n)).astype(np.float32)
+    return [jnp.asarray(base * (1.0 + 1e-3 * i), jnp.bfloat16)
+            for i in range(k)]
+
+
+def main():
+    print("device:", jax.devices()[0], flush=True)
+
+    # every jitted fn returns a SCALAR: fetching a full (n, n) result
+    # over the ~12 MB/s tunnel costs seconds and was exactly the bug in
+    # the first microbench (32 MB fetch read as "9.5 TFLOP/s")
+    for n in (4096, 8192, 16384):
+        xs = mk(n)
+        f = jax.jit(lambda a: (a @ a).astype(jnp.float32).sum())
+        bench(f"mm{n}", f, xs, 2.0 * n ** 3)
+
+    xs = mk(4096)
+
+    def unroll(a):
+        c = a
+        for _ in range(16):
+            c = (c @ a).astype(jnp.bfloat16)
+        return c.astype(jnp.float32).sum()
+
+    bench("unroll16", jax.jit(unroll), xs, 16 * 2.0 * 4096 ** 3)
+
+    def scan64(a):
+        return lax.scan(lambda c, _: ((c @ a).astype(jnp.bfloat16), None),
+                        a, None, length=64)[0].astype(jnp.float32).sum()
+
+    bench("scan64", jax.jit(scan64), xs, 64 * 2.0 * 4096 ** 3, reps=3)
+
+    def scan64_f32(a):
+        def body(c, _):
+            y = jax.lax.dot_general(c, a, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            return y.astype(jnp.bfloat16), None
+        return lax.scan(body, a, None, length=64)[0] \
+            .astype(jnp.float32).sum()
+
+    bench("scan64_f32acc", jax.jit(scan64_f32), xs, 64 * 2.0 * 4096 ** 3,
+          reps=3)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""Probe 5b: calibrate sustained matmul rate with LLAMA-SHAPED matmuls
+(probe 5's square 4096^3 scan chain sustained only ~9 TFLOP/s while the
+real llama step sustains ~92 — either square chains hit a tunnel/
+virtualization pathology or the llama numerator is wrong; probe 5b + a
+traced-jaxpr FLOP count of the train step settle which).
+
+  lmhead16   16 x (16384x768 @ 768x32000) chained   12.88 TFLOP/program
+  proj64     64 x (16384x768 @ 768x768)  chained     1.24 TFLOP/program
+  sq1024x64  64 x (1024^3) scan chain                0.14 TFLOP (count
+             vs size discrimination for the probe-5 anomaly)
+
+All fns reduce to a scalar in-program; true host-fetch fence.
+
+Usage: nohup setsid python tools/dispatch_probe5b.py > /tmp/probe5b.out 2>&1 &
+"""
+from __future__ import annotations
+
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def fetch(x):
+    return np.asarray(x).ravel()[0]
+
+
+def bench(tag, f, args, flops, reps=5):
+    fetch(f(*args))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fetch(f(*args))
+        ts.append(time.perf_counter() - t0)
+    dt = statistics.median(ts)
+    print(f"{tag:12s} {dt*1e3:9.2f} ms  {flops/dt/1e12:7.1f} TFLOP/s "
+          f"(min {min(ts)*1e3:.2f} max {max(ts)*1e3:.2f})", flush=True)
+
+
+def main():
+    print("device:", jax.devices()[0], flush=True)
+    rng = np.random.RandomState(0)
+    B, D, V = 16384, 768, 32000
+    x = jnp.asarray(rng.randn(B, D).astype(np.float32) / 28,
+                    jnp.bfloat16)
+    w_head = jnp.asarray(rng.randn(D, V).astype(np.float32) / 28,
+                         jnp.bfloat16)
+    w_back = jnp.asarray(rng.randn(V, D).astype(np.float32) / 180,
+                         jnp.bfloat16)
+    w_proj = jnp.asarray(rng.randn(D, D).astype(np.float32) / 28,
+                         jnp.bfloat16)
+
+    def lmhead16(x, wh, wb):
+        c = x
+        for _ in range(8):
+            y = (c @ wh).astype(jnp.bfloat16)     # (B, V)
+            c = (y @ wb).astype(jnp.bfloat16)     # (B, D)
+        return c.astype(jnp.float32).sum()
+
+    fl = 8 * (2.0 * B * D * V + 2.0 * B * V * D)
+    bench("lmhead16", jax.jit(lmhead16), (x, w_head, w_back), fl)
+
+    def proj64(x, w):
+        def body(c, _):
+            return (c @ w).astype(jnp.bfloat16), None
+        return lax.scan(body, x, None, length=64)[0] \
+            .astype(jnp.float32).sum()
+
+    bench("proj64", jax.jit(proj64), (x, w_proj), 64 * 2.0 * B * D * D)
+
+    s = jnp.asarray(rng.randn(1024, 1024).astype(np.float32) / 32,
+                    jnp.bfloat16)
+
+    def sq1024x64(a):
+        def body(c, _):
+            return (c @ a).astype(jnp.bfloat16), None
+        return lax.scan(body, a, None, length=64)[0] \
+            .astype(jnp.float32).sum()
+
+    bench("sq1024x64", jax.jit(sq1024x64), (s,), 64 * 2.0 * 1024 ** 3)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Flagship-config sweep: honest-MFU (traced-FLOPs numerator) of
+larger single-chip Llama configs.  The 110M `small` config has weak
+arithmetic intensity (dim 768); a right-sized config keeps the MXU
+busier per HBM byte.
+
+Usage: nohup setsid python tools/flagship_sweep.py > /tmp/flagship.out 2>&1 &
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+
+def main():
+    from singa_tpu import device, models, opt, tensor
+    from singa_tpu.utils.metrics import peak_flops
+    from singa_tpu.utils.timing import windowed_steps
+
+    device.set_default_device(device.create_tpu_device())
+    peak = peak_flops("TPU v5 lite")
+
+    cases = [
+        ("small-768x12 b16", dict(vocab_size=32000, dim=768, num_layers=12,
+                                  num_heads=12, num_kv_heads=4,
+                                  ffn_dim=2048, max_position=2048), 16),
+        ("mid-1536x16 b8", dict(vocab_size=32000, dim=1536, num_layers=16,
+                                num_heads=16, num_kv_heads=8,
+                                ffn_dim=4096, max_position=2048), 8),
+        ("big-2048x16 b8", dict(vocab_size=32000, dim=2048, num_layers=16,
+                                num_heads=16, num_kv_heads=8,
+                                ffn_dim=5632, max_position=2048), 8),
+        ("big-2048x24 b8", dict(vocab_size=32000, dim=2048, num_layers=24,
+                                num_heads=16, num_kv_heads=8,
+                                ffn_dim=5632, max_position=2048), 8),
+    ]
+    T = 1024
+    for name, kw, B in cases:
+        try:
+            tensor.set_seed(0)
+            np.random.seed(0)
+            cfg = models.LlamaConfig(**kw)
+            cfg.fused_loss = True
+            m = models.Llama(cfg)
+            m.set_optimizer(opt.SGD(lr=0.01, momentum=0.9))
+            ids = tensor.from_numpy(np.random.randint(
+                0, cfg.vocab_size, (B, T)).astype(np.int32))
+            t0 = time.time()
+            m.compile([ids], is_train=True, use_graph=True)
+            out = m.train_step(ids)
+            np.asarray(out[-1].data)
+            t_compile = time.time() - t0
+
+            holder = {}
+
+            def one():
+                holder["out"] = m.train_step(ids)
+                return holder["out"][-1].data
+
+            dt, stats = windowed_steps(one, windows=3, window_len=8,
+                                       warmup=1)
+            n = m.num_params()
+            n_emb = cfg.vocab_size * cfg.dim     # tok_emb gather, no FLOPs
+            fl_tok = (6 * (n - n_emb) + 12 * cfg.num_layers * cfg.dim * T
+                      + 2 * cfg.dim * cfg.vocab_size)
+            fl = fl_tok * B * T
+            print(f"{name:18s} params {n/1e6:6.1f}M  {dt*1e3:8.2f} ms/step "
+                  f"{B*T/dt:9,.0f} tok/s  MFU(hon) {fl/dt/peak:.4f}  "
+                  f"compile {t_compile:.0f}s  windows {stats['window_ms']}",
+                  flush=True)
+            del m, holder
+        except Exception as e:  # noqa: BLE001
+            print(f"{name:18s} FAILED {type(e).__name__}: "
+                  f"{str(e).splitlines()[0][:160]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Print the zoo vision models' exact traced FLOP counts
+(singa_tpu.utils.flops) next to the published reference numbers.
+
+This audit caught the r1-r4 ResNet bench feeding NCHW images into the
+NHWC zoo (the "ResNet-50" being benchmarked computed 0.83 GFLOP/image
+instead of 4.1).  tests/test_flops.py pins the corrected counts.
+
+Usage: python tools/flops_count.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main():
+    from singa_tpu import models, tensor
+    from singa_tpu.utils.flops import model_forward_flops
+
+    tensor.set_seed(0)
+    np.random.seed(0)
+
+    # (name, model, NHWC shape, published fwd GFLOP/image (2 FLOPs per MAC))
+    cases = (
+        ("resnet50@224", models.resnet50(num_classes=1000,
+                                         cifar_stem=False),
+         (1, 224, 224, 3), 8.18),
+        ("resnet18-cifar@32", models.resnet18(num_classes=10,
+                                              cifar_stem=True),
+         (1, 32, 32, 3), 1.11),
+        ("vgg11@32", models.vgg11(num_classes=10), (1, 32, 32, 3), 0.31),
+    )
+    for name, m, shape, pub in cases:
+        x = tensor.from_numpy(np.random.randn(*shape).astype(np.float32))
+        m.compile([x], is_train=False, use_graph=False)
+        f = model_forward_flops(m, x)
+        print(f"{name}: forward {f/1e9:.3f} GFLOP/image "
+              f"(published ~{pub}; train ~= 3x = {3*f/1e9:.2f})", flush=True)
+
+
+if __name__ == "__main__":
+    main()
