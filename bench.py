@@ -727,20 +727,47 @@ def main() -> None:
         time.sleep(wait)
 
     headline = None
+    platform = None
     if usable:
         headline = _run_sub("tpu", tpu_timeout)
+        platform = "tpu" if headline is not None else None
     if headline is None:
         print("# no TPU headline; running the suite on CPU",
               file=sys.stderr)
         headline = _run_sub("cpu", cpu_timeout)
+        platform = "cpu" if headline is not None else None
     if headline is None:
         headline = json.dumps({"metric": "llama_train_tokens_per_sec",
                                "value": 0.0, "unit": "tokens/s",
                                "vs_baseline": 0.0})
+        platform = "none"
+    _record_bench(headline, platform)
     # The driver parses a bounded tail of this process's output
     # (BENCH_r03: stderr noise after the early headline pushed it out of
     # the capture).  The LAST stdout line is always the headline JSON.
     print(headline, flush=True)
+
+
+def _record_bench(headline: str, platform: str) -> None:
+    """Append this bench run to the durable run-record store
+    (singa_tpu.obs.record) so every headline has a committed,
+    schema-validated artifact.  CPU fallbacks append as smoke entries —
+    the store and its consumers never let them shadow on-chip runs.
+    Never fatal: the stdout contract outranks telemetry."""
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from singa_tpu.obs import record as obs_record
+        entry = obs_record.new_entry(
+            "bench", platform, platform != "tpu", platform,
+            run_id=obs_record.new_run_id("bench"),
+            payload={"headline": json.loads(headline)})
+        store = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             obs_record.DEFAULT_STORE)
+        obs_record.RunRecord(store).append(entry)
+        print(f"# bench entry appended to {store}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001
+        print(f"# bench store append failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

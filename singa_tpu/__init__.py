@@ -13,6 +13,7 @@ scripts run with only the device line changed.
 
 __version__ = "0.3.0"
 
+from . import _compat  # jax version shims — must run before submodules
 from . import device
 from . import proto
 from . import tensor
@@ -21,12 +22,13 @@ from . import layer
 from . import model
 from . import opt
 from . import graph
+from . import obs
 from . import ops
 from . import parallel
 from . import utils
 
 __all__ = ["device", "proto", "tensor", "autograd", "layer", "model", "opt",
-           "graph", "ops", "parallel", "utils", "sonnx", "models"]
+           "graph", "obs", "ops", "parallel", "utils", "sonnx", "models"]
 
 
 def __getattr__(name):

@@ -1,0 +1,54 @@
+"""JAX version compatibility shims, applied at singa_tpu import time.
+
+This image family pins different jax versions across rounds; the code
+(and the test suite) targets the modern public API.  Shims only ever
+ADD missing attributes — a jax that already provides the API is left
+completely untouched.
+
+* ``jax.shard_map`` — promoted from ``jax.experimental.shard_map`` on
+  jax < 0.4.35-era builds, adapting the modern ``check_vma`` kwarg to
+  the older ``check_rep`` spelling.  Without this, every
+  shard_map-based path (the dist executor, pipeline/spmd tests, the
+  multiprocess workers) fails with AttributeError on such images.
+* ``jax.lax.axis_size`` — the modern static axis-size query; on older
+  builds ``jax.core.axis_frame(name)`` carries the same static int.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["apply"]
+
+
+def apply() -> None:
+    if not hasattr(jax, "shard_map"):
+        try:
+            from jax.experimental.shard_map import shard_map as _shard_map
+        except ImportError:  # pragma: no cover - very old jax
+            return
+
+        def shard_map(f=None, /, *, mesh=None, in_specs=None,
+                      out_specs=None, check_vma=None, **kw):
+            if check_vma is not None and "check_rep" not in kw:
+                kw["check_rep"] = check_vma
+
+            def bind(fn):
+                return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                  out_specs=out_specs, **kw)
+
+            # modern API supports both direct and decorator usage
+            return bind if f is None else bind(f)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.lax, "axis_size"):
+        def axis_size(axis_name):
+            frame = jax.core.axis_frame(axis_name)
+            # modern axis_frame returns a frame object; this era an int
+            return frame.size if hasattr(frame, "size") else int(frame)
+
+        jax.lax.axis_size = axis_size
+
+
+apply()

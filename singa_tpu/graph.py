@@ -71,8 +71,10 @@ class CapturedGraph:
         """XLA cost analysis of the compiled module (flops, bytes)."""
         if self.compiled is None:
             return {}
+        from .obs import events as obs_events
         try:
-            ca = self.compiled.cost_analysis()
+            with obs_events.span("graph.cost_analysis", graph=self.name):
+                ca = self.compiled.cost_analysis()
             if isinstance(ca, list):
                 ca = ca[0] if ca else {}
             return dict(ca)
@@ -103,41 +105,45 @@ class CapturedGraph:
         .order, .arena_bytes, .num_nodes — the reference Graph/Scheduler's
         introspection surface, TPU-side scheduling stays XLA's."""
         from . import _core
+        from .obs import events as obs_events
         if not _core.available():
             raise RuntimeError("native core unavailable")
         cj = self.jaxpr
         if cj is None:
             raise RuntimeError("no jaxpr captured for this graph")
         jaxpr = cj.jaxpr
-        ng = _core.NativeGraph()
-        buf_ids = {}
+        with obs_events.span("graph.schedule", graph=self.name,
+                             eqns=len(jaxpr.eqns)):
+            ng = _core.NativeGraph()
+            buf_ids = {}
 
-        def bid(v):
-            key = id(v)
-            if key not in buf_ids:
-                buf_ids[key] = len(buf_ids)
-            return buf_ids[key]
+            def bid(v):
+                key = id(v)
+                if key not in buf_ids:
+                    buf_ids[key] = len(buf_ids)
+                return buf_ids[key]
 
-        for v in jaxpr.invars:
-            bid(v)
-        for eqn in jaxpr.eqns:
-            # Literals carry .val; Vars don't — version-stable check
-            ins = [bid(v) for v in eqn.invars if not hasattr(v, "val")]
-            outs = [bid(v) for v in eqn.outvars]
-            sizes = [int(np.prod(v.aval.shape)) * v.aval.dtype.itemsize
-                     for v in eqn.outvars]
-            ng.add_node(eqn.primitive.name, ins, outs, sizes)
-        # sink node: jaxpr outputs are read after the last eqn, so their
-        # buffers must stay live to the end of the plan (replay returns
-        # arena views of them)
-        sink_ins = [bid(v) for v in jaxpr.outvars if not hasattr(v, "val")]
-        if sink_ins:
-            ng.add_node("__sink__", sink_ins, [], [], 0)
-        order = ng.toposort()
-        arena, offsets = ng.plan_memory()
-        return Schedule(order=order, arena_bytes=arena,
-                        num_nodes=ng.num_nodes, buffer_offsets=offsets,
-                        closed_jaxpr=cj, var_buf=buf_ids)
+            for v in jaxpr.invars:
+                bid(v)
+            for eqn in jaxpr.eqns:
+                # Literals carry .val; Vars don't — version-stable check
+                ins = [bid(v) for v in eqn.invars if not hasattr(v, "val")]
+                outs = [bid(v) for v in eqn.outvars]
+                sizes = [int(np.prod(v.aval.shape)) * v.aval.dtype.itemsize
+                         for v in eqn.outvars]
+                ng.add_node(eqn.primitive.name, ins, outs, sizes)
+            # sink node: jaxpr outputs are read after the last eqn, so
+            # their buffers must stay live to the end of the plan (replay
+            # returns arena views of them)
+            sink_ins = [bid(v) for v in jaxpr.outvars
+                        if not hasattr(v, "val")]
+            if sink_ins:
+                ng.add_node("__sink__", sink_ins, [], [], 0)
+            order = ng.toposort()
+            arena, offsets = ng.plan_memory()
+            return Schedule(order=order, arena_bytes=arena,
+                            num_nodes=ng.num_nodes, buffer_offsets=offsets,
+                            closed_jaxpr=cj, var_buf=buf_ids)
 
     def __repr__(self):
         return f"<CapturedGraph {self.name}: {self.num_ops} ops>"

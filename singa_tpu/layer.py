@@ -262,9 +262,18 @@ class Conv2d(Layer):
         # when the other axis looks far more channel-like.
         if len(x.shape) == 4 and self.in_channels is None:
             other = x.shape[1 if self.data_format == "NHWC" else -1]
+            # the spatial dim adjacent to the claimed channel axis: if
+            # the input really is the OTHER layout, the claimed-channel
+            # axis is a spatial dim and (for the common square-image
+            # case) equals its neighbour
+            neighbor = x.shape[-2 if self.data_format == "NHWC" else 2]
             # 1/3 = gray/RGB; deeper feature maps legitimately shrink to
-            # tiny spatial dims, so 2/4 etc. stay silent
-            if other in (1, 3) and in_c > 8:
+            # tiny spatial dims, so 2/4 etc. stay silent.  Requiring the
+            # suspect axis to LOOK spatial (== its neighbour) silences
+            # the false positive on genuine NHWC inputs with spatial
+            # height 1 or 3 and many channels, e.g. (N, 1, W, C)
+            # spectrogram rows (ADVICE r5).
+            if other in (1, 3) and in_c > 8 and in_c == neighbor:
                 import warnings
                 warnings.warn(
                     f"Conv2d(data_format={self.data_format!r}) sees input "
@@ -667,17 +676,19 @@ class MultiHeadAttention(Layer):
 
 
 class _MoEOp(autograd.Operator):
-    def __init__(self, cf, top_k=1, swiglu=False):
+    def __init__(self, cf, top_k=1, swiglu=False, dispatch_mode="auto"):
         super().__init__()
         self.cf = cf
         self.top_k = top_k
         self.swiglu = swiglu
+        self.dispatch_mode = dispatch_mode
 
     def fwd(self, xa, rw, wi, wo, *wg):
         from .ops.moe import moe_forward
         out, aux = moe_forward(xa, rw, wi, wo, self.cf, return_aux=True,
                                top_k=self.top_k,
-                               w_gate=wg[0] if self.swiglu else None)
+                               w_gate=wg[0] if self.swiglu else None,
+                               dispatch_mode=self.dispatch_mode)
         return out, aux
 
 
@@ -704,18 +715,25 @@ class MoE(Layer):
 
     def __init__(self, num_experts: int, ffn_dim: int,
                  capacity_factor: float = 1.25, top_k: int = 1,
-                 act: str = "relu", name=None):
+                 act: str = "relu", dispatch_mode: str = "auto", name=None):
         super().__init__(name)
         if not 1 <= top_k <= num_experts:
             raise ValueError(
                 f"top_k={top_k} outside [1, num_experts={num_experts}]")
         if act not in ("relu", "swiglu"):
             raise ValueError(f"MoE act must be relu or swiglu, got {act!r}")
+        if dispatch_mode not in ("auto", "scatter", "einsum"):
+            raise ValueError(f"dispatch_mode must be auto/scatter/einsum, "
+                             f"got {dispatch_mode!r}")
         self.num_experts = num_experts
         self.ffn_dim = ffn_dim
         self.capacity_factor = capacity_factor
         self.top_k = top_k
         self.act = act
+        # explicit token-movement choice (ops/moe.py docstring): 'auto'
+        # resolves the global mesh at trace time — pass scatter/einsum
+        # to pin the form independent of when the mesh is installed
+        self.dispatch_mode = dispatch_mode
         self._aux_losses: List[Tensor] = []
 
     def initialize(self, x: Tensor):
@@ -739,7 +757,7 @@ class MoE(Layer):
         # router stays f32 master: moe_forward computes routing in f32
         extra = (self.w_gate,) if self.act == "swiglu" else ()
         out, aux = _MoEOp(self.capacity_factor, self.top_k,
-                          self.act == "swiglu")(
+                          self.act == "swiglu", self.dispatch_mode)(
             x, self.router, self.w_in, self.w_out, *extra)
         # accumulate only in training: eval/compile-time dry runs must
         # not leave stale entries (an init-trace tracer here would crash

@@ -40,6 +40,7 @@ import numpy as np
 from . import autograd
 from . import tensor as tensor_mod
 from .graph import CapturedGraph
+from .obs import events as obs_events
 from .layer import Layer
 from .opt import DistOpt, Optimizer
 from .tensor import Tensor
@@ -232,11 +233,21 @@ class Model(Layer):
         return super().__call__(*xs)
 
     def train_step(self, *batch):
-        """Run train_one_batch — compiled when graph mode is on."""
+        """Run train_one_batch — compiled when graph mode is on.
+
+        Telemetry: each call is a ``model.train_step`` span (obs.events;
+        host wall clock — dispatch is async, see events docstring)."""
         self.train(True)
-        if self.graph_mode:
-            return self._run_graph("train", self._train_body, batch)
-        return self.train_one_batch(*batch)
+        with obs_events.span("model.train_step", model=self.name,
+                             step=self._step_count,
+                             compiled=self.graph_mode):
+            if self.graph_mode:
+                return self._run_graph("train", self._train_body, batch)
+            out = self.train_one_batch(*batch)
+            # the compiled path's executor advances the counter; the
+            # eager path must too, or every eager span reports step=0
+            self._step_count += 1
+            return out
 
     def _train_body(self, batch_tensors):
         return self.train_one_batch(*batch_tensors)
@@ -603,9 +614,11 @@ class _StepExecutor:
             buffers = {n: _unshard(a) for n, a in buffers.items()}
             self.slots = jax.tree.map(_unshard, self.slots)
         if self.captured is None:
-            lowered = self._jitted.lower(params, buffers, self.slots, step,
-                                         rng, *batch_arrays)
-            compiled = lowered.compile()
+            with obs_events.span("graph.compile",
+                                 graph=f"{m.name}.{self.tag}"):
+                lowered = self._jitted.lower(params, buffers, self.slots,
+                                             step, rng, *batch_arrays)
+                compiled = lowered.compile()
             # lazy jaxpr capture (shapes only — safe w.r.t. donation)
             absargs = jax.tree.map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
@@ -620,8 +633,10 @@ class _StepExecutor:
             self.captured = CapturedGraph(f"{m.name}.{self.tag}",
                                           lowered=lowered, compiled=compiled,
                                           jaxpr_thunk=jaxpr_thunk)
-        outs, new_params, new_buffers, new_slots = self._jitted(
-            params, buffers, self.slots, step, rng, *batch_arrays)
+        with obs_events.span("graph.execute",
+                             graph=f"{m.name}.{self.tag}", step=step_host):
+            outs, new_params, new_buffers, new_slots = self._jitted(
+                params, buffers, self.slots, step, rng, *batch_arrays)
         # rebind updated state into the live tensors
         for n, t in self.param_tensors.items():
             t.data = new_params[n]

@@ -1,0 +1,29 @@
+"""singa_tpu.obs — durable run records + structured telemetry.
+
+The observability subsystem (ISSUE 1):
+
+* :mod:`~singa_tpu.obs.schema` — versioned field contracts for every
+  committed telemetry artifact; ``require()`` gives consumers
+  named-field errors instead of KeyError.
+* :mod:`~singa_tpu.obs.record` — :class:`RunRecord`, the append-only
+  JSONL store of bench/session runs keyed by
+  ``(run_id, platform, smoke)`` with atomic write-temp-then-rename;
+  smoke/CPU entries can never overwrite or shadow on-chip entries.
+* :mod:`~singa_tpu.obs.events` — ``trace_span`` / ``counter`` /
+  ``gauge`` with a JSONL sink and optional ``jax.profiler``
+  annotation passthrough, wired into the compiled-step, collective,
+  and grad-sync hot paths.
+
+See docs/observability.md for the schema and the smoke-vs-chip
+protection rule.
+"""
+
+from . import events, record, schema
+from .events import configure, counter, gauge, span, trace_span
+from .record import RunRecord, is_onchip_session_doc, new_entry, new_run_id
+from .schema import SCHEMA_VERSION, SchemaError, require
+
+__all__ = ["schema", "record", "events", "RunRecord", "SchemaError",
+           "SCHEMA_VERSION", "require", "new_entry", "new_run_id",
+           "is_onchip_session_doc", "configure", "counter", "gauge",
+           "span", "trace_span"]

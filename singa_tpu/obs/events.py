@@ -1,0 +1,214 @@
+"""Structured telemetry events: spans, counters, gauges.
+
+A thin host-side event layer over the hot paths (compiled-step
+dispatch, XLA compiles, collective staging, grad sync).  Disabled by
+default and engineered so the disabled path costs one attribute check —
+`span()` returns a shared no-op context manager and `counter()/gauge()`
+return immediately — because `Model.train_step` calls into here every
+step.
+
+Enable with either:
+
+* ``SINGA_OBS=/path/to/events.jsonl`` in the environment (one JSON
+  object per line), or programmatically ``events.configure(path=...)``;
+* ``SINGA_OBS_XPROF=1`` to additionally wrap spans in
+  ``jax.profiler.TraceAnnotation`` so they show up on the XProf/
+  TensorBoard timeline next to the device trace.
+
+Semantics worth knowing before reading the numbers:
+
+* **span durations are host-side wall clock.**  JAX dispatch is async:
+  a span around a compiled step measures time-to-dispatch (plus any
+  blocking fetch the caller does inside), not device time.  Device
+  time comes from ``utils.timing`` (true-fenced windows) or the XProf
+  trace — spans tell you *what ran when* and catch multi-second stalls
+  (compiles, tunnel weather), they are not an MFU instrument.
+* **collective counters fire at trace time.**  ``comm.*.bytes``
+  counters are emitted while XLA traces the step — once per compile,
+  not once per execution — because the collectives themselves are
+  in-graph ops.  They record the *staged* payload sizes (what the
+  wire will carry every step), which is the quantity the parallel
+  layer's bandwidth accounting needs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+__all__ = ["JsonlSink", "configure", "enabled", "get_sink", "span",
+           "trace_span", "counter", "gauge"]
+
+
+class JsonlSink:
+    """Append events to a JSONL file (thread-safe, line-buffered)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a")
+        self._lock = threading.Lock()
+
+    def emit(self, event: Dict[str, Any]) -> None:
+        line = json.dumps(event, sort_keys=True, default=_jsonable)
+        with self._lock:
+            if self._f.closed:
+                return
+            try:
+                self._f.write(line + "\n")
+                self._f.flush()
+            except (OSError, ValueError):
+                # disk full / fd gone mid-run: telemetry degrades, the
+                # training loop it instruments must never die for it
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+
+def _jsonable(v):
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return repr(v)
+
+
+_sink: Optional[JsonlSink] = None
+_annotate = False
+
+
+def configure(sink: Optional[JsonlSink] = None, path: Optional[str] = None,
+              annotate: Optional[bool] = None) -> None:
+    """Install/replace the event sink and/or the XProf annotation flag.
+
+    ``configure()`` with no arguments disables the JSONL sink (closing
+    the old one) and leaves annotation untouched."""
+    global _sink, _annotate
+    old = _sink
+    if path is not None:
+        sink = JsonlSink(path)
+    _sink = sink
+    if annotate is not None:
+        _annotate = bool(annotate)
+    if old is not None and old is not _sink:
+        old.close()
+
+
+def _init_from_env() -> None:
+    path = os.environ.get("SINGA_OBS")
+    if path:
+        try:
+            configure(path=path)
+        except OSError:  # unwritable path must never break training
+            pass
+    if os.environ.get("SINGA_OBS_XPROF") == "1":
+        configure(sink=_sink, annotate=True)
+
+
+def enabled() -> bool:
+    """Cheap hot-path check: is any telemetry consumer installed?"""
+    return _sink is not None or _annotate
+
+
+def get_sink() -> Optional[JsonlSink]:
+    return _sink
+
+
+def _emit(kind: str, name: str, attrs: Dict[str, Any]) -> None:
+    if _sink is None:
+        return
+    ev = {"t": time.time(), "kind": kind, "name": name}
+    ev.update(attrs)
+    _sink.emit(ev)
+
+
+def counter(name: str, value, **attrs) -> None:
+    """A monotonically-accumulating quantity (bytes moved, steps run)."""
+    if _sink is not None:
+        attrs["value"] = value
+        _emit("counter", name, attrs)
+
+
+def gauge(name: str, value, **attrs) -> None:
+    """A point-in-time level (loss, queue depth, HBM headroom)."""
+    if _sink is not None:
+        attrs["value"] = value
+        _emit("gauge", name, attrs)
+
+
+class _NullCtx:
+    """Shared no-op context manager for the disabled fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullCtx()
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "_t0", "_ann")
+
+    def __init__(self, name: str, attrs: Dict[str, Any]):
+        self.name = name
+        self.attrs = attrs
+        self._t0 = 0.0
+        self._ann = None
+
+    def __enter__(self):
+        if _annotate:
+            try:
+                import jax
+                self._ann = jax.profiler.TraceAnnotation(self.name)
+                self._ann.__enter__()
+            except Exception:  # profiler optional; never break the step
+                self._ann = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self._t0
+        if self._ann is not None:
+            with contextlib.suppress(Exception):
+                self._ann.__exit__(exc_type, exc, tb)
+        attrs = self.attrs
+        attrs["dur_ms"] = round(dur * 1e3, 3)
+        if exc_type is not None:
+            attrs["error"] = exc_type.__name__
+        _emit("span", self.name, attrs)
+        return False
+
+
+def span(name: str, **attrs):
+    """Context manager timing a host-side region.
+
+        with events.span("graph.compile", graph="llama.train"):
+            compiled = lowered.compile()
+
+    Emits ``{"kind": "span", "name": ..., "dur_ms": ...}`` to the sink
+    and (with SINGA_OBS_XPROF=1) annotates the XProf timeline.  Returns
+    a shared no-op context when telemetry is disabled."""
+    if _sink is None and not _annotate:
+        return _NULL
+    return _Span(name, attrs)
+
+
+#: alias matching the subsystem spec (`trace_span` in ISSUE.md)
+trace_span = span
+
+_init_from_env()

@@ -1,0 +1,199 @@
+"""Run-record schema (singa_tpu.obs): versioned field contracts for the
+telemetry artifacts this repo commits.
+
+Why this exists: round 5 lost its on-chip evidence because the record
+files had no contract — a CPU smoke session silently overwrote the
+on-chip `tpu_session.json`, and the README generator then crashed with a
+raw ``KeyError: 'batch'`` against the record actually committed
+(VERDICT.md).  Every consumer of a record now goes through
+:func:`require`, so a missing field fails loudly with its *name* and the
+context it was needed in, and :func:`validate_entry` checks whole
+entries so a stale or truncated record is caught at write/lint time.
+
+Three record shapes are covered:
+
+* **v1 entries** — what :class:`singa_tpu.obs.record.RunRecord` stores:
+  one JSON object per run, keyed by ``(run_id, platform, smoke)``, with
+  ``schema_version`` stamped.  Strictly validated.
+* **legacy session docs** — pre-v1 ``tpu_session.json`` (a bare
+  ``{"stages": ..., "device": ...}`` object).  Structurally validated;
+  grandfathered fields are not retro-required AT LINT TIME (the
+  committed r4 record predates the schema and cannot be re-measured
+  off-chip, so ``tools/record_check.py`` keeps CI green on it).
+  Consumers are a different story: a tool that QUOTES a field still
+  ``require()``s it and fails loudly — ``readme_perf_table.py``
+  exiting 2 with "stage 'resnet50': missing required field 'batch'"
+  against the r4 record is by design (the README table needs a fresh
+  on-chip session; silently dropping the row would be the r5 silent-
+  truncation failure mode again).
+* **driver bench records** — ``BENCH_rNN.json`` /
+  ``MULTICHIP_rNN.json`` written by the round driver.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["SCHEMA_VERSION", "SchemaError", "require", "validate_entry",
+           "validate_stage", "validate_session_doc", "validate_bench_doc",
+           "validate_multichip_doc", "entry_key"]
+
+#: bump when entry fields change incompatibly; validators dispatch on it
+SCHEMA_VERSION = 1
+
+_KINDS = ("session", "bench")
+
+
+class SchemaError(ValueError):
+    """A record failed validation.  ``field`` names the offending field
+    so consumers/CI report *what* is missing, never a raw KeyError."""
+
+    def __init__(self, message: str, field: Optional[str] = None):
+        super().__init__(message)
+        self.field = field
+
+
+def require(mapping: Any, field: str, ctx: str = "record") -> Any:
+    """Named-field access: ``mapping[field]`` that raises
+    :class:`SchemaError` ("<ctx>: missing required field '<field>'")
+    instead of KeyError, and rejects non-dict containers loudly."""
+    if not isinstance(mapping, dict):
+        raise SchemaError(f"{ctx}: expected an object with field "
+                          f"{field!r}, got {type(mapping).__name__}",
+                          field=field)
+    if field not in mapping:
+        raise SchemaError(f"{ctx}: missing required field {field!r} "
+                          f"(present: {sorted(mapping)})", field=field)
+    return mapping[field]
+
+
+def _expect(cond: bool, msg: str, field: Optional[str] = None) -> None:
+    if not cond:
+        raise SchemaError(msg, field=field)
+
+
+def entry_key(entry: Dict[str, Any]) -> Tuple[str, str, bool]:
+    """The store key: ``(run_id, platform, smoke)``."""
+    return (str(require(entry, "run_id", "entry")),
+            str(require(entry, "platform", "entry")),
+            bool(require(entry, "smoke", "entry")))
+
+
+def validate_stage(name: str, stage: Any, ctx: str = "record") -> None:
+    """One session stage: exactly one of ``skipped``, ``ok: true`` (with
+    optional ``s``/``result``), or ``ok: false`` + ``error``."""
+    c = f"{ctx}: stage {name!r}"
+    _expect(isinstance(stage, dict),
+            f"{c}: expected an object, got {type(stage).__name__}")
+    if stage.get("skipped"):
+        return
+    ok = require(stage, "ok", c)
+    _expect(isinstance(ok, bool), f"{c}: 'ok' must be a bool, got {ok!r}",
+            field="ok")
+    if not ok:
+        err = require(stage, "error", c)
+        _expect(isinstance(err, str) and err,
+                f"{c}: failed stage needs a non-empty 'error' string",
+                field="error")
+
+
+def validate_entry(entry: Any, ctx: str = "entry") -> None:
+    """Strict validation of a v1 store entry."""
+    _expect(isinstance(entry, dict),
+            f"{ctx}: expected an object, got {type(entry).__name__}")
+    ver = require(entry, "schema_version", ctx)
+    _expect(ver == SCHEMA_VERSION,
+            f"{ctx}: schema_version {ver!r} is not the supported "
+            f"{SCHEMA_VERSION}", field="schema_version")
+    run_id = require(entry, "run_id", ctx)
+    _expect(isinstance(run_id, str) and run_id,
+            f"{ctx}: 'run_id' must be a non-empty string, got {run_id!r}",
+            field="run_id")
+    kind = require(entry, "kind", ctx)
+    _expect(kind in _KINDS,
+            f"{ctx}: 'kind' must be one of {_KINDS}, got {kind!r}",
+            field="kind")
+    platform = require(entry, "platform", ctx)
+    _expect(isinstance(platform, str) and platform,
+            f"{ctx}: 'platform' must be a non-empty string, got "
+            f"{platform!r}", field="platform")
+    smoke = require(entry, "smoke", ctx)
+    _expect(isinstance(smoke, bool),
+            f"{ctx}: 'smoke' must be a bool, got {smoke!r}", field="smoke")
+    device = require(entry, "device", ctx)
+    _expect(isinstance(device, str),
+            f"{ctx}: 'device' must be a string, got {device!r}",
+            field="device")
+    created = require(entry, "created_at", ctx)
+    _expect(isinstance(created, (int, float)) and not isinstance(
+        created, bool),
+            f"{ctx}: 'created_at' must be a unix timestamp, got "
+            f"{created!r}", field="created_at")
+    if kind == "session":
+        stages = require(entry, "stages", ctx)
+        _expect(isinstance(stages, dict),
+                f"{ctx}: 'stages' must be an object, got "
+                f"{type(stages).__name__}", field="stages")
+        for sname, stage in stages.items():
+            validate_stage(sname, stage, ctx)
+    else:
+        payload = require(entry, "payload", ctx)
+        _expect(isinstance(payload, dict),
+                f"{ctx}: 'payload' must be an object, got "
+                f"{type(payload).__name__}", field="payload")
+
+
+def validate_session_doc(doc: Any, ctx: str = "session record") -> None:
+    """A session document: a v1 entry (when ``schema_version`` is
+    stamped) or a legacy ``tpu_session.json`` (structural check only —
+    grandfathered records cannot be re-measured without a chip)."""
+    _expect(isinstance(doc, dict),
+            f"{ctx}: expected an object, got {type(doc).__name__}")
+    if "schema_version" in doc:
+        validate_entry(doc, ctx)
+        return
+    stages = require(doc, "stages", ctx)
+    _expect(isinstance(stages, dict),
+            f"{ctx}: 'stages' must be an object, got "
+            f"{type(stages).__name__}", field="stages")
+    for sname, stage in stages.items():
+        validate_stage(sname, stage, ctx)
+
+
+def validate_bench_doc(doc: Any, ctx: str = "bench record") -> None:
+    """A driver ``BENCH_rNN.json``: run metadata + the parsed headline.
+
+    ``parsed`` may be null — that honestly records a round whose
+    headline never made it into the driver's tail capture (r01/r03).
+    When present it must be a complete numeric headline."""
+    _expect(isinstance(doc, dict),
+            f"{ctx}: expected an object, got {type(doc).__name__}")
+    for f in ("n", "cmd", "rc", "tail"):
+        require(doc, f, ctx)
+    parsed = require(doc, "parsed", ctx)
+    if parsed is None:
+        return
+    c = f"{ctx}: 'parsed' headline"
+    for f in ("metric", "value", "unit", "vs_baseline"):
+        require(parsed, f, c)
+    val = parsed["value"]
+    _expect(isinstance(val, (int, float)) and not isinstance(val, bool),
+            f"{c}: 'value' must be numeric, got {val!r}", field="value")
+
+
+def validate_multichip_doc(doc: Any, ctx: str = "multichip record") -> None:
+    """A driver ``MULTICHIP_rNN.json`` smoke result."""
+    _expect(isinstance(doc, dict),
+            f"{ctx}: expected an object, got {type(doc).__name__}")
+    for f in ("n_devices", "ok", "rc"):
+        require(doc, f, ctx)
+
+
+def collect_errors(validator, doc, ctx: str) -> List[str]:
+    """Run a validator, returning [] or the error messages (never raises
+    — for lint-style reporting over many files)."""
+    try:
+        validator(doc, ctx)
+        return []
+    except SchemaError as e:
+        return [str(e)]

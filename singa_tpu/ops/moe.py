@@ -87,6 +87,29 @@ def _route(logits, capacity: int, k: int):
     return e_flat, gates.T.reshape(-1), pos, keep, probs, onehot
 
 
+_warned_auto_trace = False
+
+
+def _warn_auto_under_trace(x, resolved: str) -> None:
+    """dispatch_mode='auto' resolved the global mesh while tracing: the
+    choice is baked into this jit cache entry and will NOT re-resolve if
+    the mesh changes later (the cache is not keyed on the mesh global).
+    Warn ONCE per process so raw-jit users learn to pass an explicit
+    mode; the model executor re-traces per compile and is fine."""
+    global _warned_auto_trace
+    if _warned_auto_trace or not isinstance(x, jax.core.Tracer):
+        return
+    _warned_auto_trace = True
+    import warnings
+    warnings.warn(
+        f"moe_forward(dispatch_mode='auto') resolved to {resolved!r} at "
+        "trace time from the global mesh; the jit cache is not keyed on "
+        "that global, so a later set_mesh() will NOT re-route already-"
+        "jitted callers.  Pass dispatch_mode='scatter'/'einsum' "
+        "explicitly when jitting moe_forward directly around mesh "
+        "changes.", stacklevel=3)
+
+
 def _expert_ffn(buf, w_in, w_out, w_gate):
     """(E, C, D) expert buffers -> (E, C, D) outputs (relu or SwiGLU)."""
     up = jnp.einsum("ecd,edh->ech", buf, w_in.astype(buf.dtype))
@@ -120,6 +143,16 @@ def moe_forward(x, router_w, w_in, w_out, capacity_factor: float = 1.25,
         when an 'expert' mesh axis is live: GSPMD partitions einsums
         over E into all-to-alls cleanly, which is the EP wire format.
       * 'auto' — scatter without an EP axis, einsum with one.
+        CAVEAT: 'auto' reads the global `parallel.mesh.current_mesh()`
+        AT TRACE TIME, and the jit cache is NOT keyed on that global —
+        a function jitted before the 'expert' mesh is installed stays
+        cached on the scatter path (numerics identical; the einsum
+        all-to-all wire format is what's silently missed).  The model
+        executor re-traces per compile so it is unaffected, but code
+        that jits `moe_forward` directly around mesh changes should
+        pass an explicit mode (the `MoE` layer forwards its
+        `dispatch_mode` argument for exactly this).  A one-time warning
+        fires when 'auto' resolves under a trace.
 
     Both modes share `_route` (identical routing, gating, capacity
     drops) and are equivalence-tested against each other."""
@@ -137,6 +170,7 @@ def moe_forward(x, router_w, w_in, w_out, capacity_factor: float = 1.25,
         m = mesh_mod.current_mesh()
         ep = m is not None and m.shape.get("expert", 1) > 1
         dispatch_mode = "einsum" if ep else "scatter"
+        _warn_auto_under_trace(x, dispatch_mode)
 
     if dispatch_mode == "scatter":
         e_flat, gate_flat, pos, keep, probs, onehot = _route(

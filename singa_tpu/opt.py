@@ -586,11 +586,18 @@ class DistOpt(Optimizer):
         """Mean-allreduce gradients over the data axis (in-graph).
 
         Called by the graph executor *inside* shard_map; if no mesh axis is
-        bound (single-process eager), this is the identity."""
+        bound (single-process eager), this is the identity.
+
+        Telemetry: an ``opt.grad_sync`` span (trace-time when called
+        under the compiled step) plus the communicator's per-op payload
+        counters (obs.events)."""
+        from .obs import events as obs_events
         from .parallel import communicator as comm
-        return comm.allreduce_grads(grads, axis=self.data_axis,
-                                    compress_dtype=self.compress_dtype,
-                                    topk_ratio=self.topk_ratio)
+        with obs_events.span("opt.grad_sync", axis=self.data_axis,
+                             tensors=len(grads)):
+            return comm.allreduce_grads(grads, axis=self.data_axis,
+                                        compress_dtype=self.compress_dtype,
+                                        topk_ratio=self.topk_ratio)
 
     # -- reference API surface ------------------------------------------------
     def __call__(self, loss: Tensor) -> None:

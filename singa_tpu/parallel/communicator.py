@@ -34,6 +34,26 @@ def axis_bound(axis: str) -> bool:
         return False
 
 
+def _payload_counter(collective: str, x, axis: str, **attrs) -> None:
+    """Emit a ``comm.<collective>.bytes`` counter for a staged
+    collective.
+
+    Collectives are in-graph ops, so this fires at TRACE time (once per
+    compile, not per execution) and records the payload the wire will
+    carry on every step — the quantity bandwidth accounting needs.
+    Shapes/dtypes are concrete on tracers, so no device work happens."""
+    from ..obs import events
+    if not events.enabled():
+        return
+    try:
+        nbytes = sum(int(l.size) * l.dtype.itemsize
+                     for l in jax.tree.leaves(x)
+                     if hasattr(l, "size") and hasattr(l, "dtype"))
+    except Exception:  # exotic pytree leaves must never break a trace
+        return
+    events.counter(f"comm.{collective}.bytes", nbytes, axis=axis, **attrs)
+
+
 def axis_index(axis: str):
     return jax.lax.axis_index(axis)
 
@@ -45,6 +65,7 @@ def axis_size(axis: str) -> int:
 def allreduce(x, axis: str = "data", op: str = "mean"):
     if not axis_bound(axis):
         return x
+    _payload_counter("allreduce", x, axis, op=op)
     if op == "mean":
         return jax.lax.pmean(x, axis)
     if op == "sum":
@@ -59,12 +80,14 @@ def allreduce(x, axis: str = "data", op: str = "mean"):
 def allgather(x, axis: str = "data", tiled: bool = False):
     if not axis_bound(axis):
         return x
+    _payload_counter("allgather", x, axis)
     return jax.lax.all_gather(x, axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis: str = "data", scatter_dimension: int = 0):
     if not axis_bound(axis):
         return x
+    _payload_counter("reduce_scatter", x, axis)
     return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_dimension,
                                 tiled=True)
 
@@ -72,6 +95,7 @@ def reduce_scatter(x, axis: str = "data", scatter_dimension: int = 0):
 def ppermute(x, axis: str, perm):
     if not axis_bound(axis):
         return x
+    _payload_counter("ppermute", x, axis)
     return jax.lax.ppermute(x, axis, perm)
 
 
@@ -89,6 +113,7 @@ def broadcast(x, axis: str = "data", src: int = 0):
     W = jax.lax.axis_size(axis)
     if W == 1:
         return x
+    _payload_counter("broadcast", x, axis)
     d = (jax.lax.axis_index(axis) - src) % W  # offset from src, traced
     val = x
     step = 1
@@ -121,6 +146,12 @@ def allreduce_grads(grads: Dict[str, jnp.ndarray], axis: str = "data",
     exchange (reference: sparsified allreduce)."""
     if not axis_bound(axis):
         return grads
+    _payload_counter("allreduce_grads",
+                     [g for g in grads.values() if g is not None], axis,
+                     tensors=len(grads),
+                     compress=None if compress_dtype is None
+                     else str(compress_dtype),
+                     topk_ratio=topk_ratio or 0.0)
     out = {}
     for name, g in grads.items():
         if g is None:
@@ -178,6 +209,7 @@ def quantized_allreduce(x, axis: str = "data", block: int = 256,
         raise ValueError(f"wire must be 'int32' or 'int8', got {wire!r}")
     if not axis_bound(axis):
         return x
+    _payload_counter("quantized_allreduce", x, axis, wire=wire)
     if wire == "int8":
         return _ring_int8_allreduce(x, axis, block)
     orig_shape, orig_dtype = x.shape, x.dtype

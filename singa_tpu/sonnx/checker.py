@@ -79,6 +79,14 @@ def _check_attribute(a: AttributeProto, node_name: str,
             elif v:
                 populated.append((field, enum))
     declared = a.type or 0
+    if len(populated) > 1:
+        # the official checker rejects multi-family attributes whether
+        # or not a type is declared — a declared type matching ONE of
+        # the families must not launder the extra payload through
+        _fail(f"node {node_name!r}: attribute {a.name!r} has multiple "
+              f"value families {populated}"
+              + (f" (declared type {declared})" if declared
+                 else " and no type"))
     if declared:
         matches = [e for _f, e in populated]
         if populated and declared not in matches:
@@ -86,9 +94,6 @@ def _check_attribute(a: AttributeProto, node_name: str,
             # when a DIFFERENT family is populated
             _fail(f"node {node_name!r}: attribute {a.name!r} declares "
                   f"type {declared} but carries {populated}")
-    elif len(populated) > 1:
-        _fail(f"node {node_name!r}: attribute {a.name!r} has multiple "
-              f"value families {populated} and no type")
     # recurse into sub-graphs with the outer scope visible
     if a.g is not None:
         check_graph(a.g, outer_scope=outer_scope)

@@ -39,6 +39,13 @@ class DataLoader:
             # token-id streams (LLM training) stay integral; the native
             # loader's buffers are f32-typed, so the int path uses the
             # python pipeline
+            if use_native:
+                import warnings
+                warnings.warn(
+                    "DataLoader(use_native=True) ignored: integer input "
+                    "(token ids) routes through the python pipeline — "
+                    "the native loader's buffers are f32-typed and "
+                    "would corrupt ids", stacklevel=2)
             x = x.astype(np.int32, copy=False)
             use_native = False
         else:

@@ -146,25 +146,29 @@ def mfu(model_flops_per_step: float, step_time_s: float,
 
 
 class MetricsLogger:
-    """JSONL sink: one dict per line."""
+    """JSONL sink: one dict per line.
+
+    File I/O is unified onto ``obs.events.JsonlSink`` (same atomic-line,
+    thread-safe writer the telemetry layer uses), so all JSONL emission
+    in the repo shares one implementation."""
 
     def __init__(self, path: Optional[str] = None, echo: bool = True):
+        from ..obs.events import JsonlSink
         self.path = path
         self.echo = echo
-        self._f = open(path, "a") if path else None
+        self._sink = JsonlSink(path) if path else None
 
     def log(self, **kv) -> None:
         kv.setdefault("t", time.time())
-        line = json.dumps({k: _jsonable(v) for k, v in kv.items()})
-        if self._f:
-            self._f.write(line + "\n")
-            self._f.flush()
+        payload = {k: _jsonable(v) for k, v in kv.items()}
+        if self._sink:
+            self._sink.emit(payload)
         if self.echo:
-            print(line)
+            print(json.dumps(payload))
 
     def close(self):
-        if self._f:
-            self._f.close()
+        if self._sink:
+            self._sink.close()
 
 
 def _jsonable(v):

@@ -51,6 +51,10 @@ class StepProfiler:
         self._n += 1
         if self._n > self.warmup:
             self.times.append(dt)
+            # mirror into the structured telemetry stream when enabled
+            from ..obs import events as obs_events
+            obs_events.gauge("profiler.step_ms", round(dt * 1e3, 3),
+                             n=self._n)
 
     @property
     def mean_s(self) -> float:

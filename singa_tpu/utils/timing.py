@@ -130,6 +130,7 @@ def windowed_steps(step: Callable[[], object], *, windows: int = 6,
         "median": round(med / window_len * 1e3, 1),
         "max": round(wtimes[-1] / window_len * 1e3, 1),
     }
+    _emit_timing_gauge("timing.windowed.step_ms", stats)
     return med / window_len, stats
 
 
@@ -161,4 +162,13 @@ def fenced_steps(step: Callable[[], object], *, steps: int = 8,
         "mean": round(sum(times) / len(times) * 1e3, 1),
         "max": round(times[-1] * 1e3, 1),
     }
+    _emit_timing_gauge("timing.fenced.step_ms", stats)
     return statistics.median(times), stats
+
+
+def _emit_timing_gauge(name: str, stats: dict) -> None:
+    """Mirror a measurement's summary into the structured telemetry
+    stream (obs.events) — no-op unless a sink is enabled."""
+    from ..obs import events
+    events.gauge(name, stats["median"], method=stats["method"],
+                 n=stats["n"], min=stats["min"], max=stats["max"])

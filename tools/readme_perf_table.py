@@ -1,6 +1,25 @@
-"""Regenerate README.md's measured-performance table FROM the committed
-tpu_session.json (ADVICE r4: the table had drifted from the record it
-claimed to quote — generating it removes the failure mode).
+"""Regenerate README.md's measured-performance table FROM a validated,
+committed run record (ADVICE r4: the table had drifted from the record
+it claimed to quote — generating it removes the failure mode; r5 lost
+the record itself, so generation now goes through the obs schema and
+fails LOUDLY with a named field, never a raw KeyError).
+
+Record resolution order:
+  1. --record PATH              (explicit file: store entry or legacy doc)
+  2. runs/records.jsonl         (the obs.RunRecord store: newest ON-CHIP
+                                 session entry; smoke entries never shadow)
+  3. tpu_session.json           (the legacy single-doc snapshot)
+
+A smoke/CPU record is refused unless --allow-smoke is passed: the README
+table quotes on-chip numbers only.
+
+Note the deliberate strictness against legacy records: record_check.py
+grandfathers them at lint time, but THIS tool quotes fields, so a row
+whose gating metric exists but whose companion fields are missing is a
+named SchemaError and exit 2 — the committed r4 record trips exactly
+this on `resnet50.batch`, which is the honest state until a fresh
+on-chip session is run (silently dropping the row would reintroduce
+the r5 silent-truncation failure mode).
 
 Usage: python tools/readme_perf_table.py          # rewrites README section
        python tools/readme_perf_table.py --print  # stdout only
@@ -13,6 +32,11 @@ import re
 import sys
 
 ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+from singa_tpu.obs import record as obs_record  # noqa: E402
+from singa_tpu.obs import schema  # noqa: E402
+from singa_tpu.obs.schema import SchemaError  # noqa: E402
 
 BEGIN = "<!-- perf-table:begin (tools/readme_perf_table.py) -->"
 END = "<!-- perf-table:end -->"
@@ -22,65 +46,121 @@ def _fmt(x, nd=2):
     return f"{x:,.{nd}f}".rstrip("0").rstrip(".")
 
 
-def build() -> str:
-    with open(os.path.join(ROOT, "tpu_session.json")) as f:
-        st = json.load(f)["stages"]
+def load_stages(record_path: str | None = None,
+                allow_smoke: bool = False) -> dict:
+    """Resolve + validate the record; return its stages dict."""
+    if record_path is not None:
+        with open(record_path) as f:
+            try:
+                doc = json.load(f)
+            except json.JSONDecodeError as e:
+                raise SchemaError(
+                    f"{record_path}: not a JSON record ({e.msg} at line "
+                    f"{e.lineno}); note the store is JSONL — pass a "
+                    "session snapshot, or omit --record to read the "
+                    "store's newest on-chip entry") from e
+        schema.validate_session_doc(doc, ctx=record_path)
+        if not allow_smoke and not obs_record.is_onchip_session_doc(doc):
+            raise SchemaError(
+                f"{record_path}: record is a smoke/CPU session — the "
+                "README table quotes on-chip numbers only (pass "
+                "--allow-smoke to override)")
+        return schema.require(doc, "stages", record_path)
 
+    store_path = os.path.join(ROOT, obs_record.DEFAULT_STORE)
+    if os.path.exists(store_path):
+        store = obs_record.RunRecord(store_path)
+        entry = store.latest(kind="session", smoke=False)
+        if entry is None and allow_smoke:
+            # smoke is opt-in only, and only when no on-chip entry
+            # exists — allowing must never mean preferring
+            entry = store.latest(kind="session", smoke=True)
+        if entry is not None:
+            return schema.require(entry, "stages",
+                                  f"{store_path} (run {entry['run_id']})")
+
+    legacy = os.path.join(ROOT, "tpu_session.json")
+    if not os.path.exists(legacy) and allow_smoke:
+        smoke_legacy = os.path.join(ROOT, "tpu_session.smoke.json")
+        if os.path.exists(smoke_legacy):
+            legacy = smoke_legacy
+    return load_stages(record_path=legacy, allow_smoke=allow_smoke)
+
+
+def build(st: dict) -> str:
     def res(name):
         return (st.get(name) or {}).get("result") or {}
+
+    def req(stage_result, field, stage):
+        """Named-field access: a gated row whose companion fields are
+        missing is a schema violation, not a KeyError."""
+        return schema.require(stage_result, field, f"stage {stage!r}")
 
     rows = []
     h = res("llama_headline")
     if h.get("mfu"):
         rows.append((
             "Llama 0.9B flagship training",
-            f"b{h['batch']} × {h['seq']}, flash + fused CE",
-            f"{h['tokens_per_s']:,.0f} tok/s, {h['step_ms']} ms/step, "
+            f"b{req(h, 'batch', 'llama_headline')} × "
+            f"{req(h, 'seq', 'llama_headline')}, flash + fused CE",
+            f"{req(h, 'tokens_per_s', 'llama_headline'):,.0f} tok/s, "
+            f"{req(h, 'step_ms', 'llama_headline')} ms/step, "
             f"MFU {h['mfu']}",
             f"**{h['mfu'] / 0.45:.2f}×**"))
     rn = res("resnet50")
     if rn.get("mfu"):
         rows.append((
             "ResNet-50 training",
-            f"b{rn['batch']} @ {rn['image']}²",
-            f"{rn['images_per_s']:,.0f} img/s, MFU {rn['mfu']}",
+            f"b{req(rn, 'batch', 'resnet50')} @ "
+            f"{req(rn, 'image', 'resnet50')}²",
+            f"{req(rn, 'images_per_s', 'resnet50'):,.0f} img/s, "
+            f"MFU {rn['mfu']}",
             f"**{rn['mfu'] / 0.45:.2f}×**"))
     bt = res("bert_sonnx")
     if bt.get("mfu_analytic"):
         rows.append((
             "BERT-base training (sonnx import)",
             "b256 × seq 128",
-            f"{bt['samples_per_s']:,.0f} samples/s, MFU "
-            f"{bt['mfu_analytic']} ({bt['mfu_analytic_with_embeddings']} "
+            f"{req(bt, 'samples_per_s', 'bert_sonnx'):,.0f} samples/s, "
+            f"MFU {bt['mfu_analytic']} "
+            f"({req(bt, 'mfu_analytic_with_embeddings', 'bert_sonnx')} "
             "counting embeddings)",
             f"**{bt['mfu_analytic'] / 0.45:.2f}×**"))
     sm = res("llama_small_continuity")
     if sm.get("mfu"):
         rows.append((
             "Llama `small` (110M) training",
-            f"b{sm['batch']} × {sm['seq']} (r1-r4 headline config)",
-            f"{sm['tokens_per_s']:,.0f} tok/s, {sm['step_ms']} ms/step, "
-            f"MFU {sm['mfu']}",
+            f"b{req(sm, 'batch', 'llama_small_continuity')} × "
+            f"{req(sm, 'seq', 'llama_small_continuity')} "
+            "(r1-r4 headline config)",
+            f"{req(sm, 'tokens_per_s', 'llama_small_continuity'):,.0f} "
+            f"tok/s, {req(sm, 'step_ms', 'llama_small_continuity')} "
+            f"ms/step, MFU {sm['mfu']}",
             f"{sm['mfu'] / 0.45:.2f}×"))
     ls = res("llama_longseq")
     if ls.get("step_ms"):
         rows.append((
             "Llama long-context training",
-            f"b{ls['batch']} × seq {ls['seq']}, flash",
-            f"{ls['step_ms']} ms/step, MFU {ls['mfu']}", "—"))
+            f"b{req(ls, 'batch', 'llama_longseq')} × seq "
+            f"{req(ls, 'seq', 'llama_longseq')}, flash",
+            f"{ls['step_ms']} ms/step, MFU "
+            f"{req(ls, 'mfu', 'llama_longseq')}", "—"))
     s8 = res("llama_seq8k_banded_vs_dense")
     if s8.get("banded_speedup"):
         rows.append((
             "Banded flash @ seq 8192",
             "window 1024 vs dense",
-            f"{s8['banded_step_ms']} vs {s8['dense_step_ms']} ms/step "
-            f"({s8['banded_speedup']}× faster)", "—"))
+            f"{req(s8, 'banded_step_ms', 'llama_seq8k_banded_vs_dense')} "
+            f"vs {req(s8, 'dense_step_ms', 'llama_seq8k_banded_vs_dense')} "
+            f"ms/step ({s8['banded_speedup']}× faster)", "—"))
     mo = res("llama_moe")
     if mo.get("step_ms"):
         rows.append((
             "Llama MoE training (scatter dispatch)",
-            f"top-2 of 4 SwiGLU experts, b{mo['batch']}×{mo['seq']}",
-            f"{mo['step_ms']} ms/step, MFU {mo['mfu']} (active-FLOPs)",
+            f"top-2 of 4 SwiGLU experts, b{req(mo, 'batch', 'llama_moe')}"
+            f"×{req(mo, 'seq', 'llama_moe')}",
+            f"{mo['step_ms']} ms/step, MFU {req(mo, 'mfu', 'llama_moe')} "
+            "(active-FLOPs)",
             "—"))
     g2 = res("gpt2_sonnx")
     if g2.get("gen_tokens_per_s"):
@@ -88,35 +168,41 @@ def build() -> str:
             "GPT-2 (124M) via sonnx: inference",
             "HF graph → torch.onnx → sonnx; KV-cache scan decode",
             f"{g2['gen_tokens_per_s']:,.0f} tok/s "
-            f"({g2['gen_ms_per_token']} ms/token); sonnx-vs-native "
-            f"max|Δlogit| {g2['sonnx_vs_native_max_abs']:.3g}", "—"))
+            f"({req(g2, 'gen_ms_per_token', 'gpt2_sonnx')} ms/token); "
+            f"sonnx-vs-native max|Δlogit| "
+            f"{req(g2, 'sonnx_vs_native_max_abs', 'gpt2_sonnx'):.3g}", "—"))
     gen = res("llama_generate")
     if gen.get("tokens_per_s"):
         rows.append((
             "KV-cache generation (Llama 110M)",
-            f"b{gen['batch']}, scan-decode",
+            f"b{req(gen, 'batch', 'llama_generate')}, scan-decode",
             f"{gen['tokens_per_s']:,.0f} tok/s "
-            f"({gen['ms_per_token']} ms/token)", "—"))
+            f"({req(gen, 'ms_per_token', 'llama_generate')} ms/token)",
+            "—"))
     hf = res("hostfed_input")
     if hf.get("ratio"):
         rows.append((
             "Host-fed input pipeline",
             "DataLoader + prefetch_to_device",
-            f"{hf['step_ms']} ms/step = {hf['ratio']}× the "
-            "device-resident step", "—"))
+            f"{req(hf, 'step_ms', 'hostfed_input')} ms/step = "
+            f"{hf['ratio']}× the device-resident step", "—"))
     mm = res("matmul_microbench")
     if mm.get("sustained_tflops"):
         rows.append((
             "Matmul calibration",
-            f"model-shaped bf16 chain ({mm['shape']})",
+            f"model-shaped bf16 chain "
+            f"({req(mm, 'shape', 'matmul_microbench')})",
             f"{mm['sustained_tflops']} TFLOP/s sustained "
-            f"({mm['mfu_equiv']:.2f} of quoted peak)", "—"))
+            f"({req(mm, 'mfu_equiv', 'matmul_microbench'):.2f} of quoted "
+            "peak)", "—"))
 
     out = [BEGIN,
            "",
-           "From the committed `tpu_session.json` (regenerate: "
+           "From the committed run record (regenerate: "
            "`python tools/tpu_session.py` on the chip, then "
-           "`python tools/readme_perf_table.py`).  Step times are "
+           "`python tools/readme_perf_table.py`; records are validated "
+           "against `singa_tpu/obs/schema.py` — see "
+           "`docs/observability.md`).  Step times are "
            "windowed throughput medians, true-fenced (r5 methodology — "
            "`docs/performance.md`); MFU uses traced/analytic matmul "
            "FLOPs over the v5e's quoted 197 bf16 TFLOP/s.",
@@ -130,8 +216,28 @@ def build() -> str:
     return "\n".join(out)
 
 
+def _arg_value(flag: str) -> str | None:
+    if flag in sys.argv:
+        i = sys.argv.index(flag)
+        if i + 1 >= len(sys.argv):
+            raise SystemExit(f"{flag} needs a value")
+        return sys.argv[i + 1]
+    return None
+
+
 def main():
-    table = build()
+    try:
+        st = load_stages(record_path=_arg_value("--record"),
+                         allow_smoke="--allow-smoke" in sys.argv)
+        table = build(st)
+    except SchemaError as e:
+        # the round-5 failure mode was a raw KeyError four rounds late;
+        # now the record's defect is NAMED and the exit code is real
+        print(f"readme_perf_table: record invalid: {e}", file=sys.stderr)
+        raise SystemExit(2)
+    except FileNotFoundError as e:
+        print(f"readme_perf_table: no record found: {e}", file=sys.stderr)
+        raise SystemExit(2)
     if "--print" in sys.argv:
         print(table)
         return

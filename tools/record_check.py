@@ -1,0 +1,87 @@
+"""Lint every committed telemetry record against the obs schema.
+
+The round-5 failure mode this kills: a stale/truncated/clobbered record
+sat in the tree for a whole round and was only discovered when a
+consumer crashed with a raw KeyError.  This lint validates, at CI time
+(tests/test_obs.py runs it as a tier-1 test):
+
+  * ``tpu_session*.json``      — session records (v1 entries validated
+                                 strictly; legacy pre-schema docs
+                                 structurally);
+  * ``BENCH_r*.json``          — driver bench records (metadata + a
+                                 numeric parsed headline);
+  * ``MULTICHIP_r*.json``      — driver multichip smoke records;
+  * ``runs/records.jsonl``     — the RunRecord store (every line
+                                 strictly valid, no duplicate keys).
+
+Exit code 0 = all records valid; 1 = named errors printed, one per
+line, each naming the file and the missing/invalid field.
+
+Usage: python tools/record_check.py [root-dir]
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+from singa_tpu.obs import record as obs_record  # noqa: E402
+from singa_tpu.obs import schema  # noqa: E402
+
+
+def _load(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f), None
+    except json.JSONDecodeError as e:
+        return None, f"{path}: not valid JSON ({e.msg} at line {e.lineno})"
+    except OSError as e:
+        return None, f"{path}: unreadable ({e})"
+
+
+def check_root(root: str) -> list[str]:
+    errors: list[str] = []
+
+    def run(validator, path):
+        doc, err = _load(path)
+        if err:
+            errors.append(err)
+            return
+        errors.extend(schema.collect_errors(validator, doc, path))
+
+    for path in sorted(glob.glob(os.path.join(root, "tpu_session*.json"))):
+        run(schema.validate_session_doc, path)
+    for path in sorted(glob.glob(os.path.join(root, "*_session.json"))):
+        if os.path.basename(path).startswith("tpu_session"):
+            continue  # already covered by the pattern above
+        run(schema.validate_session_doc, path)
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_*.json"))):
+        run(schema.validate_bench_doc, path)
+    for path in sorted(glob.glob(os.path.join(root, "MULTICHIP_*.json"))):
+        run(schema.validate_multichip_doc, path)
+
+    store = os.path.join(root, obs_record.DEFAULT_STORE)
+    if os.path.exists(store):
+        errors.extend(obs_record.RunRecord(store).validate())
+    return errors
+
+
+def main(argv: list[str]) -> int:
+    root = os.path.abspath(argv[1]) if len(argv) > 1 else os.path.abspath(ROOT)
+    errors = check_root(root)
+    if errors:
+        for e in errors:
+            print(f"record_check: {e}", file=sys.stderr)
+        print(f"record_check: {len(errors)} error(s) in {root}",
+              file=sys.stderr)
+        return 1
+    print(f"record_check: all records valid in {root}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

@@ -45,13 +45,21 @@ _BUDGET_S = float(os.environ.get("SINGA_TPU_SESSION_BUDGET_S", "4800"))
 # session logic end-to-end without a chip
 _SMOKE = os.environ.get("SINGA_TPU_SESSION_SMOKE") == "1"
 # SINGA_TPU_SESSION_ONLY=a,b,c: run only the named stages (plus probe)
-# and MERGE results into the existing tpu_session.json — for re-running
+# and MERGE results into the existing session record — for re-running
 # stages that failed (OOM/compile-helper) without redoing the session
 _ONLY = {n for n in os.environ.get("SINGA_TPU_SESSION_ONLY", "").split(",")
          if n}
-_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                    "tpu_session.log")
+# SINGA_TPU_SESSION_DIR: where the record/log/store land (default: the
+# repo root).  Exists so tests can exercise the full write path —
+# including the smoke-vs-chip guard — against a scratch dir.
+_DIR = os.path.abspath(os.environ.get(
+    "SINGA_TPU_SESSION_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")))
+_LOG = os.path.join(_DIR, "tpu_session.log")
 _RESULTS: dict = {"stages": {}}
+# run identity for the durable store (singa_tpu.obs.record): one entry
+# per (run_id, platform, smoke); platform is stamped by the probe stage
+_RUN_ID = f"session-{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}"
 
 
 def mark(msg: str) -> None:
@@ -114,15 +122,17 @@ def _fetch(x):
 def main() -> None:
     open(_LOG, "w").close()
     if _ONLY:
-        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "..", "tpu_session.json")
-        try:
-            with open(path) as f:
-                _RESULTS.update(json.load(f))
-        except Exception:
-            pass
-        mark(f"ONLY mode: {sorted(_ONLY)} (merging into existing record)")
-    mark(f"session start, budget {_BUDGET_S:.0f}s")
+        # merge source is decided by MODE alone (the probe hasn't run
+        # yet, so _session_json_path()'s platform-based redirect must
+        # not be consulted here): a smoke rerun merges the smoke
+        # snapshot — NEVER the on-chip record, which is how r5
+        # polluted-then-lost its evidence — and a real rerun merges
+        # tpu_session.json so the stages it does NOT rerun survive
+        path = _merge_source_path()
+        _merge_only_results(path)
+        mark(f"ONLY mode: {sorted(_ONLY)} (merging from {path})")
+    mark(f"session start, budget {_BUDGET_S:.0f}s"
+         + (" [SMOKE]" if _SMOKE else ""))
 
     import jax
 
@@ -137,6 +147,7 @@ def main() -> None:
         x = jnp.ones((256, 256), jnp.bfloat16)
         jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
         _RESULTS["device"] = getattr(d[0], "device_kind", d[0].platform)
+        _RESULTS["platform"] = d[0].platform
         return d[0].platform
 
     platform = probe()
@@ -833,8 +844,12 @@ def main() -> None:
 
 
 def _write_perf_notes(dev_kind) -> None:
-    out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                       "PERF_NOTES.md")
+    out = os.path.join(_DIR, "PERF_NOTES.md")
+    if _smoke_like():
+        # the r5 incident's second casualty: a CPU smoke session
+        # overwrote the committed on-chip PERF_NOTES.md.  Smoke/CPU
+        # sessions get their own file, unconditionally.
+        out = os.path.join(_DIR, "PERF_NOTES.smoke.md")
     st = _RESULTS["stages"]
 
     def res(name):
@@ -990,12 +1005,103 @@ def _write_perf_notes(dev_kind) -> None:
     mark(f"wrote {os.path.abspath(out)}")
 
 
+def _smoke_like() -> bool:
+    """Smoke mode, a probe that resolved to CPU, or a probe that never
+    ran at all: either way this run carries no on-chip evidence and
+    must not displace (or shadow, via the store) any."""
+    platform = _RESULTS.get("platform")
+    return _SMOKE or platform is None or platform == "cpu"
+
+
+def _merge_source_path() -> str:
+    """The record an ONLY-mode rerun merges FROM — decided by mode
+    alone, valid before the probe has stamped a platform."""
+    if _SMOKE:
+        return os.path.join(_DIR, "tpu_session.smoke.json")
+    return os.path.join(_DIR, "tpu_session.json")
+
+
+def _merge_only_results(path: str) -> None:
+    """Merge a previous record's STAGES into this run (ONLY mode),
+    stripping the merged record's run-identity metadata: platform,
+    device, etc. must be re-established by THIS run's probe.  Otherwise
+    a rerun whose probe fails would inherit platform='tpu' from the
+    merged record, _smoke_like() would read False, and _finish would
+    overwrite the on-chip record and append a falsified non-smoke
+    store entry for a run that never touched a chip."""
+    try:
+        with open(path) as f:
+            _RESULTS.update(json.load(f))
+    except Exception:
+        pass
+    for k in ("schema_version", "run_id", "kind", "platform", "smoke",
+              "device", "created_at"):
+        _RESULTS.pop(k, None)
+
+
+def _session_json_path() -> str:
+    """Where this run's session snapshot goes.
+
+    The round-5 data loss: a CPU smoke session's ``_finish()``
+    unconditionally overwrote ``tpu_session.json``, destroying the
+    on-chip record.  Now smoke runs ALWAYS write
+    ``tpu_session.smoke.json``; a non-smoke run that resolved to CPU
+    writes ``tpu_session.cpu.json`` whenever the existing
+    ``tpu_session.json`` looks on-chip (legacy records included —
+    inference via obs.record.is_onchip_session_doc)."""
+    base = os.path.join(_DIR, "tpu_session.json")
+    if _SMOKE:
+        return os.path.join(_DIR, "tpu_session.smoke.json")
+    if _smoke_like():
+        # non-smoke run with no on-chip evidence (CPU probe, or probe
+        # never ran): preserve an existing on-chip record
+        try:
+            with open(base) as f:
+                existing = json.load(f)
+        except Exception:
+            existing = None
+        from singa_tpu.obs import record as obs_record
+        if obs_record.is_onchip_session_doc(existing):
+            return os.path.join(_DIR, "tpu_session.cpu.json")
+    return base
+
+
 def _finish(final: bool = True) -> None:
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                        "tpu_session.json")
-    tmp = path + ".tmp"
+    from singa_tpu.obs import record as obs_record
+
+    # 1. the durable store: one schema-validated entry per run, keyed
+    #    (run_id, platform, smoke) — incremental _finish calls supersede
+    #    this run's OWN line only; other runs' lines are preserved
+    #    byte-for-byte, so a smoke session structurally cannot damage an
+    #    on-chip entry
+    platform = _RESULTS.get("platform") or ("cpu" if _SMOKE else "unknown")
+    try:
+        entry = obs_record.new_entry(
+            "session", platform, _smoke_like(),
+            str(_RESULTS.get("device", "")), run_id=_RUN_ID,
+            stages=_RESULTS["stages"])
+        obs_record.RunRecord(
+            os.path.join(_DIR, obs_record.DEFAULT_STORE)).append(entry)
+    except Exception as e:  # noqa: BLE001 - the snapshot below still lands
+        mark(f"store append failed: {type(e).__name__}: {e}")
+
+    # 2. the legacy single-doc snapshot (what bench.py and the README
+    #    generator read), smoke-guarded via _session_json_path and
+    #    written atomically (temp + rename) like the store
+    path = _session_json_path()
+    doc = dict(_RESULTS)
+    doc["schema_version"] = 1
+    doc["run_id"] = _RUN_ID
+    doc["kind"] = "session"
+    doc["platform"] = platform
+    doc["smoke"] = _smoke_like()
+    doc["device"] = str(_RESULTS.get("device", ""))
+    doc["created_at"] = _T0
+    tmp = path + f".tmp.{os.getpid()}"
     with open(tmp, "w") as f:
-        json.dump(_RESULTS, f, indent=1)
+        json.dump(doc, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
     if final:
         mark(f"session end; results in {os.path.abspath(path)}")
