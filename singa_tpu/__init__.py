@@ -28,12 +28,14 @@ from . import parallel
 from . import utils
 
 __all__ = ["device", "proto", "tensor", "autograd", "layer", "model", "opt",
-           "graph", "obs", "ops", "parallel", "utils", "sonnx", "models"]
+           "graph", "obs", "ops", "parallel", "utils", "sonnx", "models",
+           "serve"]
 
 
 def __getattr__(name):
-    # lazy: sonnx pulls in the onnx proto machinery, models pulls model zoo
-    if name in ("sonnx", "models"):
+    # lazy: sonnx pulls in the onnx proto machinery, models pulls model
+    # zoo, serve pulls the inference engine
+    if name in ("sonnx", "models", "serve"):
         import importlib
         mod = importlib.import_module("." + name, __name__)
         globals()[name] = mod

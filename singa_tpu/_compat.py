@@ -18,7 +18,26 @@ from __future__ import annotations
 
 import jax
 
-__all__ = ["apply"]
+__all__ = ["apply", "jax_version_tuple", "legacy_jax"]
+
+
+def jax_version_tuple() -> tuple:
+    """(major, minor) of the running jax, robust to suffixes."""
+    parts = []
+    for p in jax.__version__.split(".")[:2]:
+        digits = "".join(ch for ch in p if ch.isdigit())
+        parts.append(int(digits or 0))
+    return tuple(parts)
+
+
+def legacy_jax() -> bool:
+    """True on the jax-0.4.x-era images this repo's growth containers
+    pin.  Gates the known pre-existing failures those builds cannot
+    pass (ZeRO-1 donation aliasing under GSPMD; old shard_map gradient
+    semantics in the pipeline schedule) behind non-strict xfail markers
+    so tier-1 signal stays clean there while the tests still run — and
+    must pass — on modern jax."""
+    return jax_version_tuple() < (0, 5)
 
 
 def apply() -> None:

@@ -27,7 +27,7 @@ import numpy as np
 from .. import autograd
 from ..tensor import Tensor
 
-__all__ = ["GenerateMixin"]
+__all__ = ["GenerateMixin", "prefill_step", "decode_step"]
 
 
 @contextmanager
@@ -55,6 +55,45 @@ def _bound(model, params: Dict, buffers: Dict):
             t.data = saved_b[n]
 
 
+def prefill_step(model, total_len: int, last_only: bool = True):
+    """Build the prompt-forward closure shared by `_GenSession` and the
+    serving engine (serve.engine): (params, buffers, ids (B, P)) ->
+    (logits, caches) with fresh (B, total_len) caches written for
+    positions [0, P).  `last_only` returns just the last position's
+    (B, V) logits (the generate() path); the engine keeps the full
+    (B, P, V) block so it can gather at each request's true length
+    inside its own jitted wrapper."""
+
+    def prefill(params, buffers, ids):
+        with _bound(model, params, buffers):
+            t = Tensor(data=ids, device=_dev(model), requires_grad=False)
+            logits, caches = model.forward_cached(
+                t, caches=model.init_caches(ids.shape[0], total_len), pos=0)
+        lg = logits.data
+        return (lg[:, -1, :] if last_only else lg), caches
+
+    return prefill
+
+
+def decode_step(model):
+    """Build the one-token decode closure shared by `_GenSession` and
+    the serving engine: (params, buffers, tok (B, 1), pos, caches) ->
+    (logits (B, V), caches).  `pos` may be a traced scalar (all rows at
+    the same depth — generate()) or a traced (B,) vector (every slot at
+    its own depth — serve.engine); the ops layer (rope offset, cache
+    scatter, per-row attention limit) handles both inside ONE compiled
+    program."""
+
+    def decode(params, buffers, tok, pos, caches):
+        with _bound(model, params, buffers):
+            t = Tensor(data=tok, device=_dev(model), requires_grad=False)
+            logits, caches = model.forward_cached(t, caches=caches,
+                                                  pos=pos)
+        return logits.data[:, 0, :], caches
+
+    return decode
+
+
 class _GenSession:
     """Compiled prefill + whole-generation programs for one
     (batch, prompt, total) shape.
@@ -74,23 +113,11 @@ class _GenSession:
         self.total_len = total_len
         self._decode_all_cache: Dict = {}
         self._beam_all_cache: Dict = {}
-
-        def prefill(params, buffers, ids):
-            with _bound(model, params, buffers):
-                t = Tensor(data=ids, device=_dev(model), requires_grad=False)
-                logits, caches = model.forward_cached(
-                    t, caches=model.init_caches(batch, total_len), pos=0)
-            return logits.data[:, -1, :], caches
-
-        def decode(params, buffers, tok, pos, caches):
-            with _bound(model, params, buffers):
-                t = Tensor(data=tok, device=_dev(model), requires_grad=False)
-                logits, caches = model.forward_cached(t, caches=caches,
-                                                      pos=pos)
-            return logits.data[:, 0, :], caches
-
-        self.prefill = jax.jit(prefill)
-        self.decode = jax.jit(decode, donate_argnums=(4,))
+        # prefill/decode closures shared with serve.engine (one source
+        # of truth for the cached forward — the engine's greedy decode
+        # is token-identical by construction)
+        self.prefill = jax.jit(prefill_step(model, total_len))
+        self.decode = jax.jit(decode_step(model), donate_argnums=(4,))
 
     def decode_all_fn(self, n: int, temperature: float,
                       top_k: Optional[int], top_p: Optional[float],

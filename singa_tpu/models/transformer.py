@@ -216,11 +216,17 @@ class GPT2(GenerateMixin, model.Model):
 
     def forward_cached(self, ids: Tensor, caches, pos):
         T = ids.shape[-1]
-        if isinstance(pos, int):
-            positions = jnp.arange(pos, pos + T, dtype=jnp.int32)
+        if getattr(pos, "ndim", 0):
+            # per-row positions (continuous batching — serve.engine):
+            # row b embeds absolute positions [pos[b], pos[b]+T)
+            grid = pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
         else:
-            positions = pos + jnp.arange(T, dtype=jnp.int32)
-        pos_t = Tensor(data=jnp.broadcast_to(positions[None, :], ids.shape),
+            if isinstance(pos, int):
+                positions = jnp.arange(pos, pos + T, dtype=jnp.int32)
+            else:
+                positions = pos + jnp.arange(T, dtype=jnp.int32)
+            grid = positions[None, :]
+        pos_t = Tensor(data=jnp.broadcast_to(grid, ids.shape),
                        device=ids.device, requires_grad=False)
         x = self.wte(ids) + self.wpe(pos_t)
         x = self.drop(x)

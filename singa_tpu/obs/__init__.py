@@ -19,11 +19,13 @@ protection rule.
 """
 
 from . import events, record, schema
-from .events import configure, counter, gauge, span, trace_span
+from .events import (configure, counter, gauge, histogram,
+                     histogram_summary, reset_histograms, span, trace_span)
 from .record import RunRecord, is_onchip_session_doc, new_entry, new_run_id
 from .schema import SCHEMA_VERSION, SchemaError, require
 
 __all__ = ["schema", "record", "events", "RunRecord", "SchemaError",
            "SCHEMA_VERSION", "require", "new_entry", "new_run_id",
            "is_onchip_session_doc", "configure", "counter", "gauge",
-           "span", "trace_span"]
+           "span", "trace_span", "histogram", "histogram_summary",
+           "reset_histograms"]

@@ -41,7 +41,8 @@ import time
 from typing import Any, Dict, Optional
 
 __all__ = ["JsonlSink", "configure", "enabled", "get_sink", "span",
-           "trace_span", "counter", "gauge"]
+           "trace_span", "counter", "gauge", "histogram",
+           "histogram_summary", "reset_histograms"]
 
 
 class JsonlSink:
@@ -144,6 +145,97 @@ def gauge(name: str, value, **attrs) -> None:
     if _sink is not None:
         attrs["value"] = value
         _emit("gauge", name, attrs)
+
+
+#: bounded per-name sample buffer: count/sum/min/max stay exact beyond
+#: this; percentiles are computed over a deterministic ring of the most
+#: recent _HIST_CAP observations (no RNG — reproducible summaries)
+_HIST_CAP = 4096
+
+
+class _Hist:
+    __slots__ = ("count", "total", "vmin", "vmax", "samples")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.vmin = float("inf")
+        self.vmax = float("-inf")
+        self.samples: list = []
+
+    def observe(self, v: float) -> None:
+        self.count += 1
+        self.total += v
+        self.vmin = min(self.vmin, v)
+        self.vmax = max(self.vmax, v)
+        if len(self.samples) < _HIST_CAP:
+            self.samples.append(v)
+        else:
+            self.samples[(self.count - 1) % _HIST_CAP] = v
+
+    def summary(self) -> Optional[Dict[str, Any]]:
+        """{count, sum, mean, min, max, p50, p90, p99}, or None when
+        nothing was observed yet."""
+        if not self.count:
+            return None
+        vals = sorted(self.samples)
+        return {"count": self.count, "sum": self.total,
+                "mean": self.total / self.count, "min": self.vmin,
+                "max": self.vmax,
+                "p50": _percentile(vals, 50.0),
+                "p90": _percentile(vals, 90.0),
+                "p99": _percentile(vals, 99.0)}
+
+
+_hists: Dict[str, _Hist] = {}
+_hist_lock = threading.Lock()
+
+
+def histogram(name: str, value, **attrs) -> None:
+    """One observation of a distribution (a latency, a queue wait).
+
+    Unlike counter/gauge, histograms ALWAYS aggregate in-process —
+    cheaply (one list append under a lock) — because their consumers
+    (serve.metrics TTFT/per-token percentiles, the serve_throughput
+    bench) need summaries even when no JSONL sink is installed.  With a
+    sink, each observation is additionally emitted as a
+    ``{"kind": "hist", "name": ..., "value": ...}`` line."""
+    v = float(value)
+    with _hist_lock:
+        h = _hists.get(name)
+        if h is None:
+            h = _hists[name] = _Hist()
+        h.observe(v)
+    if _sink is not None:
+        attrs["value"] = v
+        _emit("hist", name, attrs)
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over the retained samples."""
+    i = min(len(sorted_vals) - 1, max(0, int(round(
+        q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[i]
+
+
+def histogram_summary(name: str) -> Optional[Dict[str, Any]]:
+    """{count, sum, mean, min, max, p50, p90, p99} for ``name``, or
+    None when nothing was observed.  count/sum/min/max are exact over
+    every observation; percentiles come from the retained ring (the
+    most recent ``_HIST_CAP`` samples)."""
+    with _hist_lock:
+        h = _hists.get(name)
+        return h.summary() if h is not None else None
+
+
+def reset_histograms(name: Optional[str] = None) -> None:
+    """Drop one histogram's aggregates (or all of them) — a bench run
+    isolating its own window calls this before the measured phase."""
+    with _hist_lock:
+        if name is None:
+            _hists.clear()
+        else:
+            _hists.pop(name, None)
 
 
 class _NullCtx:

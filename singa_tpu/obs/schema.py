@@ -36,12 +36,18 @@ from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["SCHEMA_VERSION", "SchemaError", "require", "validate_entry",
            "validate_stage", "validate_session_doc", "validate_bench_doc",
-           "validate_multichip_doc", "entry_key"]
+           "validate_multichip_doc", "validate_serve_payload", "entry_key"]
 
 #: bump when entry fields change incompatibly; validators dispatch on it
 SCHEMA_VERSION = 1
 
-_KINDS = ("session", "bench")
+_KINDS = ("session", "bench", "serve_throughput")
+
+#: required numeric payload fields of a serve_throughput entry — the
+#: serving bench's headline quantities (tools/record_check.py lints
+#: committed serving records against these alongside the training ones)
+_SERVE_FIELDS = ("tokens_per_s", "speedup_vs_sequential", "ttft_p50_ms",
+                 "ttft_p99_ms", "requests")
 
 
 class SchemaError(ValueError):
@@ -141,6 +147,19 @@ def validate_entry(entry: Any, ctx: str = "entry") -> None:
         _expect(isinstance(payload, dict),
                 f"{ctx}: 'payload' must be an object, got "
                 f"{type(payload).__name__}", field="payload")
+        if kind == "serve_throughput":
+            validate_serve_payload(payload, f"{ctx}: serve payload")
+
+
+def validate_serve_payload(payload: Any, ctx: str = "serve payload") -> None:
+    """The serving bench's headline quantities: every field in
+    ``_SERVE_FIELDS`` present and numeric (a serving record with a
+    missing TTFT percentile is the r5 silent-truncation failure mode
+    wearing a new hat)."""
+    for f in _SERVE_FIELDS:
+        v = require(payload, f, ctx)
+        _expect(isinstance(v, (int, float)) and not isinstance(v, bool),
+                f"{ctx}: {f!r} must be numeric, got {v!r}", field=f)
 
 
 def validate_session_doc(doc: Any, ctx: str = "session record") -> None:

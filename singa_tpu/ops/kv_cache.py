@@ -33,7 +33,15 @@ def init_cache(num_layers: int, batch: int, max_len: int, num_kv_heads: int,
 def update_cache(ck, cv, k_new, v_new, pos):
     """Write k/v for positions [pos, pos+T) into the cache (functional).
 
-    `pos` may be a traced scalar — decode steps compile once and slide."""
+    `pos` may be a traced scalar — decode steps compile once and slide —
+    or a traced (B,) vector (continuous batching, serve.engine): row b's
+    new keys land at its own positions [pos[b], pos[b]+T), so slots at
+    different generation depths share ONE compiled decode step."""
+    if getattr(pos, "ndim", 0):
+        def row(c, n, p):
+            return jax.lax.dynamic_update_slice_in_dim(c, n, p, axis=0)
+        return (jax.vmap(row)(ck, k_new.astype(ck.dtype), pos),
+                jax.vmap(row)(cv, v_new.astype(cv.dtype), pos))
     ck = jax.lax.dynamic_update_slice_in_dim(ck, k_new.astype(ck.dtype),
                                              pos, axis=1)
     cv = jax.lax.dynamic_update_slice_in_dim(cv, v_new.astype(cv.dtype),
@@ -46,21 +54,25 @@ def cached_sdpa(q, ck, cv, limit, scale: float = None, mask=None,
     """Attention of q (B, T, H, D) against the full cache (B, S, K, D),
     masked to cache positions < `limit` plus bottom-right-aligned
     causality inside the query block (query t attends cache positions
-    <= limit - T + t).  GQA (H % K == 0) and the grouped einsums are
-    delegated to attention._sdpa_reference — one attention math, two
-    entry points.  `mask`: optional (B, 1|H, 1|T, S) boolean padding
-    mask ANDed with the validity window.  `window`: Mistral-style
-    sliding window — each query also ignores cache positions more than
-    `window - 1` behind it."""
+    <= limit - T + t).  `limit` may be a scalar or a (B,) vector of
+    per-row limits (continuous batching: every slot attends its own
+    prefix inside one compiled step).  GQA (H % K == 0) and the grouped
+    einsums are delegated to attention._sdpa_reference — one attention
+    math, two entry points.  `mask`: optional (B, 1|H, 1|T, S) boolean
+    padding mask ANDed with the validity window.  `window`:
+    Mistral-style sliding window — each query also ignores cache
+    positions more than `window - 1` behind it."""
     from .attention import _sdpa_reference
     T = q.shape[1]
     S = ck.shape[1]
     scale = scale or (1.0 / math.sqrt(q.shape[-1]))
-    kpos = jnp.arange(S)[None, :]                       # (1, S)
-    qpos = limit - T + jnp.arange(T)[:, None]           # (T, 1)
-    valid = (kpos <= qpos)[None, None]                  # (1, 1, T, S)
+    kpos = jnp.arange(S)[None, None, None, :]           # (1, 1, 1, S)
+    lim = jnp.asarray(limit)
+    lim = lim.reshape((-1, 1, 1, 1)) if lim.ndim else lim
+    qpos = lim - T + jnp.arange(T)[None, None, :, None]  # (B|1, 1, T, 1)
+    valid = kpos <= qpos                                 # (B|1, 1, T, S)
     if window is not None:
-        valid = jnp.logical_and(valid, (kpos > qpos - window)[None, None])
+        valid = jnp.logical_and(valid, kpos > qpos - window)
     if mask is not None:
         valid = jnp.logical_and(valid, mask)
     return _sdpa_reference(q, ck, cv, False, valid, scale)

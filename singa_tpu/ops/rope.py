@@ -61,9 +61,19 @@ def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0,
 def _rope_fn(x, cos, sin, offset=0):
     # x: (B, T, H, D); tables sliced to [offset, offset+T).  `offset` may
     # be a traced scalar (KV-cached decoding) — dynamic_slice keeps the
-    # compiled decode step position-independent.
+    # compiled decode step position-independent — or a traced (B,)
+    # vector (continuous-batching decode, serve.engine): row b reads
+    # table rows [offset[b], offset[b]+T), so every slot rotates at its
+    # own position inside ONE compiled step.
     import jax
     T = x.shape[1]
+    if getattr(offset, "ndim", 0):
+        idx = offset[:, None] + jnp.arange(T)[None, :]       # (B, T)
+        c = jnp.take(cos, idx, axis=0)[:, :, None, :]        # (B, T, 1, D/2)
+        s = jnp.take(sin, idx, axis=0)[:, :, None, :]
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        out = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+        return out.astype(x.dtype)
     if isinstance(offset, int) and offset == 0:
         c, s = cos[:T], sin[:T]
     else:

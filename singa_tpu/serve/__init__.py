@@ -1,0 +1,29 @@
+"""singa_tpu.serve — continuous-batching inference engine (ISSUE 2).
+
+The serving counterpart of the Graph/Scheduler training layer: the
+whole serving lifetime runs through exactly two compiled XLA programs.
+
+* :mod:`~singa_tpu.serve.slots` — :class:`SlotPool`, the fixed
+  (num_slots, max_len) KV-cache arena built on ``ops/kv_cache``;
+  admit/evict are pure index updates, freed slots are reused without
+  recompilation.
+* :mod:`~singa_tpu.serve.scheduler` — FIFO queue, admission control
+  (:class:`QueueFull` backpressure), per-request deadlines and token
+  budgets, eviction policy.
+* :mod:`~singa_tpu.serve.engine` — :class:`ServeEngine`:
+  ``submit() / step() / run_until_idle()``, streaming token callbacks,
+  greedy decode token-identical to ``GenerateMixin.generate``.
+* :mod:`~singa_tpu.serve.metrics` — queue/slot gauges, admit/reject/
+  evict counters, TTFT and per-token latency histograms through
+  ``obs.events``.
+
+See docs/serving.md for the architecture, the slot lifecycle and the
+backpressure semantics.
+"""
+
+from .engine import ServeEngine
+from .scheduler import QueueFull, RequestHandle, Scheduler
+from .slots import SlotPool
+
+__all__ = ["ServeEngine", "SlotPool", "Scheduler", "RequestHandle",
+           "QueueFull"]

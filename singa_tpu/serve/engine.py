@@ -1,0 +1,320 @@
+"""ServeEngine — continuous-batching inference over a slot arena.
+
+The engine turns the one-session decode loop of
+``models/_generate.py`` into a multi-request server while keeping the
+training stack's single-compiled-module discipline: for a given
+(model, num_slots, max_len) it compiles exactly TWO XLA programs —
+
+* **prefill-into-slot** — one request's prompt (padded to
+  ``prefill_len``, true length passed as a traced scalar) runs the
+  model's cached forward against a fresh cache row, which is then
+  written into the arena at a traced slot index.  Variable prompt
+  lengths therefore never change the compiled shape.
+* **decode-over-slots** — ONE token for every slot per dispatch, with
+  per-slot positions: RoPE offsets, cache scatters and attention
+  limits are all (num_slots,) vectors inside the program (the ops
+  layer grew per-row variants for exactly this), and inactive slots
+  are masked — their position is clamped to 0 and their logits zeroed,
+  so a half-empty arena still runs the same program.
+
+Both programs thread params/buffers as jit arguments through the same
+``_bound`` rebinding as generation, so weights are never baked into the
+executables, and both donate the arena, so cache memory is updated in
+place.  Submitting, admitting and evicting requests are host-side index
+updates — no recompilation ever happens after warmup (asserted in
+tests/test_serve.py via the jit cache size).
+
+Greedy decode through the engine is token-identical to
+``GenerateMixin.generate`` (same prefill/decode closures, same argmax),
+which anchors the whole subsystem's correctness to existing behavior.
+
+The engine loop is guarded by ``utils.failure.Heartbeat`` when
+``heartbeat_timeout_s`` is set: a hung device dispatch surfaces as a
+clean abort instead of wedging the server.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import nullcontext
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models._generate import _bound, decode_step, prefill_step
+from ..obs import events
+from ..utils.failure import Heartbeat
+from .metrics import ServeMetrics
+from .scheduler import (EVICTED, FINISHED, RUNNING, QueueFull, Request,
+                        RequestHandle, Scheduler)
+from .slots import SlotPool
+
+__all__ = ["ServeEngine", "QueueFull"]
+
+
+class ServeEngine:
+    """Continuous-batching engine over one decoder model.
+
+        eng = ServeEngine(model, num_slots=8, max_len=256)
+        h = eng.submit(prompt_ids, max_new_tokens=64, deadline_s=30.0)
+        eng.run_until_idle()
+        full = h.result()              # prompt + generated tokens
+
+    ``step()`` advances the whole arena by one decode tick (evict →
+    admit/prefill → decode), delivering one token to every live request
+    and invoking their streaming ``on_token`` callbacks.
+
+    Decoding is greedy — the serving counterpart of
+    ``generate(temperature=0)`` and token-identical to it.
+    """
+
+    def __init__(self, model, num_slots: int, max_len: int, *,
+                 prefill_len: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 param_dtype=None,
+                 heartbeat_timeout_s: Optional[float] = None,
+                 on_failure=None):
+        self.model = model
+        self.prefill_len = int(prefill_len or max_len - 1)
+        if not 0 < self.prefill_len < max_len:
+            raise ValueError(
+                f"prefill_len must be in (0, max_len), got "
+                f"{self.prefill_len} for max_len {max_len}")
+        max_pos = getattr(getattr(model, "cfg", None), "max_position", None)
+        if max_pos is not None and max_len > max_pos:
+            raise ValueError(
+                f"max_len ({max_len}) exceeds the model's max_position "
+                f"({max_pos})")
+        self.sched = Scheduler(
+            max_queue=2 * num_slots if max_queue is None else max_queue)
+        self.metrics = ServeMetrics()
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self._on_failure = on_failure
+
+        # weights snapshotted once (same pattern as _gen_setup); decode
+        # is weight-read bound, so an optional one-time bf16 cast halves
+        # per-token HBM traffic on TPU
+        params = {n: t.data for n, t in model.get_params().items()}
+        if not params:
+            raise ValueError(
+                "model has no initialized params — call model.compile() "
+                "(or run one forward) before building a ServeEngine")
+        buffers = {n: t.data for n, t in model._get_buffers().items()}
+        arena_dtype = None
+        if param_dtype is not None:
+            params = {n: (a.astype(param_dtype)
+                          if jnp.issubdtype(a.dtype, jnp.floating) else a)
+                      for n, a in params.items()}
+            # the arena must match the dtype init_caches picks under the
+            # CAST params inside the prefill trace (models size their
+            # caches off the bound weights' dtype) — otherwise the
+            # fresh-row splice type-mismatches at trace time.  eval_shape
+            # under the cast binding reads that dtype without allocating.
+            with _bound(model, params, buffers):
+                spec = jax.eval_shape(lambda: model.init_caches(1, 2))
+            arena_dtype = jax.tree.leaves(spec)[0].dtype
+        self._params, self._buffers = params, buffers
+        self.pool = SlotPool(model, num_slots, max_len, dtype=arena_dtype)
+
+        self._running: Dict[int, Request] = {}      # slot -> request
+        # device-resident per-slot last tokens: written by prefill (the
+        # request's first token) and decode (each next token); the host
+        # only ever FETCHES this small int vector — tokens are never
+        # uploaded, so the decode hot loop is one dispatch + one tiny
+        # fetch per tick
+        self._toks = jnp.zeros((num_slots,), jnp.int32)
+
+        # ---- the exactly-two compiled programs --------------------------
+        pf = prefill_step(model, max_len, last_only=False)
+
+        def prefill_into_slot(params, buffers, ids, length, slot, toks,
+                              caches):
+            logits, fresh = pf(params, buffers, ids)
+            last = jax.lax.dynamic_slice_in_dim(
+                logits, length - 1, 1, axis=1)[:, 0, :]
+            # greedy pick in-program (jnp.argmax — bit-identical to
+            # _pick_impl's temperature-0 branch in generate())
+            tok = jnp.argmax(last, axis=-1).astype(jnp.int32)[0]
+            toks = toks.at[slot].set(tok)
+            new = [
+                (jax.lax.dynamic_update_slice_in_dim(ak, fk, slot, axis=0),
+                 jax.lax.dynamic_update_slice_in_dim(av, fv, slot, axis=0))
+                for (ak, av), (fk, fv) in zip(caches, fresh)]
+            return toks, new
+
+        dec = decode_step(model)
+
+        def decode_over_slots(params, buffers, toks, pos, active, caches):
+            # inactive slots are masked: position clamped to 0 (their
+            # stale cache row is overwritten wholesale by the next
+            # prefill, so the position-0 scribble is harmless and keeps
+            # every row's attention window non-empty → no NaN softmax),
+            # and their token entry frozen so nothing downstream reads a
+            # garbage argmax
+            posc = jnp.where(active, pos, 0)
+            logits, caches = dec(params, buffers, toks[:, None], posc,
+                                 caches)
+            picked = jnp.argmax(logits.astype(jnp.float32),
+                                axis=-1).astype(jnp.int32)
+            new_toks = jnp.where(active, picked, toks)
+            new_pos = jnp.where(active, pos + 1, pos)
+            return new_toks, new_pos, caches
+
+        self._prefill = jax.jit(prefill_into_slot, donate_argnums=(6,))
+        self._decode = jax.jit(decode_over_slots, donate_argnums=(5,))
+
+    # -- introspection ----------------------------------------------------
+    def compiled_counts(self):
+        """(prefill, decode) jit-cache entry counts — the no-recompile
+        invariant says both stay at 1 after warmup (tested)."""
+        return (self._prefill._cache_size(), self._decode._cache_size())
+
+    @property
+    def pending(self) -> int:
+        """Requests still in flight (queued + running)."""
+        return self.sched.depth + len(self._running)
+
+    # -- submission --------------------------------------------------------
+    def submit(self, prompt_ids, *, max_new_tokens: int,
+               deadline_s: Optional[float] = None,
+               eos_id: Optional[int] = None,
+               on_token=None) -> RequestHandle:
+        """Queue one generation request; returns its handle.
+
+        Raises :class:`QueueFull` when admission control refuses the
+        request — the wait queue is at capacity.  Admission out of the
+        queue into slots happens only at ``step()`` boundaries, so a
+        burst of more than ``max_queue`` un-stepped submissions is
+        rejected even while slots are free (size ``max_queue`` for the
+        largest burst to absorb; default ``2 * num_slots``).  Raises
+        ``ValueError`` when the request cannot ever fit the arena
+        (prompt longer than ``prefill_len``, or prompt + budget past
+        ``max_len`` — the arena guarantee that decode never writes out
+        of bounds is enforced here, at the door)."""
+        req = Request(prompt_ids, max_new_tokens, deadline_s, eos_id,
+                      on_token)
+        p = req.prompt.size
+        if p > self.prefill_len:
+            raise ValueError(
+                f"prompt ({p} tokens) exceeds prefill_len "
+                f"({self.prefill_len})")
+        if p + req.max_new_tokens > self.pool.max_len:
+            raise ValueError(
+                f"prompt ({p}) + max_new_tokens ({req.max_new_tokens}) "
+                f"= {p + req.max_new_tokens} exceeds max_len "
+                f"({self.pool.max_len})")
+        try:
+            self.sched.offer(req)
+        except QueueFull:
+            self.metrics.on_reject()
+            raise
+        self.metrics.on_submit()
+        return req.handle
+
+    # -- the engine loop ---------------------------------------------------
+    def step(self) -> int:
+        """One continuous-batching tick: deadline eviction → admission
+        (prefill queued requests into free slots) → one decode over all
+        active slots.  Returns the number of tokens delivered."""
+        with events.span("serve.step"):
+            now = time.monotonic()
+            delivered = 0
+
+            # 1. deadline eviction — queued requests that died waiting
+            #    and running requests past their deadline vacate first,
+            #    so their slots are admittable this same tick
+            for req in self.sched.expire_queued(now):
+                self.metrics.on_evict("deadline")
+            for slot in [s for s, r in self._running.items()
+                         if r.expired(now)]:
+                req = self._running[slot]
+                req.finish_reason = "deadline"
+                self._finalize(slot, evicted=True)
+
+            # 2. admission — prefill into free slots between decode steps
+            while self.pool.free_count:
+                req = self.sched.pop_for_admission()
+                if req is None:
+                    break
+                delivered += self._admit(req)
+
+            # 3. one decode tick over the whole arena
+            if self._running:
+                delivered += self._decode_tick()
+
+            self.metrics.on_step(self.sched.depth, self.pool.active_count)
+        return delivered
+
+    def run_until_idle(self, max_steps: Optional[int] = None) -> None:
+        """Drive ``step()`` until no request is queued or running.  With
+        ``heartbeat_timeout_s`` set, a Heartbeat watchdog guards every
+        tick — a hung decode (dead device, wedged tunnel) aborts cleanly
+        instead of wedging the server."""
+        hb = Heartbeat(timeout=self.heartbeat_timeout_s,
+                       on_failure=self._on_failure) \
+            if self.heartbeat_timeout_s else None
+        n = 0
+        with hb if hb is not None else nullcontext():
+            while self.pending:
+                self.step()
+                n += 1
+                if hb is not None:
+                    hb.beat(n)
+                if max_steps is not None and n >= max_steps:
+                    break
+
+    # -- internals ---------------------------------------------------------
+    def _admit(self, req: Request) -> int:
+        slot = self.pool.alloc()
+        assert slot is not None, "admission with no free slot"
+        P = req.prompt.size
+        ids = np.zeros((1, self.prefill_len), np.int32)
+        ids[0, :P] = req.prompt
+        with events.span("serve.prefill", slot=slot, prompt=P):
+            self._toks, self.pool.caches = self._prefill(
+                self._params, self._buffers, jnp.asarray(ids),
+                jnp.asarray(P, jnp.int32), jnp.asarray(slot, jnp.int32),
+                self._toks, self.pool.caches)
+            tok = int(np.asarray(self._toks)[slot])
+        self.pool.activate(slot, P)
+        req.slot = slot
+        req.state = RUNNING
+        self._running[slot] = req
+        self.metrics.on_admit()
+        done = req.deliver(tok)       # prefill yields the first token
+        self.metrics.on_first_token(req.ttft_s)
+        if req.on_token is not None:
+            req.on_token(tok, req.handle)
+        if done:
+            self._finalize(slot)
+        return 1
+
+    def _decode_tick(self) -> int:
+        t0 = time.perf_counter()
+        with events.span("serve.decode", active=len(self._running)):
+            self._toks, new_pos, self.pool.caches = self._decode(
+                self._params, self._buffers, self._toks,
+                self.pool.pos, self.pool.active, self.pool.caches)
+            toks = np.asarray(self._toks)    # tiny fetch: num_slots ints
+        self.pool.pos = new_pos
+        dt = time.perf_counter() - t0
+        delivered = 0
+        for slot in list(self._running):
+            req = self._running[slot]
+            tok = int(toks[slot])
+            done = req.deliver(tok)
+            self.metrics.on_token(dt)
+            if req.on_token is not None:
+                req.on_token(tok, req.handle)
+            delivered += 1
+            if done:
+                self._finalize(slot)
+        return delivered
+
+    def _finalize(self, slot: int, evicted: bool = False) -> None:
+        req = self._running.pop(slot)
+        self.pool.release(slot)
+        req.state = EVICTED if evicted else FINISHED
+        self.metrics.on_evict(req.finish_reason or "unknown")

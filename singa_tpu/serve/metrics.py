@@ -1,0 +1,102 @@
+"""Serving telemetry: queue/slot gauges, admission counters, latency
+histograms — all through the shared ``obs.events`` layer, so a single
+``SINGA_OBS=/path.jsonl`` env var captures training AND serving events
+in one stream.
+
+Metric names (documented in docs/serving.md):
+
+==========================  =========  ==================================
+name                        kind       meaning
+==========================  =========  ==================================
+``serve.submitted``         counter    requests accepted by submit()
+``serve.admitted``          counter    prefilled into a slot
+``serve.rejected``          counter    refused at submit (queue full)
+``serve.evicted``           counter    left the system — a slot vacated
+                                       (``eos``/``length``/``deadline``)
+                                       or a queued request dropped at
+                                       its deadline (``reason`` attr)
+``serve.queue_depth``       gauge      waiting requests, after each step
+``serve.active_slots``      gauge      live slots, after each step
+``serve.step``              span       one engine step (host wall clock)
+``serve.prefill``           span       one prefill dispatch (+ fetch)
+``serve.decode``            span       one decode dispatch (+ fetch)
+``serve.ttft_ms``           histogram  submit → first token
+``serve.token_ms``          histogram  per generated token, decode path
+==========================  =========  ==================================
+
+Counters/gauges cost one attribute check when no sink is configured.
+Latency aggregation is PER ENGINE: each ServeMetrics owns its own
+histogram state (``snapshot()`` reads it), so two engines in one
+process never reset or pollute each other's percentiles; the emitted
+``serve.ttft_ms``/``serve.token_ms`` sink lines keep the documented
+names (the global ``events.histogram_summary`` view then spans every
+engine — by design for a whole-process dashboard).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..obs import events
+# per-engine aggregation state reuses the events-layer histogram
+# implementation (exact totals + bounded deterministic sample ring)
+from ..obs.events import _Hist
+
+__all__ = ["ServeMetrics"]
+
+
+class ServeMetrics:
+    """Thin per-engine facade: exact local totals (for snapshots/tests)
+    plus pass-through emission to the shared obs sink."""
+
+    def __init__(self):
+        self.submitted = 0
+        self.admitted = 0
+        self.rejected = 0
+        self.evicted: Dict[str, int] = {}
+        self.steps = 0
+        self._ttft = _Hist()
+        self._token = _Hist()
+
+    # -- request lifecycle ------------------------------------------------
+    def on_submit(self) -> None:
+        self.submitted += 1
+        events.counter("serve.submitted", 1)
+
+    def on_reject(self) -> None:
+        self.rejected += 1
+        events.counter("serve.rejected", 1)
+
+    def on_admit(self) -> None:
+        self.admitted += 1
+        events.counter("serve.admitted", 1)
+
+    def on_evict(self, reason: str) -> None:
+        self.evicted[reason] = self.evicted.get(reason, 0) + 1
+        events.counter("serve.evicted", 1, reason=reason)
+
+    # -- latency ----------------------------------------------------------
+    def on_first_token(self, ttft_s: float) -> None:
+        self._ttft.observe(ttft_s * 1e3)
+        events.histogram("serve.ttft_ms", ttft_s * 1e3)
+
+    def on_token(self, latency_s: float) -> None:
+        self._token.observe(latency_s * 1e3)
+        events.histogram("serve.token_ms", latency_s * 1e3)
+
+    # -- per-step levels ---------------------------------------------------
+    def on_step(self, queue_depth: int, active_slots: int) -> None:
+        self.steps += 1
+        events.gauge("serve.queue_depth", queue_depth)
+        events.gauge("serve.active_slots", active_slots)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Exact totals + THIS engine's latency summaries (None until
+        observed)."""
+        return {
+            "submitted": self.submitted, "admitted": self.admitted,
+            "rejected": self.rejected, "evicted": dict(self.evicted),
+            "steps": self.steps,
+            "ttft_ms": self._ttft.summary(),
+            "token_ms": self._token.summary(),
+        }

@@ -1,0 +1,166 @@
+"""Continuous-batching scheduler: request queue, admission control,
+deadlines, eviction policy.
+
+Pure host-side policy — no device code.  The engine asks the scheduler
+three questions each step: who expired (deadline eviction, including
+requests that died *waiting in the queue*), who to admit into the free
+slots (FIFO — prefill interleaves between decode steps), and whether a
+running request just finished (EOS / token budget / deadline).  Keeping
+policy out of the engine keeps the two compiled programs policy-free:
+scheduling decisions can change per step without touching XLA.
+
+Admission control (backpressure): `offer()` refuses requests beyond
+``max_queue`` waiting entries by raising :class:`QueueFull` — callers
+see rejection at submit time, not a silently growing queue.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from typing import Callable, Deque, List, Optional
+
+import numpy as np
+
+__all__ = ["QueueFull", "Request", "RequestHandle", "Scheduler",
+           "QUEUED", "RUNNING", "FINISHED", "EVICTED"]
+
+QUEUED = "queued"
+RUNNING = "running"
+FINISHED = "finished"
+EVICTED = "evicted"
+
+
+class QueueFull(RuntimeError):
+    """Admission refused: the wait queue is at capacity.  (Queued
+    requests drain into slots only at step() boundaries, so a large
+    enough burst between ticks is refused even while slots are free —
+    bounded queueing is the backpressure contract.)  The caller should
+    shed load or retry later."""
+
+
+class Request:
+    """One generation request's full lifecycle state (engine-internal;
+    users hold the :class:`RequestHandle` view)."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt_ids, max_new_tokens: int,
+                 deadline_s: Optional[float],
+                 eos_id: Optional[int],
+                 on_token: Optional[Callable[[int, "RequestHandle"], None]]):
+        self.rid = next(Request._ids)
+        self.prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        self.submitted_at = time.monotonic()
+        self.deadline = (self.submitted_at + float(deadline_s)
+                         if deadline_s is not None else None)
+        self.eos_id = eos_id
+        self.on_token = on_token
+        self.state = QUEUED
+        self.slot: Optional[int] = None
+        self.tokens: List[int] = []
+        self.finish_reason: Optional[str] = None
+        self.ttft_s: Optional[float] = None
+        self.handle = RequestHandle(self)
+
+    # -- transitions (called by the engine) ------------------------------
+    def deliver(self, tok: int) -> bool:
+        """Record one generated token; returns True when the request is
+        now complete (EOS emitted or token budget spent).  The EOS token
+        itself is kept — same convention as GenerateMixin.generate."""
+        if self.ttft_s is None:
+            self.ttft_s = time.monotonic() - self.submitted_at
+        self.tokens.append(int(tok))
+        if self.eos_id is not None and int(tok) == self.eos_id:
+            self.finish_reason = "eos"
+            return True
+        if len(self.tokens) >= self.max_new_tokens:
+            self.finish_reason = "length"
+            return True
+        return False
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
+
+
+class RequestHandle:
+    """User-facing view of a submitted request (returned by
+    ``ServeEngine.submit``)."""
+
+    def __init__(self, req: Request):
+        self._req = req
+
+    @property
+    def rid(self) -> int:
+        return self._req.rid
+
+    @property
+    def status(self) -> str:
+        return self._req.state
+
+    @property
+    def done(self) -> bool:
+        return self._req.state in (FINISHED, EVICTED)
+
+    @property
+    def finish_reason(self) -> Optional[str]:
+        """'eos' | 'length' | 'deadline' (None while in flight)."""
+        return self._req.finish_reason
+
+    @property
+    def tokens(self) -> List[int]:
+        """Generated token ids so far (no prompt)."""
+        return list(self._req.tokens)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        return self._req.ttft_s
+
+    def result(self) -> np.ndarray:
+        """prompt + generated tokens as one int32 vector."""
+        return np.concatenate([self._req.prompt,
+                               np.asarray(self._req.tokens, np.int32)])
+
+
+class Scheduler:
+    """FIFO queue + admission/eviction policy over a fixed slot count."""
+
+    def __init__(self, max_queue: int):
+        if max_queue < 0:
+            raise ValueError(f"max_queue must be >= 0, got {max_queue}")
+        self.max_queue = max_queue
+        self.queue: Deque[Request] = deque()
+
+    @property
+    def depth(self) -> int:
+        return len(self.queue)
+
+    def offer(self, req: Request) -> None:
+        """Enqueue, or raise :class:`QueueFull` (admission control)."""
+        if len(self.queue) >= self.max_queue:
+            raise QueueFull(
+                f"queue full ({len(self.queue)}/{self.max_queue} waiting); "
+                f"request rejected — shed load or raise max_queue")
+        self.queue.append(req)
+
+    def expire_queued(self, now: float) -> List[Request]:
+        """Drop queued requests already past their deadline (they would
+        only waste a prefill).  Returns the dropped requests."""
+        dead = [r for r in self.queue if r.expired(now)]
+        if dead:
+            self.queue = deque(r for r in self.queue if not r.expired(now))
+            for r in dead:
+                r.state = EVICTED
+                r.finish_reason = "deadline"
+        return dead
+
+    def pop_for_admission(self) -> Optional[Request]:
+        """Next request to prefill into a free slot (FIFO), or None."""
+        return self.queue.popleft() if self.queue else None

@@ -1,0 +1,96 @@
+"""Slot-based KV-cache pool for continuous-batching inference.
+
+The arena is the model's own static KV cache (ops/kv_cache.init_cache)
+with the batch axis reinterpreted as SLOTS: a fixed
+(num_slots, max_len, K, D) buffer pair per layer, allocated once.  A
+request is admitted by prefilling its prompt into one slot row and
+evicted by returning the slot index to the free list — both are pure
+index updates against fixed-shape arrays, so the engine's two compiled
+programs serve every admit/evict/decode for the lifetime of the pool
+(the same single-compiled-module discipline the Graph/Scheduler layer
+enforces for training).
+
+Per-slot ``pos``/``active`` state lives in device arrays (int32/bool
+vectors of length num_slots): they are inputs of the decode program, and
+admit/evict mutate them with ``.at[slot].set`` — tiny cached index-update
+dispatches, never a recompile.  Freed slots are NOT scrubbed: the next
+prefill overwrites the slot's entire (max_len) cache row, and decode
+masks every slot to its own validity window (cached_sdpa per-row
+``limit``), so stale keys beyond a slot's ``pos`` are unreachable.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+__all__ = ["SlotPool"]
+
+
+class SlotPool:
+    """Fixed arena of `num_slots` KV-cache rows of length `max_len`.
+
+    Host side: a free list of slot indices.  Device side: the per-layer
+    cache arena plus the per-slot ``pos`` (valid prefix length) and
+    ``active`` vectors the decode program consumes.
+    """
+
+    def __init__(self, model, num_slots: int, max_len: int, dtype=None):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2, got {max_len}")
+        self.num_slots = num_slots
+        self.max_len = max_len
+        if dtype is None:
+            self.caches = model.init_caches(num_slots, max_len)
+        else:
+            # allocate straight in the serving dtype (e.g. bf16 under a
+            # param_dtype cast): eval_shape keeps the full-precision
+            # arena abstract, so construction never holds two copies
+            import jax
+            spec = jax.eval_shape(
+                lambda: model.init_caches(num_slots, max_len))
+            self.caches = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, dtype), spec)
+        self.pos = jnp.zeros((num_slots,), jnp.int32)
+        self.active = jnp.zeros((num_slots,), bool)
+        # LIFO reuse: the most recently freed slot is re-prefilled first
+        # (its cache row is hottest in HBM/cache hierarchies)
+        self._free: List[int] = list(range(num_slots - 1, -1, -1))
+
+    # -- host-side bookkeeping -------------------------------------------
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_count(self) -> int:
+        return self.num_slots - len(self._free)
+
+    def alloc(self) -> Optional[int]:
+        """Claim a free slot index, or None when the pool is full (the
+        scheduler's signal to queue/reject — backpressure)."""
+        return self._free.pop() if self._free else None
+
+    def release(self, slot: int) -> None:
+        """Return `slot` to the free list and deactivate it.  The cache
+        row is left as-is; the next prefill overwrites it wholesale."""
+        if slot in self._free:
+            raise ValueError(f"slot {slot} double-freed")
+        self.active = self.active.at[slot].set(False)
+        self.pos = self.pos.at[slot].set(0)
+        self._free.append(slot)
+
+    # -- device-side state transitions -----------------------------------
+    def activate(self, slot: int, length: int) -> None:
+        """Mark `slot` live with `length` valid cache positions (called
+        after its prompt was prefilled into the arena)."""
+        self.pos = self.pos.at[slot].set(length)
+        self.active = self.active.at[slot].set(True)
+
+    def positions(self):
+        """Host copy of per-slot positions (np.ndarray view)."""
+        import numpy as np
+        return np.asarray(self.pos)

@@ -5,7 +5,7 @@ Two measurements, different questions:
 ``windowed_steps`` — training throughput: windows of K back-to-back
 dispatches with ONE fence at the window end, median over windows.
 This is how a real training loop runs (nothing fences per step), so it
-is the honest throughput number.  r5 probe 3 (tools/dispatch_probe3.py)
+is the honest throughput number.  r5 probe 3 (tools/dispatch_probe.py overhead)
 showed per-step-fenced timing carries ~30 ms/step of host dispatch
 overhead on the tunneled chip that pipelined execution fully hides:
 fenced 186.8 ms vs 8-step windows 156.4 ms vs 8 steps compiled into ONE

@@ -1,0 +1,295 @@
+"""Continuous-batching serving engine (singa_tpu.serve, ISSUE 2) —
+tier-1 CPU coverage on LlamaConfig.tiny().
+
+The invariants under test are the subsystem's contract:
+  * greedy decode through the engine is token-identical to
+    GenerateMixin.generate for the same prompts;
+  * exactly TWO compiled programs per (model, num_slots, max_len) —
+    submitting, evicting and reusing slots never recompiles (asserted
+    via the jit cache size);
+  * admission control rejects loudly when the queue is full;
+  * deadlines evict both queued and running requests;
+  * serving metrics flow through the shared obs sink, and the
+    histogram primitive's summary semantics hold.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from singa_tpu import models, tensor
+from singa_tpu.obs import events
+from singa_tpu.serve import QueueFull, ServeEngine
+
+
+@pytest.fixture(scope="module")
+def llama():
+    tensor.set_seed(0)
+    m = models.Llama(models.LlamaConfig.tiny())
+    m.eval()
+    m.compile([tensor.from_numpy(np.zeros((1, 4), np.int32))],
+              is_train=False, use_graph=False)
+    return m
+
+
+@pytest.fixture(scope="module")
+def engine(llama):
+    """Shared engine for the stateless-between-runs tests (each test
+    must drain it: run_until_idle leaves every slot free again)."""
+    return ServeEngine(llama, num_slots=4, max_len=32, prefill_len=12)
+
+
+def _prompts(n, lens, vocab=256, seed=7):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, vocab, (lens[i % len(lens)],)).astype(np.int32)
+            for i in range(n)]
+
+
+class TestGreedyEquivalence:
+    def test_single_request_matches_generate(self, llama, engine):
+        prompt = _prompts(1, [8])[0]
+        ref = llama.generate(prompt[None], max_new_tokens=10)[0, 8:]
+        h = engine.submit(prompt, max_new_tokens=10)
+        engine.run_until_idle()
+        np.testing.assert_array_equal(ref, np.asarray(h.tokens))
+        np.testing.assert_array_equal(
+            h.result(), np.concatenate([prompt, ref]))
+
+    def test_mixed_lengths_concurrent_match_generate(self, llama, engine):
+        """Six requests of four distinct prompt lengths decode
+        concurrently (slots at different positions inside one compiled
+        step) and every stream equals its sequential reference."""
+        prompts = _prompts(6, [3, 5, 8, 11])
+        refs = [llama.generate(p[None], max_new_tokens=9)[0, p.size:]
+                for p in prompts]
+        hs = [engine.submit(p, max_new_tokens=9) for p in prompts]
+        engine.run_until_idle()
+        for ref, h in zip(refs, hs):
+            np.testing.assert_array_equal(ref, np.asarray(h.tokens))
+
+    def test_param_dtype_bf16_matches_generate_bf16(self, llama):
+        """One-time bf16 weight cast (the TPU decode configuration):
+        the arena follows the cast dtype and the streams still match
+        generate(param_dtype=bf16)."""
+        import jax.numpy as jnp
+        prompt = _prompts(1, [6], seed=11)[0]
+        ref = llama.generate(prompt[None], max_new_tokens=8,
+                             param_dtype=jnp.bfloat16)[0, 6:]
+        eng = ServeEngine(llama, num_slots=2, max_len=24, prefill_len=8,
+                          param_dtype=jnp.bfloat16)
+        assert eng.pool.caches[0][0].dtype == jnp.bfloat16
+        h = eng.submit(prompt, max_new_tokens=8)
+        eng.run_until_idle()
+        np.testing.assert_array_equal(ref, np.asarray(h.tokens))
+
+    def test_gpt2_engine_matches_generate(self):
+        """The engine is model-generic: GPT-2's learned-position path
+        (per-row position grids in forward_cached) serves too."""
+        tensor.set_seed(0)
+        m = models.GPT2(models.GPT2Config.tiny())
+        m.compile([tensor.from_numpy(np.zeros((1, 4), np.int32))],
+                  is_train=False, use_graph=False)
+        prompts = _prompts(3, [4, 6, 9])
+        refs = [m.generate(p[None], max_new_tokens=6)[0, p.size:]
+                for p in prompts]
+        eng = ServeEngine(m, num_slots=2, max_len=24, prefill_len=10)
+        hs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        eng.run_until_idle()
+        for ref, h in zip(refs, hs):
+            np.testing.assert_array_equal(ref, np.asarray(h.tokens))
+
+
+class TestCompileDiscipline:
+    def test_exactly_two_programs_and_slot_reuse(self, engine, llama):
+        """Mixed lengths, multiple admission waves, EOS-free slot churn:
+        the jit caches must hold exactly ONE entry per program — no
+        shape ever leaks into a recompile — and every slot returns to
+        the free list."""
+        for wave in range(2):
+            hs = [engine.submit(p, max_new_tokens=5)
+                  for p in _prompts(6, [2, 4, 7, 9], seed=wave)]
+            engine.run_until_idle()
+            assert all(h.done for h in hs)
+        assert engine.compiled_counts() == (1, 1)
+        assert engine.pool.free_count == engine.pool.num_slots
+
+    def test_eos_eviction_frees_slot_without_recompile(self, llama,
+                                                       engine):
+        prompt = _prompts(1, [6])[0]
+        ref = llama.generate(prompt[None], max_new_tokens=8)[0, 6:]
+        eos = int(ref[2])
+        # the greedy stream stops at the FIRST occurrence of eos (which
+        # may be earlier than index 2 if the value repeats), eos kept
+        k = int(np.where(ref == eos)[0][0])
+        h = engine.submit(prompt, max_new_tokens=8, eos_id=eos)
+        engine.run_until_idle()
+        assert h.finish_reason == "eos"
+        assert h.tokens == [int(t) for t in ref[:k + 1]]
+        assert engine.pool.free_count == engine.pool.num_slots
+        assert engine.compiled_counts() == (1, 1)
+
+
+class TestAdmissionControl:
+    def test_queue_full_rejects(self, engine):
+        """The shared engine's queue (max_queue = 2*num_slots = 8) caps
+        un-stepped submissions; the 9th is rejected loudly, and
+        draining re-opens admission."""
+        rej0, adm0 = engine.metrics.rejected, engine.metrics.admitted
+        ps = _prompts(9, [4])
+        for p in ps[:8]:
+            engine.submit(p, max_new_tokens=3)
+        with pytest.raises(QueueFull):
+            engine.submit(ps[8], max_new_tokens=3)
+        assert engine.metrics.rejected - rej0 == 1
+        # draining the queue re-opens admission
+        engine.run_until_idle()
+        h = engine.submit(ps[8], max_new_tokens=3)
+        engine.run_until_idle()
+        assert h.done and h.finish_reason == "length"
+        assert engine.metrics.admitted - adm0 == 9
+
+    def test_oversized_requests_refused_at_the_door(self, engine):
+        with pytest.raises(ValueError, match="prefill_len"):
+            engine.submit(np.zeros(13, np.int32), max_new_tokens=2)
+        with pytest.raises(ValueError, match="max_len"):
+            engine.submit(np.zeros(10, np.int32), max_new_tokens=30)
+
+    def test_deadline_evicts_queued_and_running(self, engine):
+        import time
+        dl0 = engine.metrics.evicted.get("deadline", 0)
+        # running request whose deadline will pass mid-stream
+        h_run = engine.submit(_prompts(1, [4])[0], max_new_tokens=28,
+                              deadline_s=0.2)
+        # queued request already expired before it can be admitted
+        # (expire_queued runs BEFORE admission inside step())
+        h_q = engine.submit(_prompts(1, [5], seed=9)[0], max_new_tokens=4,
+                            deadline_s=-1.0)
+        engine.step()                   # drops h_q, admits h_run
+        assert h_q.done and h_q.finish_reason == "deadline"
+        assert not h_q.tokens
+        engine.step()                   # a couple of live decode ticks
+        engine.step()
+        time.sleep(0.25)                # ... then the deadline passes
+        engine.step()                   # eviction tick
+        assert h_run.done and h_run.finish_reason == "deadline"
+        assert 0 < len(h_run.tokens) < 28, \
+            "deadline must cut the stream short, after first tokens"
+        assert engine.pool.free_count == engine.pool.num_slots
+        assert engine.metrics.evicted.get("deadline", 0) - dl0 == 2
+
+    def test_max_new_tokens_validated(self, engine):
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            engine.submit(np.zeros(4, np.int32), max_new_tokens=0)
+
+
+class TestStreamingAndMetrics:
+    def test_on_token_streams_in_order(self, llama, engine):
+        seen = []
+        prompt = _prompts(1, [7], seed=3)[0]
+        h = engine.submit(prompt, max_new_tokens=6,
+                          on_token=lambda t, hd: seen.append(
+                              (t, len(hd.tokens))))
+        engine.run_until_idle()
+        assert [t for t, _ in seen] == h.tokens
+        assert [n for _, n in seen] == list(range(1, 7))
+
+    def test_obs_sink_carries_serve_events(self, engine, tmp_path):
+        path = str(tmp_path / "serve_events.jsonl")
+        events.configure(path=path)
+        try:
+            hs = [engine.submit(p, max_new_tokens=4)
+                  for p in _prompts(3, [4, 6])]
+            engine.run_until_idle()
+        finally:
+            events.configure()          # disable; close the sink
+        assert all(h.done for h in hs)
+        evs = [json.loads(l) for l in open(path)]
+        names = {(e["kind"], e["name"]) for e in evs}
+        for expected in (("counter", "serve.submitted"),
+                         ("counter", "serve.admitted"),
+                         ("counter", "serve.evicted"),
+                         ("gauge", "serve.queue_depth"),
+                         ("gauge", "serve.active_slots"),
+                         ("span", "serve.step"),
+                         ("span", "serve.prefill"),
+                         ("span", "serve.decode"),
+                         ("hist", "serve.ttft_ms"),
+                         ("hist", "serve.token_ms")):
+            assert expected in names, f"missing {expected} in {names}"
+
+    def test_snapshot_counts(self, engine):
+        from singa_tpu.serve.metrics import ServeMetrics
+        engine.metrics = ServeMetrics()   # fresh totals + histograms
+        hs = [engine.submit(p, max_new_tokens=3) for p in _prompts(2, [4])]
+        engine.run_until_idle()
+        assert all(h.done for h in hs)
+        snap = engine.metrics.snapshot()
+        assert snap["submitted"] == 2
+        assert snap["evicted"] == {"length": 2}
+        assert snap["ttft_ms"]["count"] == 2
+        assert snap["token_ms"]["count"] == 4   # 2 reqs x 2 decode tokens
+
+
+class TestHistogramPrimitive:
+    def test_summary_semantics(self):
+        events.reset_histograms("t.h")
+        for v in (1.0, 2.0, 3.0, 4.0, 100.0):
+            events.histogram("t.h", v)
+        s = events.histogram_summary("t.h")
+        assert s["count"] == 5
+        assert s["sum"] == pytest.approx(110.0)
+        assert s["mean"] == pytest.approx(22.0)
+        assert s["min"] == 1.0 and s["max"] == 100.0
+        assert s["p50"] == 3.0
+        assert s["p99"] == 100.0
+
+    def test_reset_and_missing(self):
+        events.reset_histograms("t.h2")
+        assert events.histogram_summary("t.h2") is None
+        events.histogram("t.h2", 5.0)
+        assert events.histogram_summary("t.h2")["count"] == 1
+        events.reset_histograms("t.h2")
+        assert events.histogram_summary("t.h2") is None
+
+    def test_bounded_ring_keeps_exact_totals(self):
+        from singa_tpu.obs.events import _HIST_CAP
+        events.reset_histograms("t.ring")
+        n = _HIST_CAP + 100
+        for i in range(n):
+            events.histogram("t.ring", float(i))
+        s = events.histogram_summary("t.ring")
+        # count/sum/min/max exact beyond the ring capacity
+        assert s["count"] == n
+        assert s["sum"] == pytest.approx(n * (n - 1) / 2.0)
+        assert s["min"] == 0.0 and s["max"] == float(n - 1)
+
+    def test_sink_emission(self, tmp_path):
+        path = str(tmp_path / "h.jsonl")
+        events.configure(path=path)
+        try:
+            events.histogram("t.sink", 7.5, stage="x")
+        finally:
+            events.configure()
+        ev = json.loads(open(path).read().strip())
+        assert ev["kind"] == "hist" and ev["name"] == "t.sink"
+        assert ev["value"] == 7.5 and ev["stage"] == "x"
+
+
+def test_serve_record_schema_roundtrip(tmp_path):
+    """A serve_throughput store entry validates; a truncated one is
+    named-field rejected (the record_check CI contract)."""
+    from singa_tpu.obs import record as obs_record
+    from singa_tpu.obs import schema
+
+    store = obs_record.RunRecord(str(tmp_path / "records.jsonl"))
+    entry = obs_record.new_entry(
+        "serve_throughput", "cpu", True, "cpu",
+        payload={"tokens_per_s": 1000.0, "speedup_vs_sequential": 2.0,
+                 "ttft_p50_ms": 5.0, "ttft_p99_ms": 9.0, "requests": 12})
+    store.append(entry)
+    assert store.validate() == []
+    bad = dict(entry)
+    bad["payload"] = {"tokens_per_s": 1000.0}
+    with pytest.raises(schema.SchemaError, match="ttft_p50_ms|speedup"):
+        schema.validate_entry(bad)
