@@ -29,13 +29,14 @@ from . import utils
 
 __all__ = ["device", "proto", "tensor", "autograd", "layer", "model", "opt",
            "graph", "obs", "ops", "parallel", "utils", "sonnx", "models",
-           "serve"]
+           "serve", "train"]
 
 
 def __getattr__(name):
     # lazy: sonnx pulls in the onnx proto machinery, models pulls model
-    # zoo, serve pulls the inference engine
-    if name in ("sonnx", "models", "serve"):
+    # zoo, serve pulls the inference engine, train pulls the run
+    # orchestrator
+    if name in ("sonnx", "models", "serve", "train"):
         import importlib
         mod = importlib.import_module("." + name, __name__)
         globals()[name] = mod

@@ -36,18 +36,25 @@ from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["SCHEMA_VERSION", "SchemaError", "require", "validate_entry",
            "validate_stage", "validate_session_doc", "validate_bench_doc",
-           "validate_multichip_doc", "validate_serve_payload", "entry_key"]
+           "validate_multichip_doc", "validate_serve_payload",
+           "validate_train_run_payload", "entry_key"]
 
 #: bump when entry fields change incompatibly; validators dispatch on it
 SCHEMA_VERSION = 1
 
-_KINDS = ("session", "bench", "serve_throughput")
+_KINDS = ("session", "bench", "serve_throughput", "train_run")
 
 #: required numeric payload fields of a serve_throughput entry — the
 #: serving bench's headline quantities (tools/record_check.py lints
 #: committed serving records against these alongside the training ones)
 _SERVE_FIELDS = ("tokens_per_s", "speedup_vs_sequential", "ttft_p50_ms",
                  "ttft_p99_ms", "requests")
+
+#: required numeric payload fields of a train_run entry — what the
+#: training orchestrator (singa_tpu.train.TrainRunner) commits for
+#: every run: how far it got, how long it took, how many checkpoints
+#: it landed, and where it resumed from (-1 = fresh start)
+_TRAIN_RUN_FIELDS = ("steps", "wall_s", "ckpt_count", "resumed_from")
 
 
 class SchemaError(ValueError):
@@ -149,6 +156,19 @@ def validate_entry(entry: Any, ctx: str = "entry") -> None:
                 f"{type(payload).__name__}", field="payload")
         if kind == "serve_throughput":
             validate_serve_payload(payload, f"{ctx}: serve payload")
+        elif kind == "train_run":
+            validate_train_run_payload(payload, f"{ctx}: train_run payload")
+
+
+def _require_numeric_fields(payload: Any, fields: Tuple[str, ...],
+                            ctx: str) -> None:
+    """One definition of "a numeric payload field" for every kind that
+    carries headline quantities (bools are NOT numbers here — a record
+    field accidentally set to True must not lint as a measurement)."""
+    for f in fields:
+        v = require(payload, f, ctx)
+        _expect(isinstance(v, (int, float)) and not isinstance(v, bool),
+                f"{ctx}: {f!r} must be numeric, got {v!r}", field=f)
 
 
 def validate_serve_payload(payload: Any, ctx: str = "serve payload") -> None:
@@ -156,10 +176,15 @@ def validate_serve_payload(payload: Any, ctx: str = "serve payload") -> None:
     ``_SERVE_FIELDS`` present and numeric (a serving record with a
     missing TTFT percentile is the r5 silent-truncation failure mode
     wearing a new hat)."""
-    for f in _SERVE_FIELDS:
-        v = require(payload, f, ctx)
-        _expect(isinstance(v, (int, float)) and not isinstance(v, bool),
-                f"{ctx}: {f!r} must be numeric, got {v!r}", field=f)
+    _require_numeric_fields(payload, _SERVE_FIELDS, ctx)
+
+
+def validate_train_run_payload(payload: Any,
+                               ctx: str = "train_run payload") -> None:
+    """The orchestrator's run outcome: every field in
+    ``_TRAIN_RUN_FIELDS`` present and numeric, so a run that aborted
+    mid-write can never masquerade as a complete record."""
+    _require_numeric_fields(payload, _TRAIN_RUN_FIELDS, ctx)
 
 
 def validate_session_doc(doc: Any, ctx: str = "session record") -> None:

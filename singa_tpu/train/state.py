@@ -1,0 +1,109 @@
+"""RunState — the schema-versioned resume bundle of a training run.
+
+A checkpoint that holds only params+moments can restore the *model*,
+but not the *run*: the data-iterator position, the RNG trajectory, and
+the step/epoch counters are what make a resumed run reproduce the
+uninterrupted one bit-for-bit.  RunState packages exactly that state as a
+plain JSON-able dict carried in the checkpoint aux (under
+:data:`AUX_RUN_STATE`), versioned so a future layout change fails
+loudly instead of resuming from a misread bundle.
+
+Conventions:
+
+* ``step`` counts **completed** steps — a RunState with ``step=k``
+  resumes execution at step index ``k`` (0-based).
+* ``rng_key`` is the model's base PRNG key as a list of uint32 words;
+  restoring it makes per-step ``fold_in`` keys (dropout etc.) replay
+  the uninterrupted sequence.
+* ``data_state`` is whatever the loader's ``state_dict()`` returned
+  (see :meth:`singa_tpu.utils.data.DataLoader.state_dict`); it is
+  applied back verbatim via ``load_state_dict``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..obs import schema
+
+__all__ = ["RunState", "AUX_RUN_STATE", "RUN_STATE_VERSION"]
+
+#: aux key the orchestrator stores the bundle under
+AUX_RUN_STATE = "run_state"
+
+#: bump when the bundle layout changes incompatibly
+RUN_STATE_VERSION = 1
+
+
+@dataclasses.dataclass
+class RunState:
+    step: int                               # steps completed so far
+    epoch: int                              # data epochs completed
+    data_state: Optional[Dict[str, Any]]    # DataLoader.state_dict()
+    rng_key: Optional[List[int]]            # model._base_key words
+    model_step_count: int                   # Model._step_count
+    run_id: str
+    version: int = RUN_STATE_VERSION
+
+    # -- capture / restore -------------------------------------------------
+    @classmethod
+    def capture(cls, model, loader, step: int, run_id: str,
+                data_state: Optional[Dict[str, Any]] = None) -> "RunState":
+        """Snapshot the run-level state after ``step`` completed steps.
+
+        ``data_state`` overrides the loader's live cursor (the
+        emergency-checkpoint path passes the pre-draw cursor of a step
+        that never completed)."""
+        if data_state is None and loader is not None \
+                and hasattr(loader, "state_dict"):
+            data_state = dict(loader.state_dict())
+        rng = None
+        key = getattr(model, "_base_key", None)
+        if key is not None:
+            rng = [int(w) for w in np.asarray(key).ravel().tolist()]
+        # .get: the loader contract is duck-typed (any state_dict()
+        # counts), and capture runs inside the emergency-checkpoint
+        # path where a KeyError would lose the save
+        epoch = int(data_state.get("epoch", 0)) if data_state else 0
+        return cls(step=int(step), epoch=epoch, data_state=data_state,
+                   rng_key=rng,
+                   model_step_count=int(getattr(model, "_step_count", 0)),
+                   run_id=str(run_id))
+
+    def apply(self, model, loader=None) -> None:
+        """Restore the captured trajectory onto a fresh model/loader
+        (params and optimizer moments are the checkpoint file's job —
+        this handles everything around them)."""
+        if self.rng_key is not None and hasattr(model, "_base_key"):
+            import jax.numpy as jnp
+            model._base_key = jnp.asarray(
+                np.array(self.rng_key, dtype=np.uint32))
+        if hasattr(model, "_step_count"):
+            model._step_count = int(self.model_step_count)
+        if (loader is not None and self.data_state is not None
+                and hasattr(loader, "load_state_dict")):
+            loader.load_state_dict(self.data_state)
+
+    # -- (de)serialization -------------------------------------------------
+    def to_aux(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_aux(cls, aux: Any, ctx: str = "run_state") -> "RunState":
+        ver = schema.require(aux, "version", ctx)
+        if ver != RUN_STATE_VERSION:
+            raise schema.SchemaError(
+                f"{ctx}: version {ver!r} is not the supported "
+                f"{RUN_STATE_VERSION} — refusing to resume from a bundle "
+                f"this code cannot interpret", field="version")
+        return cls(step=int(schema.require(aux, "step", ctx)),
+                   epoch=int(schema.require(aux, "epoch", ctx)),
+                   data_state=aux.get("data_state"),
+                   rng_key=aux.get("rng_key"),
+                   model_step_count=int(
+                       schema.require(aux, "model_step_count", ctx)),
+                   run_id=str(schema.require(aux, "run_id", ctx)),
+                   version=int(ver))

@@ -17,6 +17,8 @@ restore (shared-filesystem convention, as in the reference lineage).
 
 from __future__ import annotations
 
+import contextlib
+import hashlib
 import json
 import os
 import tempfile
@@ -25,9 +27,11 @@ from typing import Dict, List, Optional
 import numpy as np
 
 __all__ = ["save_states", "load_states", "save_arrays", "load_arrays",
-           "CheckpointManager"]
+           "atomic_write", "check_opt_manifest", "CheckpointManager"]
 
 _AUX_KEY = "__aux__"
+_MANIFEST_KEY = "__arrays__"
+_DIGEST_KEY = "__digest__"
 _OPT_PREFIX = "__opt__:"
 
 
@@ -53,28 +57,86 @@ def _to_host(a) -> np.ndarray:
     return np.asarray(a)
 
 
-def save_arrays(arrays: Dict[str, np.ndarray], fpath: str,
-                aux: Optional[Dict] = None) -> None:
-    """Atomic write: temp file in the same dir, then rename."""
+def _manifest_of(arrays: Dict[str, np.ndarray]) -> Dict[str, List]:
+    return {k: [list(np.asarray(v).shape), str(np.asarray(v).dtype)]
+            for k, v in arrays.items()}
+
+
+def _digest(aux_json: str, manifest_json: str) -> str:
+    h = hashlib.sha256()
+    h.update(aux_json.encode())
+    h.update(manifest_json.encode())
+    return h.hexdigest()
+
+
+def atomic_write(fpath: str, write_fn, mode: str = "wb") -> None:
+    """The crash-consistent write protocol, shared by every durable
+    file this package lands (npz payloads here, commit markers in
+    ``train.ckpt``): temp file in the target dir, ``write_fn(f)``,
+    fsync, atomic rename.  The temp file never outlives a failed write
+    (ENOSPC, a serialization error, an interrupt) — and the cleanup
+    itself must not mask the original error."""
     d = os.path.dirname(os.path.abspath(fpath)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
     try:
-        with os.fdopen(fd, "wb") as f:
-            meta = {_AUX_KEY: json.dumps(aux or {})}
-            np.savez(f, __meta__=json.dumps(meta), **arrays)
+        with os.fdopen(fd, mode) as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, fpath)
     except BaseException:
-        if os.path.exists(tmp):
+        with contextlib.suppress(OSError):
             os.unlink(tmp)
         raise
+
+
+def save_arrays(arrays: Dict[str, np.ndarray], fpath: str,
+                aux: Optional[Dict] = None) -> None:
+    """Atomic write: temp file in the same dir, fsync, then rename.
+
+    The embedded metadata carries a manifest of every array member
+    (name/shape/dtype — *including* the ``__opt__:<i>`` optimizer-moment
+    leaves) plus a sha256 digest over aux+manifest, so ``load_arrays``
+    can fail loudly on a params/opt mismatch or tampered aux instead of
+    handing a silently-inconsistent state to the optimizer."""
+    def _write(f):
+        aux_json = json.dumps(aux or {}, sort_keys=True)
+        manifest_json = json.dumps(_manifest_of(arrays), sort_keys=True)
+        meta = {_AUX_KEY: aux_json, _MANIFEST_KEY: manifest_json,
+                _DIGEST_KEY: _digest(aux_json, manifest_json)}
+        np.savez(f, __meta__=json.dumps(meta), **arrays)
+
+    atomic_write(fpath, _write)
 
 
 def load_arrays(fpath: str):
     with np.load(fpath, allow_pickle=False) as z:
         meta = json.loads(str(z["__meta__"]))
         arrays = {k: z[k] for k in z.files if k != "__meta__"}
-    aux = json.loads(meta.get(_AUX_KEY, "{}"))
+    aux_json = meta.get(_AUX_KEY, "{}")
+    aux = json.loads(aux_json)
+    manifest_json = meta.get(_MANIFEST_KEY)
+    if manifest_json is not None:   # pre-manifest files load unchecked
+        stored = meta.get(_DIGEST_KEY)
+        if stored != _digest(aux_json, manifest_json):
+            raise ValueError(
+                f"{fpath}: aux/manifest digest mismatch — metadata was "
+                f"tampered with or the write was torn")
+        manifest = json.loads(manifest_json)
+        missing = sorted(set(manifest) - set(arrays))
+        extra = sorted(set(arrays) - set(manifest))
+        if missing or extra:
+            raise ValueError(
+                f"{fpath}: array members do not match the manifest "
+                f"(missing: {missing}, unexpected: {extra}) — params/"
+                f"optimizer-moment set is inconsistent")
+        for k, (shape, dtype) in manifest.items():
+            a = arrays[k]
+            if list(a.shape) != list(shape) or str(a.dtype) != dtype:
+                raise ValueError(
+                    f"{fpath}: array {k!r} is {a.shape}/{a.dtype} but the "
+                    f"manifest recorded {tuple(shape)}/{dtype}")
     return arrays, aux
 
 
@@ -130,6 +192,23 @@ def save_states(model, fpath: str, aux_states: Optional[Dict] = None) -> None:
     _barrier(f"singa_save_states_{os.path.basename(fpath)}")
 
 
+def check_opt_manifest(arrays: Dict, aux: Dict) -> None:
+    """One definition of "the optimizer moments agree with their slot
+    manifest", enforced both at load (:func:`_apply`) and by the
+    offline auditor (``tools/ckpt_fsck.py``).  Raises ValueError on a
+    params/opt-state mismatch; a pre-manifest aux passes unchecked."""
+    manifest = aux.get("opt_slots")
+    if manifest is None:
+        return
+    expected = sum(int(n) for _, n in manifest)
+    got = sum(1 for k in arrays if k.startswith(_OPT_PREFIX))
+    if expected != got:
+        raise ValueError(
+            f"checkpoint carries {got} optimizer moment arrays but its "
+            f"slot manifest lists {expected} — params/opt-state "
+            f"mismatch, refusing to load")
+
+
 def _apply(model, arrays: Dict, aux: Dict) -> None:
     opt = getattr(model, "optimizer", None)
     manifest = aux.get("opt_slots")
@@ -145,6 +224,11 @@ def _apply(model, arrays: Dict, aux: Dict) -> None:
             f"optimizer is {opt.state_signature()!r} — refusing to "
             f"reinterpret moments across optimizers")
     opt_arrays = {k: v for k, v in arrays.items() if k.startswith(_OPT_PREFIX)}
+    # checked BEFORE any mutation: a checkpoint whose moment arrays
+    # don't match its own slot manifest is torn/mixed — loading the
+    # params while zeroing the moments would silently change the
+    # training dynamics
+    check_opt_manifest(arrays, aux)
     model.set_states({k: v for k, v in arrays.items()
                       if not k.startswith(_OPT_PREFIX)})
     if opt is None:

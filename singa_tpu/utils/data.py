@@ -19,8 +19,17 @@ __all__ = ["DataLoader", "prefetch_to_device", "synthetic_dataset"]
 class DataLoader:
     """Iterate (x, y) minibatches from in-memory arrays.
 
-    One iteration = one epoch. Reshuffles every epoch (native path uses
-    seed+epoch so runs are reproducible)."""
+    One iteration = one epoch. Reshuffles every epoch (seed+epoch on
+    both paths, so runs are reproducible).
+
+    Resume: the loader tracks a (epoch, batch) cursor across
+    iterations; :meth:`state_dict` / :meth:`load_state_dict` let a
+    checkpointing orchestrator (``singa_tpu.train``) capture the exact
+    data position and continue the shuffle trajectory mid-epoch after a
+    crash — a restored iteration replays the SAME permutation (seed +
+    epoch) and starts at the saved batch index.  Note that abandoning
+    an epoch mid-iteration leaves the cursor mid-epoch on purpose: the
+    next ``__iter__`` resumes, it does not reshuffle."""
 
     def __init__(self, x: np.ndarray, y: Optional[np.ndarray] = None,
                  batch_size: int = 32, shuffle: bool = True, seed: int = 0,
@@ -71,7 +80,9 @@ class DataLoader:
         self.shuffle = shuffle
         self.seed = seed
         self.drop_last = drop_last
-        self._epoch = 0
+        self._epoch = 0       # epochs fully consumed
+        self._batch_idx = 0   # batches consumed within the current epoch
+        self._len_warned = False
         if use_native is None:
             use_native = _core.available()
         self._native: Optional[_core.NativeLoader] = None
@@ -87,25 +98,72 @@ class DataLoader:
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray]]]:
         if self._native is not None:
-            for _ in range(len(self)):
+            for _ in range(len(self) - self._batch_idx):
                 try:
-                    yield self._native.next()
+                    b = self._native.next()
                 except StopIteration:
                     # under-delivery (e.g. concurrent close) ends the epoch
                     # cleanly instead of PEP-479 RuntimeError
                     return
+                self._batch_idx += 1
+                yield b
+            self._epoch += 1
+            self._batch_idx = 0
             return
-        n = len(self.x)
-        idx = np.arange(n)
+        idx = np.arange(len(self.x))
         if self.shuffle:
+            # seed+epoch: the permutation is a pure function of the
+            # cursor, so a resumed loader replays the same epoch order
             np.random.RandomState(self.seed + self._epoch).shuffle(idx)
-        self._epoch += 1
-        for s in range(0, len(self) * self.batch_size, self.batch_size):
-            sel = idx[s:s + self.batch_size]
+        for b in range(self._batch_idx, len(self)):
+            sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
             if len(sel) == 0:
                 break
+            self._batch_idx = b + 1
             yield (self.x[sel],
                    self.y[sel] if self.y is not None else None)
+        self._epoch += 1
+        self._batch_idx = 0
+
+    # -- resume (singa_tpu.train orchestrator) ---------------------------
+    def state_dict(self) -> dict:
+        """The loader's position: everything needed to reproduce the
+        remaining data trajectory after a crash."""
+        return {"epoch": int(self._epoch), "batch_idx": int(self._batch_idx),
+                "seed": int(self.seed), "num_samples": int(len(self.x))}
+
+    def load_state_dict(self, state: dict) -> None:
+        """Restore a position captured by :meth:`state_dict`.
+
+        Warns once if the underlying dataset length changed between
+        save and load (the shuffle trajectory then runs over different
+        data — resumption is best-effort, not bit-reproducible).  The
+        native loader cannot seek, so restoring a nonzero position
+        falls back to the python pipeline; its numpy permutation
+        differs from the native loader's (std::mt19937_64) order, so a
+        native→python resume is also best-effort, not bit-identical —
+        bitwise resume requires staying on one pipeline
+        (``use_native=False``)."""
+        import warnings
+        n = state.get("num_samples")
+        if n is not None and int(n) != len(self.x) and not self._len_warned:
+            self._len_warned = True
+            warnings.warn(
+                f"DataLoader dataset length changed between save "
+                f"({int(n)} samples) and load ({len(self.x)}): the "
+                f"resumed shuffle trajectory covers different data",
+                stacklevel=2)
+        self.seed = int(state.get("seed", self.seed))
+        self._epoch = int(state.get("epoch", 0))
+        self._batch_idx = int(state.get("batch_idx", 0))
+        if self._native is not None and (self._epoch or self._batch_idx):
+            warnings.warn(
+                "DataLoader: native loader cannot seek to a saved "
+                "position; resuming on the python pipeline (its shuffle "
+                "order differs from the native one — resume is "
+                "best-effort, not bit-identical)", stacklevel=2)
+            self._native.close()
+            self._native = None
 
     def close(self):
         if self._native is not None:

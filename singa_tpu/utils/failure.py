@@ -72,6 +72,9 @@ class Heartbeat:
         self._stop.clear()   # restartable after stop()
         self._fired = False
         self._last = time.monotonic()
+        # ALWAYS a daemon: the monitor exists to watch for wedged
+        # threads, so it must never itself keep a dying interpreter
+        # alive waiting on a join
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="singa-heartbeat")
         self._thread.start()
@@ -82,10 +85,15 @@ class Heartbeat:
         self._last_step = step
 
     def stop(self) -> None:
+        """Idempotent shutdown: safe before start(), safe to call
+        repeatedly, and safe from the monitor thread itself (an
+        on_failure callback tearing the watchdog down must not
+        self-join) — so TrainRunner.__exit__ can always call it without
+        hanging interpreter shutdown."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2 * self.check_every)
-            self._thread = None
+        t, self._thread = self._thread, None
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2 * self.check_every)
 
     @property
     def fired(self) -> bool:

@@ -14,14 +14,16 @@ consumer crashed with a raw KeyError.  This lint validates, at CI time
   * ``runs/records.jsonl``     — the RunRecord store (every line
                                  strictly valid, no duplicate keys).
                                  Covers every store kind: ``session``,
-                                 ``bench`` AND the serving engine's
-                                 ``serve_throughput`` entries, whose
-                                 payload must carry the full numeric
-                                 headline (tokens_per_s,
+                                 ``bench``, the serving engine's
+                                 ``serve_throughput`` entries (full
+                                 numeric headline: tokens_per_s,
                                  speedup_vs_sequential, ttft_p50_ms,
-                                 ttft_p99_ms, requests) — serving
-                                 records are CI-validated alongside the
-                                 training ones.
+                                 ttft_p99_ms, requests) AND the
+                                 training orchestrator's ``train_run``
+                                 entries (numeric steps, wall_s,
+                                 ckpt_count, resumed_from) — a run that
+                                 aborted mid-write can never masquerade
+                                 as a complete record.
 
 Exit code 0 = all records valid; 1 = named errors printed, one per
 line, each naming the file and the missing/invalid field.
