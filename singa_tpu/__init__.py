@@ -23,13 +23,14 @@ from . import model
 from . import opt
 from . import graph
 from . import obs
+from . import faults  # eager: SINGA_FAULTS env activation happens here
 from . import ops
 from . import parallel
 from . import utils
 
 __all__ = ["device", "proto", "tensor", "autograd", "layer", "model", "opt",
-           "graph", "obs", "ops", "parallel", "utils", "sonnx", "models",
-           "serve", "train"]
+           "graph", "obs", "faults", "ops", "parallel", "utils", "sonnx",
+           "models", "serve", "train"]
 
 
 def __getattr__(name):

@@ -1,0 +1,183 @@
+"""singa_tpu.faults — deterministic fault injection (chaos testing).
+
+Every failure path this repo claims to survive — train-step retry,
+torn-checkpoint fallback, serve-engine quarantine and arena recovery,
+hang detection — is exercisable through NAMED injection sites wired at
+the real failure seams (:mod:`.sites`), driven by a seeded
+:class:`~singa_tpu.faults.plan.FaultPlan` (:mod:`.plan`).  The chaos
+tests in ``tests/test_faults.py`` replace the ad-hoc monkeypatching
+that previously stood in for failures.
+
+Usage::
+
+    from singa_tpu import faults
+    plan = faults.FaultPlan([
+        faults.FaultSpec("serve.decode", "error", every=3, times=2),
+        faults.FaultSpec("serve.prefill", "hang", at=2, delay_s=1.0),
+    ], seed=42)
+    with faults.active(plan):
+        engine.run_until_idle()
+    assert plan.fire_count() == 3
+
+or from the environment (no code changes)::
+
+    SINGA_FAULTS="train.step=error:every=50" python train.py
+
+Design contract (asserted in tests):
+
+* **zero overhead when off** — :func:`fire`/:func:`corrupt` are a
+  single module-global ``None`` check when no plan is active; sites
+  live OUTSIDE jit, so activating a plan never changes compiled-program
+  cache keys, and with no plan active no obs event is ever emitted.
+* **deterministic** — trigger decisions are pure functions of
+  ``(seed, site, spec index, call index)``; a chaos run replays
+  bit-identically.
+* **observable** — every fired fault emits a ``fault.injected``
+  counter through :mod:`singa_tpu.obs.events` (site, kind, call).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Any, Optional
+
+from .plan import KINDS, FaultPlan, FaultSpec, InjectedFault
+from .sites import SITES
+
+__all__ = ["KINDS", "SITES", "FaultPlan", "FaultSpec", "InjectedFault",
+           "fire", "corrupt", "active", "install", "uninstall",
+           "get_active"]
+
+_active: Optional[FaultPlan] = None
+
+
+def get_active() -> Optional[FaultPlan]:
+    return _active
+
+
+def install(plan: Optional[FaultPlan]) -> None:
+    """Make ``plan`` the process-wide active plan (None deactivates).
+    Prefer the :func:`active` context manager in tests."""
+    global _active
+    _active = plan
+
+
+def uninstall() -> None:
+    install(None)
+
+
+@contextlib.contextmanager
+def active(plan: FaultPlan):
+    """Activate ``plan`` for the dynamic extent of the block."""
+    global _active
+    if _active is not None:
+        raise RuntimeError("a FaultPlan is already active — nested "
+                           "activation would make firing ambiguous")
+    _active = plan
+    try:
+        yield plan
+    finally:
+        _active = None
+
+
+def _emit(site: str, kind: str, call: int) -> None:
+    from ..obs import events
+    # attr is fault_kind, not kind: event attrs merge into the sink
+    # line, and a bare "kind" would clobber the event's own kind field
+    events.counter("fault.injected", 1, site=site, fault_kind=kind,
+                   call=call)
+
+
+def fire(site: str, **ctx: Any) -> None:
+    """The injection hook: a no-op unless an active plan says this call
+    of ``site`` faults.  Kind ``error`` raises :class:`InjectedFault`,
+    ``hang`` sleeps the spec's ``delay_s`` (so a Heartbeat watching the
+    caller fires), ``torn_write`` truncates the file at ``ctx['path']``.
+    When several specs fire on the same call, hangs and truncations are
+    applied first and an error is raised last."""
+    plan = _active
+    if plan is None:
+        return
+    hits = plan.match(site, ("error", "hang", "torn_write"))
+    if not hits:
+        return
+    err: Optional[FaultSpec] = None
+    for _, spec in hits:
+        _emit(site, spec.kind, plan.calls.get(site, 0))
+        if spec.kind == "hang":
+            time.sleep(spec.delay_s)
+        elif spec.kind == "torn_write":
+            _truncate(ctx.get("path"))
+        else:
+            err = spec
+    if err is not None:
+        raise InjectedFault(
+            f"injected transient fault at {site} "
+            f"(call {plan.calls.get(site, 0)}, ctx {ctx or '{}'})")
+
+
+def corrupt(site: str, value: Any) -> Any:
+    """NaN-corruption hook: returns ``value`` unchanged unless a ``nan``
+    spec fires, in which case every float array in it is replaced with
+    NaNs.  Does not advance the site's call counter — by convention a
+    ``nan``-capable site calls :func:`fire` first (pre-dispatch) and
+    ``corrupt`` on the same logical call's output."""
+    plan = _active
+    if plan is None:
+        return value
+    hits = plan.match(site, ("nan",), count=False)
+    if not hits:
+        return value
+    for _, spec in hits:
+        _emit(site, spec.kind, plan.calls.get(site, 0))
+    return _nanify(value)
+
+
+def _truncate(path: Optional[str]) -> None:
+    """Tear a file the way an interrupted write would: keep the first
+    half, drop the rest.  (A site offering torn_write passes ``path``.)"""
+    if not path or not os.path.exists(path):
+        return
+    size = os.path.getsize(path)
+    if size < 2:
+        return
+    with open(path, "r+b") as f:
+        f.truncate(size // 2)
+
+
+def _nanify(value: Any) -> Any:
+    import numpy as np
+
+    def one(x):
+        dt = getattr(x, "dtype", None)
+        if dt is None or not np.issubdtype(np.dtype(dt), np.floating):
+            return x
+        if isinstance(x, np.ndarray):
+            return np.full_like(x, np.nan)
+        try:
+            import jax.numpy as jnp
+            return jnp.full_like(x, jnp.nan)
+        except Exception:
+            return x
+
+    try:
+        import jax
+        return jax.tree.map(one, value)
+    except Exception:
+        return one(value)
+
+
+def _init_from_env() -> None:
+    text = os.environ.get("SINGA_FAULTS")
+    if not text:
+        return
+    seed = int(os.environ.get("SINGA_FAULTS_SEED", "0") or 0)
+    # a malformed plan must fail LOUDLY: the whole point of env
+    # activation is a chaos run — silently injecting nothing would
+    # report "survived" without ever being tested
+    install(FaultPlan.parse(text, seed=seed))
+
+
+_init_from_env()

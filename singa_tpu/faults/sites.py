@@ -1,0 +1,78 @@
+"""Injection-site registry: the named seams where faults can be fired.
+
+A *site* is a host-side hook (`faults.fire(name, ...)` or
+`faults.corrupt(name, value)`) placed at a failure seam the robustness
+machinery claims to survive.  The registry is the contract between the
+chaos tests and the code under test: a :class:`~singa_tpu.faults.plan.
+FaultPlan` naming an unregistered site fails at construction (catching
+typos before a chaos run silently injects nothing), and
+``docs/robustness.md`` renders this table as the user-facing list.
+
+Every site fires host-side Python — a fired fault never becomes part
+of a compiled program, so activating a plan cannot change
+compiled-program cache keys (asserted in tests/test_faults.py via the
+serve engine's jit cache sizes).  All sites except ``comm.collective``
+also fire outside tracing, once per runtime call; ``comm.collective``
+necessarily fires at TRACE time (see its entry below for what that
+means for ``at=``/``every=`` triggers).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+__all__ = ["SITES", "supported_kinds", "is_known"]
+
+#: site name -> (description, kinds the site supports).
+#: ``error``/``hang`` are raised/slept by :func:`faults.fire` before the
+#: guarded operation dispatches; ``torn_write`` truncates the file named
+#: by the site's ``path`` context; ``nan`` is applied by
+#: :func:`faults.corrupt` to the value flowing PAST the site.
+SITES: Dict[str, Tuple[str, Tuple[str, ...]]] = {
+    "device.execute": (
+        "compiled step-graph dispatch (model step executor); nan "
+        "corrupts the step outputs (loss) after a clean dispatch",
+        ("error", "hang", "nan")),
+    "comm.collective": (
+        "collective staging in parallel.communicator (allreduce / "
+        "allgather / reduce_scatter / ppermute / broadcast); fires "
+        "host-side at staging, so an injected error surfaces at trace "
+        "time like a failed collective launch.  Collectives are "
+        "in-graph ops: the site counts graph (re)traces, NOT "
+        "executions — at=/every= triggers count traces, and a plan "
+        "activated after warmup injects nothing until something "
+        "retraces",
+        ("error", "hang")),
+    "ckpt.write": (
+        "checkpoint serialization in train.ckpt (before the npz is "
+        "written); an injected error surfaces through "
+        "AsyncCheckpointManager.wait() exactly like ENOSPC",
+        ("error", "hang")),
+    "ckpt.torn": (
+        "after the commit marker lands (ctx: path) — torn_write "
+        "truncates the committed npz, simulating a crash/bit-rot torn "
+        "file that the sha-checked restore path must skip",
+        ("torn_write",)),
+    "serve.prefill": (
+        "serve engine prefill-into-slot dispatch (per admission)",
+        ("error", "hang")),
+    "serve.decode": (
+        "serve engine decode-over-slots dispatch (per tick)",
+        ("error", "hang")),
+    "train.step": (
+        "TrainRunner's retried step region (the shared injector the "
+        "train retry/backoff path is exercised through)",
+        ("error", "hang")),
+    "data.next": (
+        "DataLoader batch draw; nan corrupts the float parts of the "
+        "yielded batch",
+        ("error", "hang", "nan")),
+}
+
+
+def is_known(site: str) -> bool:
+    return site in SITES
+
+
+def supported_kinds(site: str) -> Tuple[str, ...]:
+    return SITES[site][1] if site in SITES else ()

@@ -633,10 +633,18 @@ class _StepExecutor:
             self.captured = CapturedGraph(f"{m.name}.{self.tag}",
                                           lowered=lowered, compiled=compiled,
                                           jaxpr_thunk=jaxpr_thunk)
+        from . import faults
+        # "device.execute" injection site: error/hang fire HOST-side
+        # before the dispatch (so donated buffers are still intact and
+        # the caller's retry can re-dispatch this same step); nan
+        # corrupts the step outputs after a clean dispatch
+        faults.fire("device.execute", graph=f"{m.name}.{self.tag}",
+                    step=step_host)
         with obs_events.span("graph.execute",
                              graph=f"{m.name}.{self.tag}", step=step_host):
             outs, new_params, new_buffers, new_slots = self._jitted(
                 params, buffers, self.slots, step, rng, *batch_arrays)
+        outs = faults.corrupt("device.execute", outs)
         # rebind updated state into the live tensors
         for n, t in self.param_tensors.items():
             t.data = new_params[n]

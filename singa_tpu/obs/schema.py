@@ -37,12 +37,13 @@ from typing import Any, Dict, List, Optional, Tuple
 __all__ = ["SCHEMA_VERSION", "SchemaError", "require", "validate_entry",
            "validate_stage", "validate_session_doc", "validate_bench_doc",
            "validate_multichip_doc", "validate_serve_payload",
-           "validate_train_run_payload", "entry_key"]
+           "validate_train_run_payload", "validate_incident_payload",
+           "entry_key"]
 
 #: bump when entry fields change incompatibly; validators dispatch on it
 SCHEMA_VERSION = 1
 
-_KINDS = ("session", "bench", "serve_throughput", "train_run")
+_KINDS = ("session", "bench", "serve_throughput", "train_run", "incident")
 
 #: required numeric payload fields of a serve_throughput entry — the
 #: serving bench's headline quantities (tools/record_check.py lints
@@ -55,6 +56,13 @@ _SERVE_FIELDS = ("tokens_per_s", "speedup_vs_sequential", "ttft_p50_ms",
 #: every run: how far it got, how long it took, how many checkpoints
 #: it landed, and where it resumed from (-1 = fresh start)
 _TRAIN_RUN_FIELDS = ("steps", "wall_s", "ckpt_count", "resumed_from")
+
+#: required string payload fields of an incident entry — one fired
+#: fault or recovery action (singa_tpu.faults / ServeEngine resilience):
+#: which seam (site), what happened there (fault), what the system did
+#: about it (outcome); ``ref`` (step or request id) and numeric
+#: ``retries`` are validated separately in validate_incident_payload
+_INCIDENT_STR_FIELDS = ("site", "fault", "outcome")
 
 
 class SchemaError(ValueError):
@@ -158,6 +166,8 @@ def validate_entry(entry: Any, ctx: str = "entry") -> None:
             validate_serve_payload(payload, f"{ctx}: serve payload")
         elif kind == "train_run":
             validate_train_run_payload(payload, f"{ctx}: train_run payload")
+        elif kind == "incident":
+            validate_incident_payload(payload, f"{ctx}: incident payload")
 
 
 def _require_numeric_fields(payload: Any, fields: Tuple[str, ...],
@@ -185,6 +195,27 @@ def validate_train_run_payload(payload: Any,
     ``_TRAIN_RUN_FIELDS`` present and numeric, so a run that aborted
     mid-write can never masquerade as a complete record."""
     _require_numeric_fields(payload, _TRAIN_RUN_FIELDS, ctx)
+
+
+def validate_incident_payload(payload: Any,
+                              ctx: str = "incident payload") -> None:
+    """One fired fault / recovery action in the durable store: ``site``
+    (injection-site or subsystem seam), ``fault`` (what fired), and
+    ``outcome`` (``retried`` / ``quarantined`` / ``recovered`` /
+    ``unrecoverable`` / ...) as non-empty strings; ``ref`` — the step or
+    request id the incident is about (string or number); ``retries`` —
+    how many attempts were burned, numeric, so postmortems can
+    aggregate retry pressure without re-parsing prose."""
+    for f in _INCIDENT_STR_FIELDS:
+        v = require(payload, f, ctx)
+        _expect(isinstance(v, str) and v,
+                f"{ctx}: {f!r} must be a non-empty string, got {v!r}",
+                field=f)
+    ref = require(payload, "ref", ctx)
+    _expect(isinstance(ref, (str, int, float)) and not isinstance(ref, bool),
+            f"{ctx}: 'ref' must be a step/request id (string or number), "
+            f"got {ref!r}", field="ref")
+    _require_numeric_fields(payload, ("retries",), ctx)
 
 
 def validate_session_doc(doc: Any, ctx: str = "session record") -> None:

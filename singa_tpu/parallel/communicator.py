@@ -34,6 +34,15 @@ def axis_bound(axis: str) -> bool:
         return False
 
 
+def _staged(collective: str, x, axis: str, **attrs) -> None:
+    """Per-collective staging hook: the ``comm.collective`` injection
+    site (host-side, so an injected error surfaces at trace time like a
+    failed collective launch) plus the payload counter."""
+    from .. import faults
+    faults.fire("comm.collective", collective=collective, axis=axis)
+    _payload_counter(collective, x, axis, **attrs)
+
+
 def _payload_counter(collective: str, x, axis: str, **attrs) -> None:
     """Emit a ``comm.<collective>.bytes`` counter for a staged
     collective.
@@ -65,7 +74,7 @@ def axis_size(axis: str) -> int:
 def allreduce(x, axis: str = "data", op: str = "mean"):
     if not axis_bound(axis):
         return x
-    _payload_counter("allreduce", x, axis, op=op)
+    _staged("allreduce", x, axis, op=op)
     if op == "mean":
         return jax.lax.pmean(x, axis)
     if op == "sum":
@@ -80,14 +89,14 @@ def allreduce(x, axis: str = "data", op: str = "mean"):
 def allgather(x, axis: str = "data", tiled: bool = False):
     if not axis_bound(axis):
         return x
-    _payload_counter("allgather", x, axis)
+    _staged("allgather", x, axis)
     return jax.lax.all_gather(x, axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis: str = "data", scatter_dimension: int = 0):
     if not axis_bound(axis):
         return x
-    _payload_counter("reduce_scatter", x, axis)
+    _staged("reduce_scatter", x, axis)
     return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_dimension,
                                 tiled=True)
 
@@ -95,7 +104,7 @@ def reduce_scatter(x, axis: str = "data", scatter_dimension: int = 0):
 def ppermute(x, axis: str, perm):
     if not axis_bound(axis):
         return x
-    _payload_counter("ppermute", x, axis)
+    _staged("ppermute", x, axis)
     return jax.lax.ppermute(x, axis, perm)
 
 
@@ -113,7 +122,7 @@ def broadcast(x, axis: str = "data", src: int = 0):
     W = jax.lax.axis_size(axis)
     if W == 1:
         return x
-    _payload_counter("broadcast", x, axis)
+    _staged("broadcast", x, axis)
     d = (jax.lax.axis_index(axis) - src) % W  # offset from src, traced
     val = x
     step = 1
@@ -146,12 +155,12 @@ def allreduce_grads(grads: Dict[str, jnp.ndarray], axis: str = "data",
     exchange (reference: sparsified allreduce)."""
     if not axis_bound(axis):
         return grads
-    _payload_counter("allreduce_grads",
-                     [g for g in grads.values() if g is not None], axis,
-                     tensors=len(grads),
-                     compress=None if compress_dtype is None
-                     else str(compress_dtype),
-                     topk_ratio=topk_ratio or 0.0)
+    _staged("allreduce_grads",
+            [g for g in grads.values() if g is not None], axis,
+            tensors=len(grads),
+            compress=None if compress_dtype is None
+            else str(compress_dtype),
+            topk_ratio=topk_ratio or 0.0)
     out = {}
     for name, g in grads.items():
         if g is None:
@@ -209,7 +218,7 @@ def quantized_allreduce(x, axis: str = "data", block: int = 256,
         raise ValueError(f"wire must be 'int32' or 'int8', got {wire!r}")
     if not axis_bound(axis):
         return x
-    _payload_counter("quantized_allreduce", x, axis, wire=wire)
+    _staged("quantized_allreduce", x, axis, wire=wire)
     if wire == "int8":
         return _ring_int8_allreduce(x, axis, block)
     orig_shape, orig_dtype = x.shape, x.dtype

@@ -11,8 +11,13 @@ whole serving lifetime runs through exactly two compiled XLA programs.
   (:class:`QueueFull` backpressure), per-request deadlines and token
   budgets, eviction policy.
 * :mod:`~singa_tpu.serve.engine` — :class:`ServeEngine`:
-  ``submit() / step() / run_until_idle()``, streaming token callbacks,
-  greedy decode token-identical to ``GenerateMixin.generate``.
+  ``submit() / step() / run_until_idle() / drain() / close()``,
+  streaming token callbacks, greedy decode token-identical to
+  ``GenerateMixin.generate``; resilience (ISSUE 4): bounded-backoff
+  retry of transient dispatch failures, quarantine of requests that
+  repeatedly poison prefill (a ``failed`` handle status, not an engine
+  crash), deadline-aware overload shedding, and a Heartbeat-driven
+  arena-recovery path (see docs/robustness.md).
 * :mod:`~singa_tpu.serve.metrics` — queue/slot gauges, admit/reject/
   evict counters, TTFT and per-token latency histograms through
   ``obs.events``.
@@ -21,9 +26,11 @@ See docs/serving.md for the architecture, the slot lifecycle and the
 backpressure semantics.
 """
 
-from .engine import ServeEngine
-from .scheduler import QueueFull, RequestHandle, Scheduler
+from .engine import EngineClosed, ServeEngine
+from .scheduler import (EVICTED, FAILED, FINISHED, QUEUED, RUNNING,
+                        QueueFull, RequestHandle, Scheduler)
 from .slots import SlotPool
 
 __all__ = ["ServeEngine", "SlotPool", "Scheduler", "RequestHandle",
-           "QueueFull"]
+           "QueueFull", "EngineClosed",
+           "QUEUED", "RUNNING", "FINISHED", "EVICTED", "FAILED"]

@@ -28,30 +28,70 @@ Greedy decode through the engine is token-identical to
 ``GenerateMixin.generate`` (same prefill/decode closures, same argmax),
 which anchors the whole subsystem's correctness to existing behavior.
 
-The engine loop is guarded by ``utils.failure.Heartbeat`` when
-``heartbeat_timeout_s`` is set: a hung device dispatch surfaces as a
-clean abort instead of wedging the server.
+Resilience (ISSUE 4) — the engine survives its failure modes the way
+``train.loop.TrainRunner`` survives training's, and every path below is
+exercised by deterministic chaos tests (``singa_tpu.faults``,
+tests/test_faults.py) rather than ad-hoc monkeypatching:
+
+* **retry** — transient dispatch failures (RuntimeError/OSError before
+  the program launches) are retried with bounded exponential backoff;
+  the ``serve.prefill``/``serve.decode`` injection sites fire *before*
+  the jitted call, so an injected fault leaves the donated arena intact
+  and the retry re-dispatches the same tick.
+* **quarantine** — a request whose prefill keeps failing is marked
+  ``failed`` on its handle (with the error message) instead of crashing
+  the engine; everyone else keeps decoding.
+* **shedding** — deadline-aware overload control: queued requests whose
+  deadline will expire before they could plausibly reach a slot are
+  shed at the step boundary (reason ``shed``) instead of wasting a
+  prefill.
+* **recovery** — when decode dies past retries, or a Heartbeat detects
+  a hang (``recover_on_hang=True``), the arena is rebuilt and every
+  in-flight request is re-prefilled from prompt + tokens-so-far.
+  Greedy decode makes the replay idempotent: recovered streams are
+  bit-identical to an uninterrupted run.
+* **drain/close** — ``drain()`` refuses new submissions while
+  completing everything in the system; ``close()`` drains and releases
+  the arena.
+
+With ``heartbeat_timeout_s`` set and ``recover_on_hang`` unset, a hung
+dispatch still surfaces as a clean abort instead of wedging the server.
+Quarantines and recoveries land as durable ``incident`` records
+(``record_store``), linted by ``tools/record_check.py``.
 """
 
 from __future__ import annotations
 
+import itertools
+import threading
 import time
+import warnings
 from contextlib import nullcontext
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import faults
 from ..models._generate import _bound, decode_step, prefill_step
 from ..obs import events
+from ..obs import record as obs_record
+from ..utils import failure
 from ..utils.failure import Heartbeat
 from .metrics import ServeMetrics
-from .scheduler import (EVICTED, FINISHED, RUNNING, QueueFull, Request,
-                        RequestHandle, Scheduler)
+from .scheduler import (EVICTED, FAILED, FINISHED, RUNNING, QueueFull,
+                        Request, RequestHandle, Scheduler)
 from .slots import SlotPool
 
-__all__ = ["ServeEngine", "QueueFull"]
+__all__ = ["ServeEngine", "QueueFull", "EngineClosed"]
+
+#: distinguishes engines built in the same second+pid (run_id suffix)
+_ENGINE_SEQ = itertools.count()
+
+
+class EngineClosed(RuntimeError):
+    """submit()/step() refused: the engine is draining or closed."""
 
 
 class ServeEngine:
@@ -75,7 +115,15 @@ class ServeEngine:
                  max_queue: Optional[int] = None,
                  param_dtype=None,
                  heartbeat_timeout_s: Optional[float] = None,
-                 on_failure=None):
+                 on_failure=None,
+                 max_dispatch_retries: int = 2,
+                 backoff_base: float = 0.05,
+                 backoff_max: float = 1.0,
+                 recover_on_hang: bool = False,
+                 max_recoveries: int = 2,
+                 record_store: Optional[str] = None,
+                 run_id: Optional[str] = None,
+                 _sleep: Callable[[float], None] = time.sleep):
         self.model = model
         self.prefill_len = int(prefill_len or max_len - 1)
         if not 0 < self.prefill_len < max_len:
@@ -92,6 +140,26 @@ class ServeEngine:
         self.metrics = ServeMetrics()
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self._on_failure = on_failure
+        self.max_dispatch_retries = int(max_dispatch_retries)
+        if self.max_dispatch_retries < 0:
+            raise ValueError(f"max_dispatch_retries must be >= 0, got "
+                             f"{max_dispatch_retries}")
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.recover_on_hang = bool(recover_on_hang)
+        self.max_recoveries = int(max_recoveries)
+        self.record_store = record_store
+        self.run_id = run_id or \
+            f"{obs_record.new_run_id('serve')}-e{next(_ENGINE_SEQ)}"
+        self._sleep = _sleep
+        self._draining = False
+        self._closed = False
+        # set by the Heartbeat monitor thread, consumed at the next
+        # step boundary by the step thread (which owns the arena)
+        self._recover_flag = threading.Event()
+        self._recoveries = 0
+        self._incident_seq = itertools.count()
+        self._tick_ewma: Optional[float] = None   # measured step() wall s
 
         # weights snapshotted once (same pattern as _gen_setup); decode
         # is weight-read bound, so an optional one-time bf16 cast halves
@@ -116,6 +184,9 @@ class ServeEngine:
                 spec = jax.eval_shape(lambda: model.init_caches(1, 2))
             arena_dtype = jax.tree.leaves(spec)[0].dtype
         self._params, self._buffers = params, buffers
+        # arena construction args kept for recovery rebuilds
+        self._num_slots, self._max_len = num_slots, max_len
+        self._arena_dtype = arena_dtype
         self.pool = SlotPool(model, num_slots, max_len, dtype=arena_dtype)
 
         self._running: Dict[int, Request] = {}      # slot -> request
@@ -192,7 +263,14 @@ class ServeEngine:
         ``ValueError`` when the request cannot ever fit the arena
         (prompt longer than ``prefill_len``, or prompt + budget past
         ``max_len`` — the arena guarantee that decode never writes out
-        of bounds is enforced here, at the door)."""
+        of bounds is enforced here, at the door).  Raises
+        :class:`EngineClosed` while draining or after ``close()``."""
+        if self._closed:
+            raise EngineClosed("submit() on a closed engine")
+        if self._draining:
+            raise EngineClosed(
+                "engine is draining — new submissions are refused while "
+                "in-flight requests complete")
         req = Request(prompt_ids, max_new_tokens, deadline_s, eos_id,
                       on_token)
         p = req.prompt.size
@@ -215,12 +293,23 @@ class ServeEngine:
 
     # -- the engine loop ---------------------------------------------------
     def step(self) -> int:
-        """One continuous-batching tick: deadline eviction → admission
-        (prefill queued requests into free slots) → one decode over all
-        active slots.  Returns the number of tokens delivered."""
+        """One continuous-batching tick: recovery (if requested by the
+        hang watchdog) → deadline eviction → overload shedding →
+        admission (prefill queued requests into free slots) → one decode
+        over all active slots.  Returns the number of tokens
+        delivered."""
+        if self._closed:
+            raise EngineClosed("step() on a closed engine")
         with events.span("serve.step"):
             now = time.monotonic()
             delivered = 0
+
+            # 0. hang recovery — the Heartbeat monitor thread can only
+            #    REQUEST it; the rebuild must run here, on the step
+            #    thread, which owns the arena
+            if self._recover_flag.is_set():
+                self._recover_flag.clear()
+                self._recover("heartbeat")
 
             # 1. deadline eviction — queued requests that died waiting
             #    and running requests past their deadline vacate first,
@@ -233,6 +322,12 @@ class ServeEngine:
                 req.finish_reason = "deadline"
                 self._finalize(slot, evicted=True)
 
+            # 1b. deadline-aware overload shedding — queued requests
+            #     that cannot plausibly deliver a first token before
+            #     their deadline are shed before burning a prefill
+            for req in self.sched.shed_overload(now, self._eta_first_token):
+                self.metrics.on_evict("shed")
+
             # 2. admission — prefill into free slots between decode steps
             while self.pool.free_count:
                 req = self.sched.pop_for_admission()
@@ -240,20 +335,51 @@ class ServeEngine:
                     break
                 delivered += self._admit(req)
 
-            # 3. one decode tick over the whole arena
+            # 3. one decode tick over the whole arena; a decode that
+            #    died past its retry budget escalates to an arena
+            #    rebuild + re-prefill instead of crashing the engine
             if self._running:
-                delivered += self._decode_tick()
+                try:
+                    delivered += self._decode_tick()
+                except (RuntimeError, OSError) as e:
+                    if isinstance(e, failure.FailureDetected):
+                        raise
+                    self._recover(f"decode: {type(e).__name__}: {e}")
 
             self.metrics.on_step(self.sched.depth, self.pool.active_count)
+            dt = time.monotonic() - now
+            self._tick_ewma = dt if self._tick_ewma is None else \
+                0.8 * self._tick_ewma + 0.2 * dt
         return delivered
+
+    def _eta_first_token(self, position: int) -> float:
+        """Seconds until the queued request at ``position`` could
+        plausibly deliver its first token.  Shedding runs immediately
+        before admission in the same tick, so the first
+        ``pool.free_count`` queued requests prefill THIS tick — eta 0.0,
+        never shed (a truly-expired deadline is eviction's job, not
+        shedding's).  Requests behind that window wait about one
+        measured tick per admission wave of ``num_slots``.  0.0 before
+        any tick has been measured — shedding never fires without
+        timing evidence."""
+        if self._tick_ewma is None:
+            return 0.0
+        free = self.pool.free_count
+        if position < free:
+            return 0.0
+        return self._tick_ewma * (1 + (position - free)
+                                  // self.pool.num_slots)
 
     def run_until_idle(self, max_steps: Optional[int] = None) -> None:
         """Drive ``step()`` until no request is queued or running.  With
         ``heartbeat_timeout_s`` set, a Heartbeat watchdog guards every
         tick — a hung decode (dead device, wedged tunnel) aborts cleanly
-        instead of wedging the server."""
+        instead of wedging the server, or, with ``recover_on_hang``,
+        requests an arena rebuild + re-prefill at the next step
+        boundary."""
         hb = Heartbeat(timeout=self.heartbeat_timeout_s,
-                       on_failure=self._on_failure) \
+                       on_failure=(self._hb_failure if self.recover_on_hang
+                                   else self._on_failure)) \
             if self.heartbeat_timeout_s else None
         n = 0
         with hb if hb is not None else nullcontext():
@@ -262,41 +388,151 @@ class ServeEngine:
                 n += 1
                 if hb is not None:
                     hb.beat(n)
+                    if hb.fired and self.recover_on_hang:
+                        # the monitor thread exits after firing once;
+                        # re-arm it so a later hang in this same drive
+                        # is also caught
+                        hb.stop()
+                        hb.start()
                 if max_steps is not None and n >= max_steps:
                     break
+        if not self.pending:
+            # a fully drained system is proof the last recovery took —
+            # give future incidents a fresh rebuild budget, and drop any
+            # rebuild REQUEST a hang on the final tick left behind (the
+            # late decode still delivered everything; rebuilding a
+            # healthy idle arena at the next drive's first step would
+            # burn recovery budget and record a bogus incident)
+            self._recoveries = 0
+            self._recover_flag.clear()
+
+    def drain(self, max_steps: Optional[int] = None) -> None:
+        """Stop accepting new requests and complete everything already
+        in the system: queued requests still get admitted, in-flight
+        slots decode to completion (or eviction).  ``submit()`` raises
+        :class:`EngineClosed` from the moment drain begins — draining is
+        one-way, the step before :meth:`close`.  Safe to call
+        repeatedly."""
+        self._draining = True
+        self.run_until_idle(max_steps=max_steps)
+
+    def close(self) -> None:
+        """``drain()`` to idle, then release the engine: the arena and
+        token buffer are dropped (freeing device memory) and every
+        subsequent ``submit()``/``step()`` raises :class:`EngineClosed`.
+        Idempotent."""
+        if self._closed:
+            return
+        self.drain()
+        self._closed = True
+        self.pool = None
+        self._toks = None
+
+    def __enter__(self) -> "ServeEngine":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
 
     # -- internals ---------------------------------------------------------
+    def _dispatch(self, site: str, fn, args, **attrs):
+        """One guarded jitted dispatch: the injection site fires first
+        (host-side, BEFORE the call — the donated arena is still
+        intact), and transient RuntimeError/OSError is retried with
+        bounded exponential backoff.  Retry scope mirrors
+        ``train.loop``: sound for dispatch-level transients (tunnel
+        hiccup before launch, injected faults); a REAL mid-execution
+        failure invalidates the donated arena, so retries fail too and
+        the error escalates to the caller — quarantine for prefill,
+        arena recovery for decode."""
+        attempt = 0
+        while True:
+            try:
+                faults.fire(site, attempt=attempt, **attrs)
+                return fn(*args)
+            except (RuntimeError, OSError) as e:
+                if isinstance(e, failure.FailureDetected):
+                    raise
+                if attempt >= self.max_dispatch_retries:
+                    raise
+                delay = min(self.backoff_max,
+                            self.backoff_base * (2 ** attempt))
+                attempt += 1
+                self.metrics.on_retry(site)
+                self._sleep(delay)
+
     def _admit(self, req: Request) -> int:
         slot = self.pool.alloc()
         assert slot is not None, "admission with no free slot"
-        P = req.prompt.size
+        # replay_ids == prompt for a fresh request; for a request
+        # re-admitted by arena recovery it is prompt + tokens-so-far,
+        # whose greedy prefill pick IS the next decode token — the
+        # recovery re-prefill is idempotent
+        replay = req.replay_ids()
+        P = replay.size
         ids = np.zeros((1, self.prefill_len), np.int32)
-        ids[0, :P] = req.prompt
-        with events.span("serve.prefill", slot=slot, prompt=P):
-            self._toks, self.pool.caches = self._prefill(
-                self._params, self._buffers, jnp.asarray(ids),
-                jnp.asarray(P, jnp.int32), jnp.asarray(slot, jnp.int32),
-                self._toks, self.pool.caches)
-            tok = int(np.asarray(self._toks)[slot])
+        ids[0, :P] = replay
+        first = not req.tokens
+        try:
+            with events.span("serve.prefill", slot=slot, prompt=P):
+                self._toks, self.pool.caches = self._dispatch(
+                    "serve.prefill", self._prefill,
+                    (self._params, self._buffers, jnp.asarray(ids),
+                     jnp.asarray(P, jnp.int32),
+                     jnp.asarray(slot, jnp.int32),
+                     self._toks, self.pool.caches),
+                    rid=req.rid)
+                tok = int(np.asarray(self._toks)[slot])
+        except (RuntimeError, OSError) as e:
+            if isinstance(e, failure.FailureDetected):
+                raise
+            # the injected/transient failure fired before dispatch, so
+            # the slot row was never touched — hand it back and fail
+            # only THIS request, not the engine
+            self.pool.release(slot)
+            self._quarantine(req, e)
+            return 0
         self.pool.activate(slot, P)
         req.slot = slot
         req.state = RUNNING
         self._running[slot] = req
-        self.metrics.on_admit()
-        done = req.deliver(tok)       # prefill yields the first token
-        self.metrics.on_first_token(req.ttft_s)
+        if first:
+            # recovery re-prefills count under serve.recoveries, not
+            # here — ``admitted`` stays comparable to ``submitted``
+            self.metrics.on_admit()
+        done = req.deliver(tok)       # prefill yields the (next) token
+        if first:
+            self.metrics.on_first_token(req.ttft_s)
         if req.on_token is not None:
             req.on_token(tok, req.handle)
         if done:
             self._finalize(slot)
         return 1
 
+    def _quarantine(self, req: Request, err: Exception) -> None:
+        """Repeatedly-poisoned prefill: surface a per-request failure
+        status (handle.failed / handle.error), never an engine crash."""
+        req.state = FAILED
+        req.finish_reason = "quarantined"
+        req.error = (f"prefill failed after "
+                     f"{self.max_dispatch_retries + 1} attempt(s): "
+                     f"{type(err).__name__}: {err}")
+        self.metrics.on_quarantine()
+        self._incident("serve.prefill", type(err).__name__,
+                       f"req:{req.rid}", "quarantined",
+                       self.max_dispatch_retries + 1)
+        warnings.warn(f"serve: request {req.rid} quarantined: "
+                      f"{req.error}", stacklevel=2)
+
     def _decode_tick(self) -> int:
         t0 = time.perf_counter()
         with events.span("serve.decode", active=len(self._running)):
-            self._toks, new_pos, self.pool.caches = self._decode(
-                self._params, self._buffers, self._toks,
-                self.pool.pos, self.pool.active, self.pool.caches)
+            self._toks, new_pos, self.pool.caches = self._dispatch(
+                "serve.decode", self._decode,
+                (self._params, self._buffers, self._toks,
+                 self.pool.pos, self.pool.active, self.pool.caches),
+                active=len(self._running))
             toks = np.asarray(self._toks)    # tiny fetch: num_slots ints
         self.pool.pos = new_pos
         dt = time.perf_counter() - t0
@@ -318,3 +554,89 @@ class ServeEngine:
         self.pool.release(slot)
         req.state = EVICTED if evicted else FINISHED
         self.metrics.on_evict(req.finish_reason or "unknown")
+
+    # -- recovery ----------------------------------------------------------
+    def recover(self, reason: str = "requested") -> None:
+        """Rebuild the arena and re-prefill every in-flight request —
+        the path behind Heartbeat hang detection, also callable directly
+        after an external device event.  Each running request is
+        requeued at the HEAD of the queue and re-prefilled from
+        ``prompt + tokens-so-far``; greedy decode makes that replay
+        idempotent, so however many times recovery runs, the final
+        streams are bit-identical to an uninterrupted run.  A request
+        whose replay no longer fits ``prefill_len`` is failed
+        (``unrecoverable``) rather than silently truncated."""
+        self._recover(reason)
+
+    def _recover(self, reason: str) -> None:
+        self._recoveries += 1
+        if self._recoveries > self.max_recoveries:
+            raise RuntimeError(
+                f"serve engine exceeded max_recoveries="
+                f"{self.max_recoveries} (last reason: {reason}) — the "
+                f"fault is not transient; surfacing it instead of "
+                f"rebuilding forever")
+        with events.span("serve.recover", reason=reason):
+            inflight = sorted(self._running.values(), key=lambda r: r.rid)
+            self._running.clear()
+            # fresh arena + token buffer: same shapes/dtypes, so the two
+            # compiled programs are reused — recovery never recompiles
+            self.pool = SlotPool(self.model, self._num_slots,
+                                 self._max_len, dtype=self._arena_dtype)
+            self._toks = jnp.zeros((self._num_slots,), jnp.int32)
+            requeue = []
+            for req in inflight:
+                if req.replay_ids().size > self.prefill_len:
+                    req.state = FAILED
+                    req.finish_reason = "unrecoverable"
+                    req.error = (
+                        f"cannot re-prefill after arena rebuild: prompt "
+                        f"+ generated = {req.replay_ids().size} tokens "
+                        f"exceeds prefill_len ({self.prefill_len})")
+                    self.metrics.on_evict("unrecoverable")
+                    self._incident("serve.arena", reason,
+                                   f"req:{req.rid}", "unrecoverable", 0)
+                else:
+                    requeue.append(req)
+            self.sched.requeue_front(requeue)
+            self.metrics.on_recover(len(requeue))
+            self._incident("serve.arena", reason,
+                           f"inflight:{len(requeue)}", "recovered",
+                           self._recoveries)
+
+    def _hb_failure(self, age: float, last_beat: int) -> None:
+        """Heartbeat monitor-thread path (``recover_on_hang``): only
+        REQUEST recovery — the step thread owns the arena and performs
+        the rebuild at its next step boundary (a hung dispatch cannot be
+        preempted from here anyway; an injected hang simply returns
+        late).  A user ``on_failure`` still gets the observation."""
+        events.counter("serve.hangs", 1, age_s=round(age, 3))
+        self._recover_flag.set()
+        if self._on_failure is not None:
+            self._on_failure(age, last_beat)
+
+    # -- durable incident records -----------------------------------------
+    def _incident(self, site: str, fault: str, ref, outcome: str,
+                  retries: int) -> None:
+        """Append one ``incident`` entry to the run-record store (when
+        ``record_store`` is set).  Best-effort: the record is evidence,
+        not a dependency — a full disk must not turn a survived fault
+        into a crash."""
+        events.counter("serve.incident", 1, site=site, outcome=outcome)
+        if not self.record_store:
+            return
+        try:
+            platform = jax.default_backend()
+            dev = jax.devices()[0]
+            payload = {"site": site, "fault": fault, "ref": ref,
+                       "outcome": outcome, "retries": int(retries),
+                       "engine_run": self.run_id}
+            entry = obs_record.new_entry(
+                "incident", platform, platform != "tpu",
+                getattr(dev, "device_kind", "") or platform,
+                run_id=f"{self.run_id}-inc{next(self._incident_seq)}",
+                payload=payload)
+            obs_record.RunRecord(self.record_store).append(entry)
+        except Exception as e:
+            warnings.warn(f"could not append incident record: "
+                          f"{type(e).__name__}: {e}", stacklevel=2)

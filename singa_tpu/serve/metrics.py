@@ -9,12 +9,21 @@ Metric names (documented in docs/serving.md):
 name                        kind       meaning
 ==========================  =========  ==================================
 ``serve.submitted``         counter    requests accepted by submit()
-``serve.admitted``          counter    prefilled into a slot
+``serve.admitted``          counter    first prefill into a slot (recovery
+                                       re-prefills count under
+                                       ``serve.recoveries``)
 ``serve.rejected``          counter    refused at submit (queue full)
 ``serve.evicted``           counter    left the system — a slot vacated
                                        (``eos``/``length``/``deadline``)
                                        or a queued request dropped at
-                                       its deadline (``reason`` attr)
+                                       its deadline or shed under
+                                       overload (``reason`` attr)
+``serve.retries``           counter    one transient dispatch failure
+                                       retried with backoff (``site``)
+``serve.quarantined``       counter    a request the engine gave up on
+                                       (failed handle status)
+``serve.recoveries``        counter    arena rebuild + re-prefill of
+                                       in-flight requests
 ``serve.queue_depth``       gauge      waiting requests, after each step
 ``serve.active_slots``      gauge      live slots, after each step
 ``serve.step``              span       one engine step (host wall clock)
@@ -54,6 +63,9 @@ class ServeMetrics:
         self.admitted = 0
         self.rejected = 0
         self.evicted: Dict[str, int] = {}
+        self.retries: Dict[str, int] = {}
+        self.quarantined = 0
+        self.recoveries = 0
         self.steps = 0
         self._ttft = _Hist()
         self._token = _Hist()
@@ -74,6 +86,19 @@ class ServeMetrics:
     def on_evict(self, reason: str) -> None:
         self.evicted[reason] = self.evicted.get(reason, 0) + 1
         events.counter("serve.evicted", 1, reason=reason)
+
+    # -- resilience (ISSUE 4) ---------------------------------------------
+    def on_retry(self, site: str) -> None:
+        self.retries[site] = self.retries.get(site, 0) + 1
+        events.counter("serve.retries", 1, site=site)
+
+    def on_quarantine(self) -> None:
+        self.quarantined += 1
+        events.counter("serve.quarantined", 1)
+
+    def on_recover(self, inflight: int) -> None:
+        self.recoveries += 1
+        events.counter("serve.recoveries", 1, inflight=inflight)
 
     # -- latency ----------------------------------------------------------
     def on_first_token(self, ttft_s: float) -> None:
@@ -96,6 +121,9 @@ class ServeMetrics:
         return {
             "submitted": self.submitted, "admitted": self.admitted,
             "rejected": self.rejected, "evicted": dict(self.evicted),
+            "retries": dict(self.retries),
+            "quarantined": self.quarantined,
+            "recoveries": self.recoveries,
             "steps": self.steps,
             "ttft_ms": self._ttft.summary(),
             "token_ms": self._token.summary(),

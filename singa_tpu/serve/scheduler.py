@@ -24,12 +24,16 @@ from typing import Callable, Deque, List, Optional
 import numpy as np
 
 __all__ = ["QueueFull", "Request", "RequestHandle", "Scheduler",
-           "QUEUED", "RUNNING", "FINISHED", "EVICTED"]
+           "QUEUED", "RUNNING", "FINISHED", "EVICTED", "FAILED"]
 
 QUEUED = "queued"
 RUNNING = "running"
 FINISHED = "finished"
 EVICTED = "evicted"
+#: terminal state of a request the ENGINE gave up on (quarantined after
+#: repeatedly poisoning prefill, or unrecoverable after an arena
+#: rebuild) — surfaced on the handle instead of crashing the engine
+FAILED = "failed"
 
 
 class QueueFull(RuntimeError):
@@ -67,8 +71,20 @@ class Request:
         self.slot: Optional[int] = None
         self.tokens: List[int] = []
         self.finish_reason: Optional[str] = None
+        self.error: Optional[str] = None
         self.ttft_s: Optional[float] = None
         self.handle = RequestHandle(self)
+
+    def replay_ids(self) -> np.ndarray:
+        """prompt + tokens generated so far — what an arena-recovery
+        re-prefill feeds the prefill program, and (via
+        :meth:`RequestHandle.result`) the user-facing full sequence.
+        Greedy decode makes the replay idempotent: the re-prefilled
+        slot's next token is exactly the token decode would have
+        produced next, so recovering any number of times leaves the
+        final stream bit-identical."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)])
 
     # -- transitions (called by the engine) ------------------------------
     def deliver(self, tok: int) -> bool:
@@ -107,11 +123,24 @@ class RequestHandle:
 
     @property
     def done(self) -> bool:
-        return self._req.state in (FINISHED, EVICTED)
+        return self._req.state in (FINISHED, EVICTED, FAILED)
+
+    @property
+    def failed(self) -> bool:
+        """True when the engine gave up on this request (quarantined /
+        unrecoverable) — a per-request failure status, never an engine
+        crash."""
+        return self._req.state == FAILED
+
+    @property
+    def error(self) -> Optional[str]:
+        """The failure message when :attr:`failed`, else None."""
+        return self._req.error
 
     @property
     def finish_reason(self) -> Optional[str]:
-        """'eos' | 'length' | 'deadline' (None while in flight)."""
+        """'eos' | 'length' | 'deadline' | 'shed' | 'quarantined' |
+        'unrecoverable' (None while in flight)."""
         return self._req.finish_reason
 
     @property
@@ -125,8 +154,7 @@ class RequestHandle:
 
     def result(self) -> np.ndarray:
         """prompt + generated tokens as one int32 vector."""
-        return np.concatenate([self._req.prompt,
-                               np.asarray(self._req.tokens, np.int32)])
+        return self._req.replay_ids()
 
 
 class Scheduler:
@@ -160,6 +188,42 @@ class Scheduler:
                 r.state = EVICTED
                 r.finish_reason = "deadline"
         return dead
+
+    def shed_overload(self, now: float,
+                      eta_first_token_s: Callable[[int], float]
+                      ) -> List[Request]:
+        """Deadline-aware overload shedding: evict queued requests whose
+        deadline will expire before they could plausibly produce a first
+        token.  ``eta_first_token_s(position)`` is the engine's estimate
+        of seconds until the request at queue ``position`` would deliver
+        its first token (derived from measured tick times); a request
+        with ``deadline < now + eta`` only wastes a prefill, so it is
+        shed NOW — at admission-decision time, not after burning a slot.
+        Deadline-less requests are never shed."""
+        shed: List[Request] = []
+        keep: Deque[Request] = deque()
+        pos = 0
+        for r in self.queue:
+            if (r.deadline is not None
+                    and now + eta_first_token_s(pos) > r.deadline):
+                r.state = EVICTED
+                r.finish_reason = "shed"
+                shed.append(r)
+            else:
+                keep.append(r)
+                pos += 1
+        self.queue = keep
+        return shed
+
+    def requeue_front(self, reqs: List[Request]) -> None:
+        """Put recovered in-flight requests back at the HEAD of the
+        queue, preserving their order — they were already admitted once,
+        so re-admission after an arena rebuild must neither lose their
+        FIFO priority nor be refused by ``max_queue`` backpressure."""
+        for r in reversed(reqs):
+            r.state = QUEUED
+            r.slot = None
+            self.queue.appendleft(r)
 
     def pop_for_admission(self) -> Optional[Request]:
         """Next request to prefill into a free slot (FIFO), or None."""

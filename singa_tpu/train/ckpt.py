@@ -168,10 +168,18 @@ class AsyncCheckpointManager:
         return self.path(step)
 
     def _write(self, step: int, arrays: Dict, aux: Dict) -> None:
+        from .. import faults
         with events.span("train.ckpt.write", step=step):
             if checkpoint._process_index() == 0:
+                # "ckpt.write" fires before any bytes land, so an
+                # injected error surfaces through wait() exactly like
+                # ENOSPC would — the caller's _save_checked fatal path
+                faults.fire("ckpt.write", step=step, path=self.path(step))
                 checkpoint.save_arrays(arrays, self.path(step), aux)
                 self._commit(step)
+                # "ckpt.torn" tears the npz AFTER its commit marker
+                # landed: the sha-checked restore path must skip it
+                faults.fire("ckpt.torn", step=step, path=self.path(step))
                 self._gc()
             checkpoint._barrier(f"singa_train_ckpt_{step}")
         events.counter("train.ckpt.committed", 1, step=step)

@@ -274,9 +274,15 @@ class TrainRunner:
             for b in batch)
 
     def _step_with_retries(self, step: int, batch: Tuple):
+        from .. import faults
         attempt = 0
         while True:
             try:
+                # "train.step" injection site: the retried region — an
+                # injected InjectedFault is a RuntimeError, so it takes
+                # the same backoff/liveness/fatal path a real transient
+                # dispatch failure would
+                faults.fire("train.step", step=step, attempt=attempt)
                 with events.span("train.step", step=step, attempt=attempt):
                     return self.model.train_step(
                         *(b for b in batch if b is not None))

@@ -97,6 +97,12 @@ class DataLoader:
             (n + self.batch_size - 1) // self.batch_size
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray]]]:
+        # "data.next" injection site: fires per batch draw on both
+        # pipelines (error/hang before the yield; nan corrupts the
+        # yielded float arrays) — the cursor has already advanced, so a
+        # caller that retries past an injected error skips the batch,
+        # exactly like a genuinely corrupt shard would be skipped
+        from .. import faults
         if self._native is not None:
             for _ in range(len(self) - self._batch_idx):
                 try:
@@ -106,7 +112,9 @@ class DataLoader:
                     # cleanly instead of PEP-479 RuntimeError
                     return
                 self._batch_idx += 1
-                yield b
+                faults.fire("data.next", epoch=self._epoch,
+                            batch=self._batch_idx)
+                yield faults.corrupt("data.next", b)
             self._epoch += 1
             self._batch_idx = 0
             return
@@ -120,8 +128,10 @@ class DataLoader:
             if len(sel) == 0:
                 break
             self._batch_idx = b + 1
-            yield (self.x[sel],
-                   self.y[sel] if self.y is not None else None)
+            faults.fire("data.next", epoch=self._epoch, batch=b + 1)
+            yield faults.corrupt(
+                "data.next",
+                (self.x[sel], self.y[sel] if self.y is not None else None))
         self._epoch += 1
         self._batch_idx = 0
 

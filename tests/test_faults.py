@@ -1,0 +1,714 @@
+"""singa_tpu.faults (ISSUE 4) — deterministic fault injection and the
+serve engine's resilience paths, tier-1 lean.
+
+The acceptance invariants under test:
+  * with a FaultPlan injecting transient decode failures plus a prefill
+    hang, the engine completes every non-poisoned request with greedy
+    tokens bitwise-identical to a fault-free run, quarantined requests
+    surface a failed status, and the engine never crashes;
+  * with no active plan every injection site is a no-op: no obs events,
+    jit caches unchanged, and an empty probe plan counts site calls
+    without firing;
+  * plans are seeded-deterministic and fail loudly on unknown
+    sites/kinds/options;
+  * incident records land in the durable store and lint clean.
+
+Budget discipline: ONE llama-tiny engine fixture is shared by every
+chaos test here (recovery rebuilds reuse its two compiled programs);
+hang-detection (Heartbeat) and decode-exhaustion rebuild tests are
+marked ``slow`` per the tier-1 cutoff rules in ROADMAP.md.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from singa_tpu import faults, models, tensor
+from singa_tpu.faults import FaultPlan, FaultSpec, InjectedFault
+from singa_tpu.obs import events
+from singa_tpu.obs import record as obs_record
+from singa_tpu.obs import schema
+from singa_tpu.serve import EngineClosed, ServeEngine
+from singa_tpu.utils.data import DataLoader
+
+
+@pytest.fixture(autouse=True)
+def _no_plan_leak():
+    """A test that dies inside faults.active() must not poison the rest
+    of the suite with a live plan (or a lingering sink)."""
+    yield
+    faults.uninstall()
+    events.configure(annotate=False)
+
+
+# ---------------------------------------------------------------------------
+# plan construction, validation, determinism (no jax)
+# ---------------------------------------------------------------------------
+
+class TestFaultPlan:
+    def test_unknown_site_fails_at_construction(self):
+        with pytest.raises(ValueError, match="unknown injection site"):
+            FaultSpec("serve.decoed", "error")
+
+    def test_unknown_kind_fails(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultSpec("serve.decode", "explode")
+
+    def test_site_kind_compatibility(self):
+        # serve.prefill supports error/hang, not nan or torn_write
+        with pytest.raises(ValueError, match="does not support"):
+            FaultSpec("serve.prefill", "nan")
+        with pytest.raises(ValueError, match="does not support"):
+            FaultSpec("ckpt.torn", "error")
+
+    def test_triggers_mutually_exclusive(self):
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            FaultSpec("serve.decode", "error", at=1, every=2)
+
+    def test_option_validation(self):
+        with pytest.raises(ValueError):
+            FaultSpec("serve.decode", "error", at=0)
+        with pytest.raises(ValueError):
+            FaultSpec("serve.decode", "error", every=0)
+        with pytest.raises(ValueError):
+            FaultSpec("serve.decode", "error", p=1.5)
+        with pytest.raises(ValueError):
+            FaultSpec("serve.decode", "hang", delay_s=-1)
+
+    def test_env_syntax_parses(self):
+        p = FaultPlan.parse(
+            "serve.decode=error:every=3,times=2;"
+            "serve.prefill=hang:at=1,delay=0.5", seed=9)
+        assert len(p.specs) == 2 and p.seed == 9
+        assert p.specs[0].every == 3 and p.specs[0].times == 2
+        assert p.specs[1].kind == "hang" and p.specs[1].delay_s == 0.5
+        # `at` defaults to a single fire
+        assert p.specs[1].times == 1
+
+    def test_env_syntax_fails_loudly(self):
+        # a malformed chaos plan must never silently inject nothing
+        with pytest.raises(ValueError, match="expected"):
+            FaultPlan.parse("serve.decode")
+        with pytest.raises(ValueError, match="unknown fault option"):
+            FaultPlan.parse("serve.decode=error:never=3")
+        with pytest.raises(ValueError, match="unknown injection site"):
+            FaultPlan.parse("serve.typo=error")
+
+    def test_probabilistic_firing_is_seed_deterministic(self):
+        def pattern(seed):
+            plan = FaultPlan([FaultSpec("serve.decode", "error", p=0.4)],
+                             seed=seed)
+            return [bool(plan.match("serve.decode", ("error",)))
+                    for _ in range(64)]
+        a, b = pattern(3), pattern(3)
+        assert a == b and any(a) and not all(a)
+        assert pattern(4) != a          # a different seed reschedules
+
+    def test_every_and_times_cap(self):
+        plan = FaultPlan([FaultSpec("serve.decode", "error",
+                                    every=2, times=2)])
+        hits = [bool(plan.match("serve.decode", ("error",)))
+                for _ in range(8)]
+        assert hits == [False, True, False, True, False, False,
+                        False, False]
+        assert plan.fire_count() == 2
+
+    def test_empty_plan_is_the_call_count_probe(self):
+        plan = FaultPlan()
+        with faults.active(plan):
+            faults.fire("serve.decode")
+            faults.fire("serve.decode")
+            out = faults.corrupt("device.execute", np.ones(2, np.float32))
+        assert plan.calls == {"serve.decode": 2}
+        assert plan.fired == [] and not np.isnan(out).any()
+
+    def test_nested_activation_rejected(self):
+        with faults.active(FaultPlan()):
+            with pytest.raises(RuntimeError, match="already active"):
+                with faults.active(FaultPlan()):
+                    pass
+
+
+# ---------------------------------------------------------------------------
+# fire / corrupt semantics
+# ---------------------------------------------------------------------------
+
+class TestFireCorrupt:
+    def test_injected_fault_is_a_runtime_error(self):
+        assert issubclass(InjectedFault, RuntimeError)
+        plan = FaultPlan([FaultSpec("serve.decode", "error", at=1)])
+        with faults.active(plan):
+            with pytest.raises(InjectedFault, match="serve.decode"):
+                faults.fire("serve.decode")
+            faults.fire("serve.decode")     # at=1 fired once; call 2 clean
+
+    def test_no_plan_emits_no_events(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        events.configure(path=path)
+        try:
+            faults.fire("serve.decode")
+            faults.corrupt("device.execute", np.ones(1, np.float32))
+        finally:
+            events.configure()
+        assert not os.path.exists(path) or open(path).read() == ""
+
+    def test_fired_fault_emits_obs_counter(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        plan = FaultPlan([FaultSpec("serve.decode", "error", at=1)])
+        events.configure(path=path)
+        try:
+            with faults.active(plan):
+                with pytest.raises(InjectedFault):
+                    faults.fire("serve.decode")
+        finally:
+            events.configure()
+        evs = [json.loads(l) for l in open(path)]
+        fired = [e for e in evs if e["name"] == "fault.injected"]
+        assert len(fired) == 1
+        assert fired[0]["site"] == "serve.decode"
+        assert fired[0]["fault_kind"] == "error"
+
+    def test_torn_write_truncates_the_ctx_path(self, tmp_path):
+        f = tmp_path / "ckpt.npz"
+        f.write_bytes(b"x" * 100)
+        plan = FaultPlan([FaultSpec("ckpt.torn", "torn_write", at=1)])
+        with faults.active(plan):
+            faults.fire("ckpt.torn", path=str(f))
+        assert f.stat().st_size == 50
+
+    def test_corrupt_nanifies_floats_only(self):
+        plan = FaultPlan([FaultSpec("data.next", "nan", at=1)])
+        with faults.active(plan):
+            plan.match("data.next", ("error", "hang"))   # advance call 1
+            x, y = faults.corrupt(
+                "data.next",
+                (np.ones((2, 3), np.float32), np.ones(2, np.int32)))
+        assert np.isnan(x).all()
+        assert (y == 1).all() and y.dtype == np.int32
+
+    def test_registry_is_documented(self):
+        for name, (desc, kinds) in faults.SITES.items():
+            assert desc and kinds, f"site {name} missing doc/kinds"
+            assert all(k in faults.KINDS for k in kinds)
+
+
+# ---------------------------------------------------------------------------
+# satellite guards: monotonic failure detection, admission validation
+# ---------------------------------------------------------------------------
+
+def test_failure_detection_never_reads_wall_clock():
+    """Heartbeat/device_liveness_check must be immune to wall-clock
+    jumps (NTP step, suspend/resume): a time.time() reappearing in
+    utils/failure.py could fire false hang detections or mask real
+    ones."""
+    import inspect
+
+    from singa_tpu.utils import failure
+    src = inspect.getsource(failure)
+    assert "time.time(" not in src
+    assert "time.monotonic(" in src
+
+
+def test_scheduler_deadlines_are_monotonic():
+    import inspect
+
+    from singa_tpu.serve import scheduler
+    src = inspect.getsource(scheduler)
+    assert "time.time(" not in src
+
+
+# ---------------------------------------------------------------------------
+# scheduler policy units (no jax)
+# ---------------------------------------------------------------------------
+
+class TestSchedulerPolicy:
+    def _req(self, deadline_s=None):
+        from singa_tpu.serve.scheduler import Request
+        return Request(np.array([1, 2], np.int32), 4, deadline_s, None,
+                       None)
+
+    def test_shed_overload_evicts_only_hopeless_deadlines(self):
+        import time as _t
+
+        from singa_tpu.serve.scheduler import EVICTED, Scheduler
+        s = Scheduler(max_queue=8)
+        keep_none = self._req(None)           # deadline-less: never shed
+        keep_far = self._req(deadline_s=60.0)
+        hopeless = self._req(deadline_s=0.05)
+        for r in (keep_none, hopeless, keep_far):
+            s.offer(r)
+        shed = s.shed_overload(_t.monotonic(), lambda pos: 10.0)
+        assert shed == [hopeless]
+        assert hopeless.state == EVICTED
+        assert hopeless.finish_reason == "shed"
+        assert list(s.queue) == [keep_none, keep_far]
+
+    def test_requeue_front_preserves_order_and_ignores_backpressure(self):
+        from singa_tpu.serve.scheduler import QUEUED, Scheduler
+        s = Scheduler(max_queue=1)
+        s.offer(self._req())                  # queue now at capacity
+        a, b = self._req(), self._req()
+        a.state = b.state = "running"
+        s.requeue_front([a, b])               # recovery must not be refused
+        assert list(s.queue)[:2] == [a, b]
+        assert a.state == QUEUED and s.depth == 3
+
+
+# ---------------------------------------------------------------------------
+# data / train / ckpt site wiring (no jit: TinyModel + python loader)
+# ---------------------------------------------------------------------------
+
+class _TinyModel:
+    """Checkpointable no-jit model stub (mirrors test_train's)."""
+
+    class _P:
+        def __init__(self, v):
+            self.data = v
+
+    def __init__(self):
+        self.w = self._P(np.zeros(2, np.float32))
+        self.optimizer = None
+        self._step_count = 0
+        self._base_key = np.array([0, 1], np.uint32)
+
+    def get_states(self):
+        return {"w": self.w}
+
+    def set_states(self, s):
+        self.w.data = np.asarray(s["w"])
+
+    def train_step(self, x, y):
+        self.w.data = self.w.data + 1.0
+        self._step_count += 1
+        return None, np.float32(0.5)
+
+
+def _loader():
+    r = np.random.RandomState(7)
+    return DataLoader(r.randn(16, 4).astype(np.float32),
+                      r.randint(0, 2, 16).astype(np.int32),
+                      batch_size=4, seed=3, use_native=False)
+
+
+class TestDataSite:
+    def test_error_at_second_batch(self):
+        plan = FaultPlan([FaultSpec("data.next", "error", at=2)])
+        with faults.active(plan):
+            it = iter(_loader())
+            next(it)
+            with pytest.raises(InjectedFault, match="data.next"):
+                next(it)
+
+    def test_nan_corruption_hits_floats_not_labels(self):
+        plan = FaultPlan([FaultSpec("data.next", "nan", at=1)])
+        with faults.active(plan):
+            x, y = next(iter(_loader()))
+        assert np.isnan(x).all() and not np.issubdtype(y.dtype,
+                                                       np.floating)
+
+    def test_no_plan_batches_clean(self):
+        x, y = next(iter(_loader()))
+        assert np.isfinite(x).all()
+
+
+class TestTrainSite:
+    def test_transient_step_fault_is_retried(self):
+        from singa_tpu.train import TrainRunner
+        plan = FaultPlan([FaultSpec("train.step", "error", at=1)])
+        r = TrainRunner(_TinyModel(), _loader(), total_steps=3,
+                        to_batch=tuple, _sleep=lambda s: None)
+        with faults.active(plan):
+            res = r.run()
+        assert res.outcome == "completed" and res.steps == 3
+        assert plan.fire_count("train.step") == 1
+
+    def test_exhausted_retries_take_the_fatal_path(self):
+        from singa_tpu.train import TrainAborted, TrainRunner
+        plan = FaultPlan([FaultSpec("train.step", "error")])  # every call
+        r = TrainRunner(_TinyModel(), _loader(), total_steps=3,
+                        to_batch=tuple, max_retries=1,
+                        liveness_timeout=2.0,
+                        on_fatal=lambda msg: None,
+                        _sleep=lambda s: None)
+        with faults.active(plan):
+            with pytest.raises(TrainAborted):
+                r.run()
+
+    def test_ckpt_write_fault_surfaces_like_enospc(self, tmp_path):
+        from singa_tpu.train import AsyncCheckpointManager
+        ck = AsyncCheckpointManager(str(tmp_path / "ck"))
+        plan = FaultPlan([FaultSpec("ckpt.write", "error", at=1)])
+        with faults.active(plan):
+            # async path: the injected error fires on the writer
+            # thread and must surface through wait(), exactly like a
+            # real write failure (ENOSPC)
+            ck.save(1, _TinyModel())
+            with pytest.raises(InjectedFault):
+                ck.wait()
+        assert ck.steps() == []        # nothing committed
+        ck.close()
+
+    def test_torn_commit_falls_back_to_previous(self, tmp_path):
+        from singa_tpu.train import AsyncCheckpointManager
+        m = _TinyModel()
+        ck = AsyncCheckpointManager(str(tmp_path / "ck"), save_every=1)
+        m.w.data = np.full(2, 5.0, np.float32)
+        ck.save(1, m, block=True)
+        plan = FaultPlan([FaultSpec("ckpt.torn", "torn_write", at=1)])
+        m.w.data = np.full(2, 9.0, np.float32)
+        with faults.active(plan):
+            ck.save(2, m, block=True)       # commits, then gets torn
+        fresh = _TinyModel()
+        with pytest.warns(UserWarning, match="torn checkpoint"):
+            aux = ck.restore_latest(fresh)
+        assert aux["step"] == 1
+        np.testing.assert_array_equal(fresh.w.data, np.full(2, 5.0))
+        ck.close()
+
+
+# ---------------------------------------------------------------------------
+# incident records
+# ---------------------------------------------------------------------------
+
+class TestIncidentRecords:
+    def test_schema_accepts_and_rejects(self):
+        good = {"site": "serve.prefill", "fault": "InjectedFault",
+                "ref": "req:3", "outcome": "quarantined", "retries": 3}
+        schema.validate_incident_payload(good)
+        for missing in ("site", "fault", "ref", "outcome", "retries"):
+            bad = dict(good)
+            del bad[missing]
+            with pytest.raises(schema.SchemaError, match=missing):
+                schema.validate_incident_payload(bad)
+        with pytest.raises(schema.SchemaError, match="retries"):
+            schema.validate_incident_payload({**good, "retries": "three"})
+
+    def test_store_roundtrip_and_lint(self, tmp_path):
+        store = tmp_path / "runs" / "records.jsonl"
+        entry = obs_record.new_entry(
+            "incident", "cpu", True, "cpu", run_id="inc-test-1",
+            payload={"site": "serve.decode", "fault": "hang",
+                     "ref": 7, "outcome": "recovered", "retries": 2})
+        obs_record.RunRecord(str(store)).append(entry)
+        assert obs_record.RunRecord(str(store)).validate() == []
+        import sys as _sys
+        _sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                         "..", "tools"))
+        import record_check
+        assert record_check.check_root(str(tmp_path)) == []
+        # and a mangled incident is NAMED, not a raw KeyError
+        bad = dict(entry, run_id="inc-test-2",
+                   payload={"site": "serve.decode"})
+        store.write_text(store.read_text()
+                         + json.dumps(bad) + "\n")
+        errs = record_check.check_root(str(tmp_path))
+        assert errs and "fault" in errs[0]
+
+
+# ---------------------------------------------------------------------------
+# the serve engine chaos suite (one shared compiled engine)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def llama():
+    tensor.set_seed(0)
+    m = models.Llama(models.LlamaConfig.tiny())
+    m.eval()
+    m.compile([tensor.from_numpy(np.zeros((1, 4), np.int32))],
+              is_train=False, use_graph=False)
+    return m
+
+
+@pytest.fixture(scope="module")
+def engine(llama):
+    """The shared chaos engine: every test drains it back to idle, and
+    recovery rebuilds reuse its two compiled programs."""
+    return ServeEngine(llama, num_slots=3, max_len=24, prefill_len=10,
+                       backoff_base=0.001, backoff_max=0.01)
+
+
+def _prompts(lens, seed=7, vocab=256):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, vocab, (n,)).astype(np.int32) for n in lens]
+
+
+@pytest.fixture(scope="module")
+def baseline(engine):
+    """Fault-free greedy streams — the bitwise reference every chaos
+    run must reproduce."""
+    hs = [engine.submit(p, max_new_tokens=6)
+          for p in _prompts([4, 6, 8])]
+    engine.run_until_idle()
+    assert engine.compiled_counts() == (1, 1)
+    return [h.tokens for h in hs]
+
+
+class TestServeChaos:
+    def test_flagship_transient_decode_plus_prefill_hang(
+            self, engine, baseline, tmp_path):
+        """THE acceptance scenario: transient decode failures + one
+        prefill hang + one request that repeatedly poisons prefill.
+        All non-poisoned requests finish bitwise-identical to the
+        fault-free run, the poisoned one surfaces a failed status, the
+        engine never crashes, and nothing recompiled."""
+        store = str(tmp_path / "runs" / "records.jsonl")
+        engine.record_store = store
+        # the poisoned request is submitted FIRST, so its prefill is
+        # site calls 1..3 (initial + 2 retries); the healthy requests'
+        # prefills start at call 4; the hang delays call 5
+        plan = FaultPlan([
+            FaultSpec("serve.prefill", "error", every=1, times=3),
+            FaultSpec("serve.prefill", "hang", at=5, delay_s=0.05),
+            FaultSpec("serve.decode", "error", every=3, times=2),
+        ], seed=1)
+        try:
+            with faults.active(plan):
+                poisoned = engine.submit(_prompts([5], seed=3)[0],
+                                         max_new_tokens=6)
+                with pytest.warns(UserWarning, match="quarantined"):
+                    hs = [engine.submit(p, max_new_tokens=6)
+                          for p in _prompts([4, 6, 8])]
+                    engine.run_until_idle()
+        finally:
+            engine.record_store = None
+        assert [h.tokens for h in hs] == baseline
+        assert poisoned.failed and poisoned.status == "failed"
+        assert poisoned.finish_reason == "quarantined"
+        assert "prefill failed" in poisoned.error
+        assert engine.pending == 0
+        assert engine.compiled_counts() == (1, 1)
+        # 3 poisoned-prefill fires + 1 hang + 2 decode errors
+        assert plan.fire_count() == 6
+        assert engine.metrics.retries.get("serve.decode") == 2
+        assert engine.metrics.quarantined >= 1
+        # the quarantine landed as a linted incident record
+        entries = obs_record.RunRecord(store).entries()
+        assert [e["payload"]["outcome"] for e in entries
+                if e["kind"] == "incident"] == ["quarantined"]
+
+    def test_direct_recovery_is_idempotent(self, engine, baseline):
+        """Mid-stream arena rebuild + re-prefill reproduces the exact
+        greedy streams (and reuses the compiled programs)."""
+        hs = [engine.submit(p, max_new_tokens=6)
+              for p in _prompts([4, 6, 8])]
+        # one tick = prefill wave + one decode: 2 tokens each, so the
+        # longest replay is 8 + 2 = prefill_len — still recoverable
+        engine.step()
+        before = engine.metrics.recoveries
+        engine.recover("test")
+        engine.recover("test-again")    # twice: still idempotent
+        engine.run_until_idle()
+        assert [h.tokens for h in hs] == baseline
+        assert engine.metrics.recoveries == before + 2
+        assert engine.compiled_counts() == (1, 1)
+
+    def test_recovery_fails_oversized_replay_loudly(self, engine):
+        """A request whose prompt+generated no longer fits prefill_len
+        is failed as unrecoverable, not silently truncated — and the
+        others still complete."""
+        long_p, short_p = _prompts([9, 4], seed=5)
+        h_long = engine.submit(long_p, max_new_tokens=8)
+        h_short = engine.submit(short_p, max_new_tokens=3)
+        engine.step()                   # long has 2 tokens: replay = 11
+        engine.recover("test")
+        engine.run_until_idle()
+        assert h_long.failed and h_long.finish_reason == "unrecoverable"
+        assert "prefill_len" in h_long.error
+        assert h_short.done and not h_short.failed
+        assert len(h_short.tokens) == 3
+
+    def test_zero_overhead_when_off(self, engine, baseline, tmp_path):
+        """Acceptance: with no plan active no obs event is emitted on
+        the hot path, and an EMPTY probe plan shows every site is still
+        reached — while jit caches stay at one entry each."""
+        path = str(tmp_path / "ev.jsonl")
+        events.configure(path=path)
+        try:
+            hs = [engine.submit(p, max_new_tokens=4)
+                  for p in _prompts([4, 6])]
+            engine.run_until_idle()
+        finally:
+            events.configure()
+        assert all(h.done for h in hs)
+        assert all(json.loads(l)["name"] != "fault.injected"
+                   for l in open(path))
+        probe = FaultPlan()             # counts calls, fires nothing
+        with faults.active(probe):
+            hs = [engine.submit(p, max_new_tokens=4)
+                  for p in _prompts([4, 6])]
+            engine.run_until_idle()
+        assert probe.calls["serve.prefill"] == 2
+        assert probe.calls["serve.decode"] >= 3
+        assert probe.fired == []
+        assert engine.compiled_counts() == (1, 1)
+
+    def test_run_until_idle_terminates_when_all_deadline_evicted(
+            self, engine):
+        """Every queued request dies at its deadline before admission:
+        the loop must terminate (not spin on a never-draining queue)
+        and every handle must surface the eviction."""
+        hs = [engine.submit(p, max_new_tokens=4, deadline_s=0.0)
+              for p in _prompts([4, 5, 6, 7])]
+        engine.run_until_idle(max_steps=50)
+        assert engine.pending == 0
+        assert all(h.done and h.finish_reason == "deadline" for h in hs)
+        assert all(h.tokens == [] for h in hs)
+        assert engine.pool.free_count == engine.pool.num_slots
+
+    def test_overload_shedding_is_deadline_aware(self, engine):
+        """With measured ticks saying a queue wave is ~5 s, a queued
+        request BEHIND the free-slot window whose deadline cannot span
+        the wait is shed (reason 'shed', before burning a prefill),
+        while a request the engine would prefill this very tick is
+        served even with a sub-tick deadline — shedding never drops a
+        request this tick's admission could still satisfy."""
+        old = engine._tick_ewma
+        engine._tick_ewma = 5.0
+        try:
+            h_keep = engine.submit(_prompts([4])[0], max_new_tokens=2)
+            # position 1 < 3 free slots: prefills this tick, so a
+            # deadline well under tick_ewma must NOT shed it
+            h_tight = engine.submit(_prompts([5])[0], max_new_tokens=2,
+                                    deadline_s=2.0)
+            h_far = engine.submit(_prompts([6])[0], max_new_tokens=2,
+                                  deadline_s=60.0)
+            # position 3 >= 3 free slots: a full ~5 s wave away, its
+            # 100 ms deadline is hopeless
+            h_shed = engine.submit(_prompts([7])[0], max_new_tokens=2,
+                                   deadline_s=0.1)
+            engine.run_until_idle()
+        finally:
+            engine._tick_ewma = old
+        assert h_shed.done and h_shed.finish_reason == "shed"
+        assert h_shed.tokens == []
+        assert h_keep.done and len(h_keep.tokens) == 2
+        assert h_tight.done and len(h_tight.tokens) == 2
+        assert h_far.done and len(h_far.tokens) == 2
+        assert engine.metrics.evicted.get("shed", 0) >= 1
+
+    def test_submit_validates_at_admission(self, engine):
+        """Satellite: an impossible request is rejected with a clear
+        ValueError at the door, never inside the padded prefill
+        program."""
+        with pytest.raises(ValueError, match="prefill_len"):
+            engine.submit(np.arange(11, dtype=np.int32),
+                          max_new_tokens=2)        # prompt > prefill_len
+        with pytest.raises(ValueError, match="max_len"):
+            engine.submit(np.arange(8, dtype=np.int32),
+                          max_new_tokens=40)       # past the arena end
+        assert engine.pending == 0
+
+
+class TestDrainClose:
+    def test_drain_refuses_submits_while_completing_inflight(self,
+                                                             llama):
+        refused = []
+
+        eng = ServeEngine(llama, num_slots=2, max_len=24, prefill_len=10,
+                          backoff_base=0.001)
+
+        def try_submit(tok, handle):
+            if not refused:
+                try:
+                    eng.submit(np.array([1, 2], np.int32),
+                               max_new_tokens=2)
+                except EngineClosed as e:
+                    refused.append(e)
+
+        hs = [eng.submit(p, max_new_tokens=4, on_token=try_submit)
+              for p in _prompts([4, 6, 8])]   # 3 reqs > 2 slots: queued
+        eng.drain()
+        assert refused, "submit during drain was not refused"
+        assert all(h.done and len(h.tokens) == 4 for h in hs)
+        with pytest.raises(EngineClosed, match="draining"):
+            eng.submit(np.array([1], np.int32), max_new_tokens=1)
+        # close releases the arena and is idempotent
+        eng.close()
+        eng.close()
+        assert eng.pool is None
+        with pytest.raises(EngineClosed):
+            eng.submit(np.array([1], np.int32), max_new_tokens=1)
+        with pytest.raises(EngineClosed):
+            eng.step()
+
+
+# ---------------------------------------------------------------------------
+# device.execute site (graph executor; one tiny MLP compile)
+# ---------------------------------------------------------------------------
+
+class TestDeviceExecuteSite:
+    def test_error_and_nan_on_compiled_step(self):
+        from singa_tpu import opt
+        np.random.seed(0)
+        tensor.set_seed(0)
+        m = models.MLP(perceptron_size=(8,), num_classes=4)
+        m.set_optimizer(opt.Adam(lr=1e-2))
+        x = np.random.RandomState(5).randn(8, 4).astype(np.float32)
+        y = np.random.RandomState(6).randint(0, 4, 8).astype(np.int32)
+        xb, yb = tensor.from_numpy(x), tensor.from_numpy(y)
+        m.compile([xb], is_train=True, use_graph=True)
+        m.train_step(xb, yb)            # warm compile, no plan
+        plan = FaultPlan([
+            FaultSpec("device.execute", "error", at=1),
+            FaultSpec("device.execute", "nan", at=2),
+        ])
+        with faults.active(plan):
+            with pytest.raises(InjectedFault, match="device.execute"):
+                m.train_step(xb, yb)
+            _, loss = m.train_step(xb, yb)   # call 2: clean dispatch,
+            assert np.isnan(float(loss.data))  # NaN-corrupted outputs
+
+
+# ---------------------------------------------------------------------------
+# slow chaos: hang detection + heartbeat-driven recovery
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestHangRecoverySlow:
+    def test_decode_exhaustion_triggers_rebuild(self, engine, baseline):
+        """Decode failing past its retry budget escalates to an arena
+        rebuild + re-prefill; the streams stay bitwise-identical."""
+        plan = FaultPlan([FaultSpec("serve.decode", "error",
+                                    every=1, times=4)])
+        with faults.active(plan):
+            hs = [engine.submit(p, max_new_tokens=6)
+                  for p in _prompts([4, 6, 8])]
+            engine.run_until_idle()
+        assert [h.tokens for h in hs] == baseline
+        assert engine.metrics.recoveries >= 1
+        assert engine.compiled_counts() == (1, 1)
+
+    def test_heartbeat_hang_drives_recovery(self, llama, engine,
+                                            baseline):
+        """An injected decode hang outlasting the Heartbeat timeout is
+        detected on the monitor thread, recovery runs at the next step
+        boundary, and the greedy streams are unchanged."""
+        eng = ServeEngine(llama, num_slots=3, max_len=24, prefill_len=10,
+                          backoff_base=0.001,
+                          heartbeat_timeout_s=0.15,
+                          recover_on_hang=True)
+        plan = FaultPlan([FaultSpec("serve.decode", "hang", at=2,
+                                    delay_s=0.6)])
+        with faults.active(plan):
+            hs = [eng.submit(p, max_new_tokens=6)
+                  for p in _prompts([4, 6, 8])]
+            eng.run_until_idle()
+        assert [h.tokens for h in hs] == baseline
+        assert eng.metrics.recoveries == 1
+
+    def test_hang_without_recovery_calls_on_failure(self, llama):
+        """recover_on_hang=False keeps the PR-2 abort contract: the
+        user's on_failure observes the hang."""
+        fired = []
+        eng = ServeEngine(llama, num_slots=2, max_len=24, prefill_len=10,
+                          heartbeat_timeout_s=0.15,
+                          on_failure=lambda age, step: fired.append(age))
+        plan = FaultPlan([FaultSpec("serve.prefill", "hang", at=1,
+                                    delay_s=0.6)])
+        with faults.active(plan):
+            h = eng.submit(_prompts([4])[0], max_new_tokens=2)
+            eng.run_until_idle()
+        assert fired and fired[0] >= 0.15
+        assert h.done            # the sleep returned; decode completed

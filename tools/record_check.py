@@ -23,7 +23,12 @@ consumer crashed with a raw KeyError.  This lint validates, at CI time
                                  entries (numeric steps, wall_s,
                                  ckpt_count, resumed_from) — a run that
                                  aborted mid-write can never masquerade
-                                 as a complete record.
+                                 as a complete record — and ``incident``
+                                 entries (fired faults / recoveries from
+                                 singa_tpu.faults + the serve engine's
+                                 resilience paths: site, fault,
+                                 outcome, step/request ref, numeric
+                                 retry count).
 
 Exit code 0 = all records valid; 1 = named errors printed, one per
 line, each naming the file and the missing/invalid field.
