@@ -128,7 +128,7 @@ def get_sink() -> Optional[JsonlSink]:
 def _emit(kind: str, name: str, attrs: Dict[str, Any]) -> None:
     if _sink is None:
         return
-    ev = {"t": time.time(), "kind": kind, "name": name}
+    ev = {"t": time.time(), "kind": kind, "name": name}  # singalint: disable=SGL005 event timestamps must correlate across hosts/files; durations use the monotonic clocks in span()
     ev.update(attrs)
     _sink.emit(ev)
 

@@ -58,7 +58,7 @@ def new_entry(kind: str, platform: str, smoke: bool, device: str,
         "platform": platform,
         "smoke": bool(smoke),
         "device": device,
-        "created_at": time.time(),
+        "created_at": time.time(),  # singalint: disable=SGL005 created_at is a cross-host-correlatable timestamp in the durable record, not a duration
     }
     if kind == "session":
         entry["stages"] = stages if stages is not None else {}

@@ -32,6 +32,7 @@ retry path.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 import warnings
 from typing import Any, Callable, Iterable, Optional, Tuple
@@ -127,6 +128,10 @@ class TrainRunner:
         self.on_step = on_step
         self.to_batch = to_batch
         self._sleep = _sleep
+        # _append_record races the heartbeat monitor thread against the
+        # step thread (a hang-abort and a fatal-abort can land together);
+        # the lock makes write-exactly-once true, not just likely
+        self._record_lock = threading.Lock()
         self._record_written = False
         self._resumed_from = -1
         self._prestep_data: Optional[dict] = None
@@ -401,9 +406,12 @@ class TrainRunner:
     # -- durable run record ------------------------------------------------
     def _append_record(self, outcome: str, steps: int,
                        wall_s: float) -> None:
-        if not self.record_store or self._record_written:
+        if not self.record_store:
             return
-        self._record_written = True
+        with self._record_lock:
+            if self._record_written:
+                return
+            self._record_written = True
         try:
             import jax
             platform = jax.default_backend()

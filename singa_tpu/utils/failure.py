@@ -103,7 +103,7 @@ class Heartbeat:
         while not self._stop.wait(self.check_every):
             age = time.monotonic() - self._last
             if age > self.timeout:
-                self._fired = True
+                self._fired = True  # singalint: disable=SGL004 monitor thread is the only writer; start() resets it before the thread exists, readers poll a latch-once bool
                 try:
                     self.on_failure(age, self._last_step)
                 finally:

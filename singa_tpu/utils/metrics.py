@@ -159,7 +159,7 @@ class MetricsLogger:
         self._sink = JsonlSink(path) if path else None
 
     def log(self, **kv) -> None:
-        kv.setdefault("t", time.time())
+        kv.setdefault("t", time.time())  # singalint: disable=SGL005 log-line timestamp correlated with obs events across files, not a duration
         payload = {k: _jsonable(v) for k, v in kv.items()}
         if self._sink:
             self._sink.emit(payload)

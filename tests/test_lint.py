@@ -1,0 +1,583 @@
+"""Tests for tools/lint (singalint) — the AST invariant linter.
+
+Every rule gets a violating and a clean fixture snippet; the suppression
+contract (reason REQUIRED) and the JSON output schema are pinned; and
+the tier-1 gate at the bottom asserts the repo itself is clean, which is
+what makes every invariant self-enforcing for future PRs.
+
+Everything here is pure-AST (no jax, no subprocesses) — the whole file
+must stay well under 5 s.
+"""
+
+import json
+import os
+
+import pytest
+
+from tools.lint import (
+    CODE_SUPPRESSION,
+    RULES,
+    lint_source,
+    render_json,
+    run_paths,
+)
+from tools.lint.__main__ import main as lint_main
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def codes_of(findings):
+    return [f.code for f in findings]
+
+
+def lint(src, code):
+    """Run exactly one rule over a dedented snippet."""
+    import textwrap
+    return lint_source(textwrap.dedent(src), codes=[code])
+
+
+# ---------------------------------------------------------------------------
+# rule catalogue
+# ---------------------------------------------------------------------------
+
+def test_catalogue_covers_the_six_invariants():
+    assert set(RULES) >= {"SGL001", "SGL002", "SGL003", "SGL004",
+                          "SGL005", "SGL006", "SGL007"}
+    for code, cls in RULES.items():
+        assert cls.code == code and cls.name and cls.description
+
+
+# ---------------------------------------------------------------------------
+# SGL001 jit-purity
+# ---------------------------------------------------------------------------
+
+class TestJitPurity:
+    def test_fires_on_plain_import_form(self):
+        # `import singa_tpu.obs.events` canonicalizes at the use site
+        out = lint("""
+            import jax
+            import singa_tpu.obs.events
+
+            @jax.jit
+            def step(x):
+                singa_tpu.obs.events.counter("serve.steps", 1)
+                return x + 1
+        """, "SGL001")
+        assert codes_of(out) == ["SGL001"]
+
+    def test_fires_on_obs_event_inside_jit(self):
+        out = lint("""
+            import jax
+            from singa_tpu.obs import events
+
+            @jax.jit
+            def step(x):
+                events.counter("serve.steps", 1)
+                return x + 1
+        """, "SGL001")
+        assert codes_of(out) == ["SGL001"]
+        assert "events.counter" in out[0].message
+
+    def test_fires_one_helper_level_deep(self):
+        out = lint("""
+            import time
+            import jax
+
+            def helper(x):
+                time.time()
+                return x
+
+            @jax.jit
+            def step(x):
+                return helper(x)
+        """, "SGL001")
+        assert codes_of(out) == ["SGL001"]
+
+    def test_fires_via_partial_jit_and_fault_site(self):
+        out = lint("""
+            from functools import partial
+            import jax
+            from singa_tpu import faults
+
+            @partial(jax.jit, static_argnums=(1,))
+            def step(x, n):
+                faults.fire("train.step")
+                return x
+        """, "SGL001")
+        assert codes_of(out) == ["SGL001"]
+
+    def test_clean_on_local_variable_named_like_a_module(self):
+        # a local dict named `record` is not obs.record
+        out = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                record = {"a": x}
+                return record.get("a")
+        """, "SGL001")
+        assert out == []
+
+    def test_fires_inside_applied_partial_factory(self):
+        out = lint("""
+            from functools import partial
+            import jax
+            from singa_tpu.obs import events
+
+            def _step(x, n):
+                events.counter("serve.steps", 1)
+                return x
+
+            step = partial(jax.jit, static_argnums=(1,))(_step)
+        """, "SGL001")
+        assert codes_of(out) == ["SGL001"]
+
+    def test_clean_when_effects_are_outside_jit(self):
+        out = lint("""
+            import jax
+            from singa_tpu.obs import events
+
+            @jax.jit
+            def step(x):
+                return x + 1
+
+            def run(x):
+                y = step(x)
+                events.counter("serve.steps", 1)
+                return y
+        """, "SGL001")
+        assert out == []
+
+
+# ---------------------------------------------------------------------------
+# SGL002 donation-safety
+# ---------------------------------------------------------------------------
+
+class TestDonationSafety:
+    def test_fires_on_read_after_donate(self):
+        out = lint("""
+            import jax
+
+            def _step(arena, x):
+                return arena + x
+
+            step = jax.jit(_step, donate_argnums=(0,))
+
+            def run(arena, x):
+                out = step(arena, x)
+                return arena.sum()
+        """, "SGL002")
+        assert codes_of(out) == ["SGL002"]
+        assert "'arena'" in out[0].message
+
+    def test_clean_when_result_is_used(self):
+        out = lint("""
+            import jax
+
+            def _step(arena, x):
+                return arena + x
+
+            step = jax.jit(_step, donate_argnums=(0,))
+
+            def run(arena, x):
+                arena = step(arena, x)
+                return arena.sum()
+        """, "SGL002")
+        assert out == []
+
+    def test_rebinding_resurrects_the_name(self):
+        out = lint("""
+            import jax
+
+            step = jax.jit(lambda a: a, donate_argnums=(0,))
+
+            def run(arena, make):
+                step(arena)
+                arena = make()
+                return arena.sum()
+        """, "SGL002")
+        assert out == []
+
+
+# ---------------------------------------------------------------------------
+# SGL003 recompile-hazard
+# ---------------------------------------------------------------------------
+
+class TestRecompileHazard:
+    def test_fires_on_jit_in_loop(self):
+        out = lint("""
+            import jax
+
+            def bench(xs):
+                outs = []
+                for x in xs:
+                    f = jax.jit(lambda a: a + 1)
+                    outs.append(f(x))
+                return outs
+        """, "SGL003")
+        assert codes_of(out) == ["SGL003"]
+
+    def test_fires_on_partial_jit_in_loop(self):
+        out = lint("""
+            from functools import partial
+            import jax
+
+            def bench(xs, fn):
+                outs = []
+                for x in xs:
+                    f = partial(jax.jit, static_argnums=(1,))(fn)
+                    outs.append(f(x, 1))
+                return outs
+        """, "SGL003")
+        assert codes_of(out) == ["SGL003"]
+
+    def test_fires_on_shape_branch_inside_jit(self):
+        out = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x.shape[0] > 2:
+                    return x * 2
+                return x
+        """, "SGL003")
+        assert codes_of(out) == ["SGL003"]
+
+    def test_clean_hoisted_jit_and_outside_shape_branch(self):
+        out = lint("""
+            import jax
+
+            f = jax.jit(lambda a: a + 1)
+
+            def bench(xs):
+                return [f(x) for x in xs]
+
+            def dispatch(x):
+                if x.shape[0] > 2:
+                    return f(x)
+                return x
+        """, "SGL003")
+        assert out == []
+
+
+# ---------------------------------------------------------------------------
+# SGL004 thread-seam
+# ---------------------------------------------------------------------------
+
+class TestThreadSeam:
+    def test_fires_on_unguarded_write_from_thread_target(self):
+        out = lint("""
+            import threading
+
+            class Worker:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def _run(self):
+                    self.count = 1
+        """, "SGL004")
+        assert codes_of(out) == ["SGL004"]
+        assert "self.count" in out[0].message
+
+    def test_fires_one_call_level_deep_via_submit(self):
+        out = lint("""
+            class Writer:
+                def save(self):
+                    self._pending = self._executor.submit(self._write)
+
+                def _write(self):
+                    self._commit()
+
+                def _commit(self):
+                    self.committed = True
+        """, "SGL004")
+        assert codes_of(out) == ["SGL004"]
+
+    def test_bare_annotation_is_not_a_write(self):
+        out = lint("""
+            import threading
+
+            class Worker:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def _run(self):
+                    self.buf: list
+        """, "SGL004")
+        assert out == []
+
+    def test_clean_when_lock_guarded(self):
+        out = lint("""
+            import threading
+
+            class Worker:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def _run(self):
+                    with self._lock:
+                        self.count = 1
+        """, "SGL004")
+        assert out == []
+
+    def test_clock_is_not_a_lock(self):
+        # 'clock' contains 'lock' but is not a guard
+        out = lint("""
+            import threading
+
+            class Worker:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def _run(self):
+                    with self._clock:
+                        self.count = 1
+        """, "SGL004")
+        assert codes_of(out) == ["SGL004"]
+
+    def test_fires_on_heartbeat_callback(self):
+        out = lint("""
+            from singa_tpu.utils.failure import Heartbeat
+
+            class Runner:
+                def run(self):
+                    self.hb = Heartbeat(timeout=5.0,
+                                        on_failure=self._on_hang)
+
+                def _on_hang(self, age, step):
+                    self.hung = True
+        """, "SGL004")
+        assert codes_of(out) == ["SGL004"]
+
+
+# ---------------------------------------------------------------------------
+# SGL005 wall-clock
+# ---------------------------------------------------------------------------
+
+class TestWallClock:
+    def test_fires_on_time_time(self):
+        out = lint("""
+            import time
+
+            def age(t0):
+                return time.time() - t0
+        """, "SGL005")
+        assert codes_of(out) == ["SGL005"]
+
+    def test_clean_on_monotonic_and_perf_counter(self):
+        out = lint("""
+            import time
+
+            def age(t0):
+                return time.monotonic() - t0
+
+            def cost(t0):
+                return time.perf_counter() - t0
+        """, "SGL005")
+        assert out == []
+
+
+# ---------------------------------------------------------------------------
+# SGL006 obs-kind / SGL007 fault-site (registry-backed)
+# ---------------------------------------------------------------------------
+
+class TestRegistryRules:
+    def test_unknown_record_kind_fires(self):
+        out = lint("""
+            from singa_tpu.obs import record
+
+            entry = record.new_entry("bogus_kind", "cpu", True, "cpu")
+        """, "SGL006")
+        assert codes_of(out) == ["SGL006"]
+        assert "bogus_kind" in out[0].message
+
+    def test_registered_record_kind_is_clean(self):
+        out = lint("""
+            from singa_tpu.obs import record
+
+            entry = record.new_entry("bench", "cpu", True, "cpu")
+        """, "SGL006")
+        assert out == []
+
+    def test_unknown_fault_site_fires(self):
+        out = lint("""
+            from singa_tpu import faults
+
+            faults.fire("no.such.site")
+        """, "SGL007")
+        assert codes_of(out) == ["SGL007"]
+        assert "no.such.site" in out[0].message
+
+    def test_registered_fault_site_is_clean(self):
+        out = lint("""
+            from singa_tpu import faults
+
+            faults.fire("ckpt.write", step=1)
+        """, "SGL007")
+        assert out == []
+
+    def test_keyword_form_is_checked_too(self):
+        out = lint("""
+            from singa_tpu import faults
+
+            faults.fire(site="no.such.site")
+        """, "SGL007")
+        assert codes_of(out) == ["SGL007"]
+
+    def test_unloadable_registry_is_a_finding_not_a_pass(self, tmp_path,
+                                                         monkeypatch):
+        """A renamed/broken schema.py must fail the gate, not silently
+        disable SGL006/SGL007."""
+        from tools.lint import rules
+        monkeypatch.setattr(rules, "_REPO_ROOT", str(tmp_path))
+        monkeypatch.setattr(rules, "_KINDS_CACHE", {})
+        monkeypatch.setattr(rules, "_SITES_CACHE", {})
+        out = lint("""
+            from singa_tpu.obs import record
+            from singa_tpu import faults
+
+            entry = record.new_entry("bench", "cpu", True, "cpu")
+            faults.fire("ckpt.write")
+        """, "SGL006")
+        assert codes_of(out) == ["SGL006"]
+        assert "could not be loaded" in out[0].message
+        out = lint("""
+            from singa_tpu import faults
+
+            faults.fire("ckpt.write")
+        """, "SGL007")
+        assert codes_of(out) == ["SGL007"]
+        assert "could not be loaded" in out[0].message
+
+
+# ---------------------------------------------------------------------------
+# suppression contract
+# ---------------------------------------------------------------------------
+
+class TestSuppressions:
+    def test_suppression_with_reason_is_honored(self):
+        out = lint_source(
+            "import time\n"
+            "t = time.time()  # singalint: disable=SGL005 epoch "
+            "timestamp for cross-host correlation\n")
+        assert out == []
+
+    def test_suppression_without_reason_is_a_finding(self):
+        out = lint_source(
+            "import time\n"
+            "t = time.time()  # singalint: disable=SGL005\n")
+        assert CODE_SUPPRESSION in codes_of(out)
+
+    def test_suppression_of_unknown_code_is_a_finding(self):
+        out = lint_source("x = 1  # singalint: disable=SGL942 because\n")
+        assert codes_of(out) == [CODE_SUPPRESSION]
+        assert "SGL942" in out[0].message
+
+    def test_suppression_only_covers_its_own_line(self):
+        out = lint_source(
+            "import time\n"
+            "a = time.time()  # singalint: disable=SGL005 fine here\n"
+            "b = time.time()\n")
+        assert codes_of(out) == ["SGL005"]
+        assert out[0].line == 3
+
+    def test_suppression_inside_string_literal_is_ignored(self):
+        out = lint_source(
+            'doc = "# singalint: disable=SGL005"\n'
+            "import time\n"
+            "t = time.time()\n")
+        assert codes_of(out) == ["SGL005"]
+
+
+# ---------------------------------------------------------------------------
+# output formats + CLI
+# ---------------------------------------------------------------------------
+
+class TestOutputAndCli:
+    def test_json_output_schema(self):
+        findings = lint_source("import time\nt = time.time()\n",
+                               path="x.py")
+        doc = json.loads(render_json(findings))
+        assert doc["version"] == 1
+        assert doc["count"] == len(findings) == 1
+        f = doc["findings"][0]
+        assert set(f) == {"path", "line", "col", "code", "message"}
+        assert f["path"] == "x.py" and f["code"] == "SGL005"
+
+    def test_syntax_error_is_a_finding_not_a_crash(self):
+        out = lint_source("def broken(:\n")
+        assert codes_of(out) == ["SGL999"]
+
+    def test_cli_exit_codes_and_select(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import time\nt = time.time()\n")
+        clean = tmp_path / "clean.py"
+        clean.write_text("import time\nt = time.monotonic()\n")
+        assert lint_main([str(clean)]) == 0
+        assert lint_main([str(bad)]) == 1
+        assert lint_main(["--select", "SGL001", str(bad)]) == 0
+        out = capsys.readouterr().out
+        assert "singalint: clean" in out
+        with pytest.raises(SystemExit):
+            lint_main(["--select", "SGL942", str(bad)])
+
+    def test_cli_rejects_paths_matching_no_files(self, tmp_path):
+        # a typo'd/renamed dir expanding to zero files must not exit 0
+        with pytest.raises(SystemExit):
+            lint_main([str(tmp_path / "no_such_dir")])
+        with pytest.raises(SystemExit):
+            lint_main([str(tmp_path)])  # exists, but has no .py files
+        # the API behind the repo-is-clean gate refuses too
+        with pytest.raises(ValueError):
+            run_paths([str(tmp_path / "no_such_dir")])
+
+    def test_cli_audit_modes_reject_lint_paths(self):
+        # silently dropping the paths would be a false-clean signal
+        with pytest.raises(SystemExit):
+            lint_main(["singa_tpu", "--records"])
+        with pytest.raises(SystemExit):
+            lint_main(["singa_tpu", "--ckpt", "somedir"])
+        with pytest.raises(SystemExit):
+            lint_main(["--records", "--ckpt", "somedir"])
+
+    def test_cli_records_root_resolution(self, monkeypatch):
+        """Bare --records means repo root; an explicit '.' means cwd
+        (audit.records_main is stubbed — it imports jax)."""
+        from tools.lint import __main__ as cli
+        seen = []
+        monkeypatch.setattr(cli.audit, "records_main",
+                            lambda root: seen.append(root) or 0)
+        assert lint_main(["--records"]) == 0
+        assert lint_main(["--records", "."]) == 0
+        assert seen == [cli.audit._REPO_ROOT, "."]
+
+    def test_cli_list_rules(self, capsys):
+        assert lint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for code in RULES:
+            assert code in out
+
+    def test_cli_json(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import time\nt = time.time()\n")
+        assert lint_main(["--json", str(bad)]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the repo itself is clean
+# ---------------------------------------------------------------------------
+
+def test_repo_is_clean():
+    """`python -m tools.lint singa_tpu tools` exits 0 on this tree —
+    every invariant the rules encode is self-enforcing from here on.
+    A finding here means: fix the violation, or suppress it inline WITH
+    A REASON (see docs/static-analysis.md for the policy)."""
+    findings = run_paths([os.path.join(REPO, "singa_tpu"),
+                          os.path.join(REPO, "tools")])
+    assert findings == [], "\n".join(f.render() for f in findings)

@@ -413,6 +413,10 @@ class TestPjrtTouchpoint:
     Client creation is NOT exercised here — it can hang over a wedged
     tunneled backend (docs/native_tpu_device.md)."""
 
+    @pytest.mark.slow  # 463s of the 870s tier-1 budget on a chipless
+    # box: libtpu is present but has no device, so plugin init grinds
+    # through its retry schedule before the handshake returns.  Runs in
+    # the slow lane; tier-1 keeps the two fast negative-path tests below.
     def test_plugin_handshake_against_libtpu(self):
         from singa_tpu import device as device_mod
         if _core.lib() is None:

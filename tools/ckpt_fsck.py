@@ -1,103 +1,35 @@
-"""Audit a checkpoint directory against the commit-marker contract.
+"""Thin shim: checkpoint fsck now lives in ``tools.lint``.
 
-What ``singa_tpu.train.AsyncCheckpointManager`` guarantees on disk —
-and what this tool verifies after a crash, a copy, or bit rot:
-
-  * every ``ckpt_<step>.npz.commit`` marker names an existing npz whose
-    size and sha256 match the marker          (mismatch → ERROR: torn);
-  * every committed npz decodes, its embedded array manifest matches
-    its members, and its optimizer-moment count matches its slot
-    manifest (``utils.checkpoint.load_arrays`` enforces all three)
-                                              (failure → ERROR);
-  * an npz without a marker is an uncommitted write — never loadable,
-    expected after a crash between write and commit (→ warning);
-  * stray ``*.tmp`` files are interrupted writes (→ warning).
+``python -m tools.lint --ckpt DIR [DIR ...]`` is the front door; this
+file keeps the historical CLI (``python tools/ckpt_fsck.py <dir>``) and
+the ``fsck_dir`` API working for existing callers (tests import it
+in-process).  See ``tools/lint/audit.py`` for the commit-marker
+contract being verified and ``docs/static-analysis.md`` for the audit
+catalogue.
 
 Exit code 0 = every committed checkpoint is intact (warnings allowed);
-1 = at least one ERROR, printed one per line naming file and cause.
-
-Usage: python tools/ckpt_fsck.py <checkpoint-dir> [<dir> ...]
+1 = at least one ERROR, printed one per line naming file and cause;
+2 = usage error.
 """
 from __future__ import annotations
 
-import glob
 import os
 import sys
-from typing import List, Tuple
+from typing import List
 
 ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 sys.path.insert(0, ROOT)
 
-from singa_tpu.train import ckpt as train_ckpt  # noqa: E402
-from singa_tpu.utils import checkpoint  # noqa: E402
+from tools.lint import audit  # noqa: E402
 
-
-def fsck_dir(directory: str) -> Tuple[List[str], List[str]]:
-    """Returns (errors, warnings) for one checkpoint directory.
-
-    The checks ARE the loader's checks — ``AsyncCheckpointManager.
-    verify`` for the marker/size/sha contract and ``utils.checkpoint``'s
-    decode + manifest enforcement — so the auditor and the restore path
-    can never disagree about what "intact" means."""
-    errors: List[str] = []
-    warns: List[str] = []
-    if not os.path.isdir(directory):
-        return [f"{directory}: not a directory"], []
-    for tmp in glob.glob(os.path.join(directory, "*.tmp")):
-        warns.append(f"{tmp}: stray temp file (interrupted write)")
-
-    mgr = train_ckpt.AsyncCheckpointManager(directory)
-    steps = mgr.steps()
-    committed = {mgr.path(s) for s in steps}
-    for marker in glob.glob(os.path.join(directory, "ckpt_*.npz"
-                                         + train_ckpt.COMMIT_SUFFIX)):
-        path = marker[:-len(train_ckpt.COMMIT_SUFFIX)]
-        if path not in committed:
-            # steps() couldn't parse the name, so restore can't see it
-            errors.append(f"{marker}: unparsable marker name (invisible "
-                          f"to restore)")
-            committed.add(path)
-
-    for step in steps:
-        path = mgr.path(step)
-        try:
-            mgr.verify(step)
-        except train_ckpt.CheckpointCorrupt as e:
-            errors.append(str(e))
-            continue
-        # committed and byte-intact: the payload must also decode and
-        # self-agree (array manifest vs members, opt moments vs slots)
-        try:
-            arrays, aux = checkpoint.load_arrays(path)
-            checkpoint.check_opt_manifest(arrays, aux)
-        except Exception as e:
-            errors.append(f"{path}: committed but undecodable "
-                          f"({type(e).__name__}: {e})")
-
-    npzs = set(glob.glob(os.path.join(directory, "ckpt_*.npz")))
-    for path in sorted(npzs - committed):
-        warns.append(f"{path}: no commit marker (uncommitted — ignored "
-                     f"at load)")
-    return errors, warns
+fsck_dir = audit.fsck_ckpt_dir
 
 
 def main(argv: List[str]) -> int:
     if len(argv) < 2:
         print(__doc__, file=sys.stderr)
         return 2
-    all_errors: List[str] = []
-    for d in argv[1:]:
-        errors, warns = fsck_dir(os.path.abspath(d))
-        for w in warns:
-            print(f"ckpt_fsck: warning: {w}", file=sys.stderr)
-        all_errors.extend(errors)
-    if all_errors:
-        for e in all_errors:
-            print(f"ckpt_fsck: {e}", file=sys.stderr)
-        print(f"ckpt_fsck: {len(all_errors)} error(s)", file=sys.stderr)
-        return 1
-    print("ckpt_fsck: all committed checkpoints intact")
-    return 0
+    return audit.ckpt_main(argv[1:])
 
 
 if __name__ == "__main__":

@@ -50,11 +50,11 @@ def main():
             m.set_optimizer(opt.SGD(lr=0.01, momentum=0.9))
             ids = tensor.from_numpy(np.random.randint(
                 0, cfg.vocab_size, (B, T)).astype(np.int32))
-            t0 = time.time()
+            t0 = time.perf_counter()
             m.compile([ids], is_train=True, use_graph=True)
             out = m.train_step(ids)
             np.asarray(out[-1].data)
-            t_compile = time.time() - t0
+            t_compile = time.perf_counter() - t0
 
             holder = {}
 

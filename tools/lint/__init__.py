@@ -1,0 +1,25 @@
+"""singalint — project-specific static analysis for singa_tpu.
+
+Public API re-exported here so tests and tools can do::
+
+    from tools.lint import lint_source, run_paths, RULES
+
+CLI front door (``python -m tools.lint``) lives in ``__main__``; the
+AST rules in ``rules``; the dynamic audits (record store, checkpoint
+dirs) in ``audit``.
+"""
+
+from .framework import (  # noqa: F401
+    CODE_SUPPRESSION,
+    Finding,
+    Rule,
+    RULES,
+    iter_python_files,
+    lint_file,
+    lint_source,
+    register,
+    render_human,
+    render_json,
+    run_paths,
+)
+from . import rules  # noqa: F401  (importing registers every rule)

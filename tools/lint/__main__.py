@@ -1,0 +1,103 @@
+"""``python -m tools.lint`` — the one audit front door.
+
+Static (default)::
+
+    python -m tools.lint singa_tpu tools          # lint trees/files
+    python -m tools.lint --json singa_tpu         # machine-readable
+    python -m tools.lint --select SGL005 singa_tpu
+    python -m tools.lint --list-rules
+
+Dynamic audits (same checks the old standalone CLIs ran)::
+
+    python -m tools.lint --records [ROOT]         # telemetry records
+    python -m tools.lint --ckpt DIR [DIR ...]     # checkpoint fsck
+
+Exit codes: 0 clean, 1 findings/errors, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from . import RULES, render_human, render_json, run_paths
+from . import audit
+
+#: ``--records`` with no value means "the repo root" — a sentinel the
+#: user cannot type, so an explicit ``--records .`` still means cwd
+_RECORDS_DEFAULT = "\0repo-root"
+
+
+def _list_rules() -> str:
+    lines = ["singalint rules:"]
+    for code, cls in RULES.items():
+        lines.append(f"  {code}  {cls.name:<17} {cls.description}")
+    lines.append("  SGL000 suppression-hygiene  a '# singalint: "
+                 "disable=CODE' without a reason, or naming an unknown "
+                 "code, is itself a finding and cannot be suppressed")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="singalint: AST invariant linter + dynamic audits")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories to lint (static rules)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit findings as JSON")
+    parser.add_argument("--select", metavar="CODES",
+                        help="comma-separated rule codes to run "
+                             "(default: all)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalogue and exit")
+    parser.add_argument("--records", nargs="?", const=_RECORDS_DEFAULT,
+                        metavar="ROOT", default=None,
+                        help="validate telemetry records under ROOT "
+                             "(default: repo root) instead of linting")
+    parser.add_argument("--ckpt", nargs="+", metavar="DIR", default=None,
+                        help="fsck checkpoint directories instead of "
+                             "linting")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+    if args.records is not None and args.ckpt is not None:
+        parser.error("--records and --ckpt are separate audit modes")
+    if (args.records is not None or args.ckpt is not None) and args.paths:
+        parser.error("audit modes take no lint paths — run the static "
+                     "lint as a separate invocation")
+    if args.records is not None:
+        root = (audit._REPO_ROOT if args.records == _RECORDS_DEFAULT
+                else args.records)
+        return audit.records_main(root)
+    if args.ckpt is not None:
+        return audit.ckpt_main(args.ckpt)
+
+    if not args.paths:
+        parser.error("no paths given (or use --list-rules / --records / "
+                     "--ckpt)")
+    codes = None
+    if args.select:
+        codes = [c.strip() for c in args.select.split(",") if c.strip()]
+        unknown = [c for c in codes if c not in RULES]
+        if unknown:
+            parser.error(f"unknown rule code(s): {', '.join(unknown)} "
+                         f"(see --list-rules)")
+    try:
+        findings = run_paths(args.paths, codes)
+    except ValueError as e:
+        # a typo'd or renamed path must not read as "clean"
+        parser.error(str(e))
+    print(render_json(findings) if args.json else render_human(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    # die silently when the consumer closes the pipe (… | head)
+    import signal
+    if hasattr(signal, "SIGPIPE"):
+        signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    raise SystemExit(main())

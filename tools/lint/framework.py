@@ -1,0 +1,207 @@
+"""singalint core: rule registry, findings, suppressions, file runner.
+
+The linter is the static half of this repo's invariant enforcement: the
+conventions PRs 1-4 established (host-side-only obs/fault seams, donated
+arenas, monotonic clocks, schema'd record kinds, lock-guarded thread
+seams) each get an AST rule with a stable ``SGL0xx`` code, and a tier-1
+test asserts the tree is clean — so the next PR cannot silently violate
+them the way only a hand-written regression test used to prevent.
+
+Suppression contract: a finding may be silenced inline with
+
+    some_code()   # singalint: disable=SGL005 reason why this is sound
+
+The reason is REQUIRED — a bare ``disable=SGL005`` is itself a finding
+(SGL000), because an unexplained suppression is exactly the silent
+convention-drift the linter exists to stop.  Multiple codes:
+``disable=SGL001,SGL005 reason...``.  A suppression silences findings
+on its own line only.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Tuple, Type
+
+__all__ = ["Finding", "Rule", "RULES", "register", "lint_source",
+           "lint_file", "iter_python_files", "run_paths", "render_human",
+           "render_json", "SUPPRESS_RE", "CODE_SUPPRESSION"]
+
+#: the hygiene pseudo-rule: malformed suppressions (missing reason,
+#: unknown code) are findings under this code and cannot themselves be
+#: suppressed
+CODE_SUPPRESSION = "SGL000"
+
+SUPPRESS_RE = re.compile(
+    r"#\s*singalint:\s*disable=([A-Za-z0-9_,]+)[ \t]*(.*?)\s*$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a file position."""
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} " \
+               f"{self.message}"
+
+    def to_json(self) -> Dict:
+        return {"path": self.path, "line": self.line, "col": self.col,
+                "code": self.code, "message": self.message}
+
+
+class Rule:
+    """Base class: subclasses set ``code``/``name``/``description`` and
+    implement :meth:`check` over one parsed module."""
+
+    code: str = ""
+    name: str = ""
+    description: str = ""
+
+    def check(self, tree: ast.Module, src: str,
+              path: str) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, path: str, node: ast.AST, message: str) -> Finding:
+        return Finding(path, getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0), self.code, message)
+
+
+#: code -> rule class, in registration order
+RULES: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    if not cls.code or cls.code in RULES:
+        raise ValueError(f"rule {cls.__name__} has a missing or duplicate "
+                         f"code {cls.code!r}")
+    RULES[cls.code] = cls
+    return cls
+
+
+def _suppressions(src: str, path: str) -> Tuple[Dict[int, set], List[Finding]]:
+    """Per-line suppressed code sets, plus hygiene findings for
+    suppressions that are malformed (no reason / unknown code).
+
+    Comments are found with tokenize so a ``# singalint:`` inside a
+    string literal is never treated as a suppression."""
+    import io
+    lines: Dict[int, set] = {}
+    bad: List[Finding] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return lines, bad
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        lineno = tok.start[0]
+        codes = [c.strip() for c in m.group(1).split(",") if c.strip()]
+        reason = m.group(2).strip()
+        if not reason:
+            bad.append(Finding(
+                path, lineno, tok.start[1], CODE_SUPPRESSION,
+                f"suppression of {','.join(codes)} carries no reason — "
+                f"write '# singalint: disable={','.join(codes)} <why this "
+                f"is sound>'"))
+            continue
+        for code in codes:
+            if code == CODE_SUPPRESSION or code not in RULES:
+                bad.append(Finding(
+                    path, lineno, tok.start[1], CODE_SUPPRESSION,
+                    f"suppression names unknown rule code {code!r} "
+                    f"(known: {', '.join(sorted(RULES))})"))
+                continue
+            lines.setdefault(lineno, set()).add(code)
+    return lines, bad
+
+
+def lint_source(src: str, path: str = "<string>",
+                codes: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run every registered rule (or just ``codes``) over one source
+    text; returns findings with suppressions already applied."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 1, e.offset or 0, "SGL999",
+                        f"syntax error: {e.msg}")]
+    suppressed, findings = _suppressions(src, path)
+    wanted = set(codes) if codes is not None else set(RULES)
+    for code, cls in RULES.items():
+        if code not in wanted:
+            continue
+        for f in cls().check(tree, src, path):
+            if f.code in suppressed.get(f.line, ()):
+                continue
+            findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.code))
+
+
+def lint_file(path: str,
+              codes: Optional[Iterable[str]] = None) -> List[Finding]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    except (OSError, UnicodeDecodeError) as e:
+        return [Finding(path, 1, 0, "SGL999", f"unreadable: {e}")]
+    return lint_source(src, path, codes)
+
+
+def iter_python_files(paths: Iterable[str]) -> List[str]:
+    """Expand files/directories into a sorted list of ``.py`` files."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+        elif p.endswith(".py"):
+            out.append(p)
+    return sorted(dict.fromkeys(out))
+
+
+def run_paths(paths: Iterable[str],
+              codes: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Lint every Python file under ``paths``.
+
+    A path that expands to zero Python files raises ``ValueError``
+    rather than contributing nothing: the repo-is-clean gate calls this
+    directly, and a renamed tree must fail the gate, not pass it."""
+    files: List[str] = []
+    for p in paths:
+        matched = iter_python_files([p])
+        if not matched:
+            raise ValueError(f"path {p!r} matches no Python files")
+        files.extend(matched)
+    findings: List[Finding] = []
+    for path in dict.fromkeys(files):
+        findings.extend(lint_file(path, codes))
+    return findings
+
+
+def render_human(findings: List[Finding]) -> str:
+    lines = [f.render() for f in findings]
+    lines.append(f"singalint: {len(findings)} finding(s)" if findings
+                 else "singalint: clean")
+    return "\n".join(lines)
+
+
+def render_json(findings: List[Finding]) -> str:
+    return json.dumps(
+        {"version": 1, "count": len(findings),
+         "findings": [f.to_json() for f in findings]},
+        indent=2, sort_keys=True)

@@ -1,102 +1,30 @@
-"""Lint every committed telemetry record against the obs schema.
+"""Thin shim: telemetry-record linting now lives in ``tools.lint``.
 
-The round-5 failure mode this kills: a stale/truncated/clobbered record
-sat in the tree for a whole round and was only discovered when a
-consumer crashed with a raw KeyError.  This lint validates, at CI time
-(tests/test_obs.py runs it as a tier-1 test):
-
-  * ``tpu_session*.json``      — session records (v1 entries validated
-                                 strictly; legacy pre-schema docs
-                                 structurally);
-  * ``BENCH_r*.json``          — driver bench records (metadata + a
-                                 numeric parsed headline);
-  * ``MULTICHIP_r*.json``      — driver multichip smoke records;
-  * ``runs/records.jsonl``     — the RunRecord store (every line
-                                 strictly valid, no duplicate keys).
-                                 Covers every store kind: ``session``,
-                                 ``bench``, the serving engine's
-                                 ``serve_throughput`` entries (full
-                                 numeric headline: tokens_per_s,
-                                 speedup_vs_sequential, ttft_p50_ms,
-                                 ttft_p99_ms, requests) AND the
-                                 training orchestrator's ``train_run``
-                                 entries (numeric steps, wall_s,
-                                 ckpt_count, resumed_from) — a run that
-                                 aborted mid-write can never masquerade
-                                 as a complete record — and ``incident``
-                                 entries (fired faults / recoveries from
-                                 singa_tpu.faults + the serve engine's
-                                 resilience paths: site, fault,
-                                 outcome, step/request ref, numeric
-                                 retry count).
+``python -m tools.lint --records [ROOT]`` is the front door; this file
+keeps the historical CLI (``python tools/record_check.py [root]``) and
+the ``check_root`` API working for existing callers (tests import it
+in-process).  See ``tools/lint/audit.py`` for what is checked and
+``docs/static-analysis.md`` for the audit catalogue.
 
 Exit code 0 = all records valid; 1 = named errors printed, one per
 line, each naming the file and the missing/invalid field.
-
-Usage: python tools/record_check.py [root-dir]
 """
 from __future__ import annotations
 
-import glob
-import json
 import os
 import sys
 
 ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 sys.path.insert(0, ROOT)
 
-from singa_tpu.obs import record as obs_record  # noqa: E402
-from singa_tpu.obs import schema  # noqa: E402
+from tools.lint import audit  # noqa: E402
 
-
-def _load(path: str):
-    try:
-        with open(path) as f:
-            return json.load(f), None
-    except json.JSONDecodeError as e:
-        return None, f"{path}: not valid JSON ({e.msg} at line {e.lineno})"
-    except OSError as e:
-        return None, f"{path}: unreadable ({e})"
-
-
-def check_root(root: str) -> list[str]:
-    errors: list[str] = []
-
-    def run(validator, path):
-        doc, err = _load(path)
-        if err:
-            errors.append(err)
-            return
-        errors.extend(schema.collect_errors(validator, doc, path))
-
-    for path in sorted(glob.glob(os.path.join(root, "tpu_session*.json"))):
-        run(schema.validate_session_doc, path)
-    for path in sorted(glob.glob(os.path.join(root, "*_session.json"))):
-        if os.path.basename(path).startswith("tpu_session"):
-            continue  # already covered by the pattern above
-        run(schema.validate_session_doc, path)
-    for path in sorted(glob.glob(os.path.join(root, "BENCH_*.json"))):
-        run(schema.validate_bench_doc, path)
-    for path in sorted(glob.glob(os.path.join(root, "MULTICHIP_*.json"))):
-        run(schema.validate_multichip_doc, path)
-
-    store = os.path.join(root, obs_record.DEFAULT_STORE)
-    if os.path.exists(store):
-        errors.extend(obs_record.RunRecord(store).validate())
-    return errors
+check_root = audit.check_records_root
 
 
 def main(argv: list[str]) -> int:
-    root = os.path.abspath(argv[1]) if len(argv) > 1 else os.path.abspath(ROOT)
-    errors = check_root(root)
-    if errors:
-        for e in errors:
-            print(f"record_check: {e}", file=sys.stderr)
-        print(f"record_check: {len(errors)} error(s) in {root}",
-              file=sys.stderr)
-        return 1
-    print(f"record_check: all records valid in {root}")
-    return 0
+    root = argv[1] if len(argv) > 1 else ROOT
+    return audit.records_main(root)
 
 
 if __name__ == "__main__":

@@ -39,7 +39,8 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
-_T0 = time.time()
+_T0 = time.monotonic()
+_STARTED_AT = time.time()  # singalint: disable=SGL005 session-start epoch timestamp for the durable record's created_at — must correlate across runs/hosts; budget math uses _T0
 _BUDGET_S = float(os.environ.get("SINGA_TPU_SESSION_BUDGET_S", "4800"))
 # SINGA_TPU_SESSION_SMOKE=1: tiny shapes + CPU pin, to validate the
 # session logic end-to-end without a chip
@@ -63,14 +64,14 @@ _RUN_ID = f"session-{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}"
 
 
 def mark(msg: str) -> None:
-    line = f"[{time.time() - _T0:7.1f}s] {msg}"
+    line = f"[{time.monotonic() - _T0:7.1f}s] {msg}"
     with open(_LOG, "a") as f:
         f.write(line + "\n")
     print(line, flush=True)
 
 
 def left() -> float:
-    return _BUDGET_S - (time.time() - _T0)
+    return _BUDGET_S - (time.monotonic() - _T0)
 
 
 def stage(name: str, need_s: float):
@@ -89,13 +90,13 @@ def stage(name: str, need_s: float):
             # HBM into the next stage — the first r5 run OOM-cascaded)
             import gc
             gc.collect()
-            t0 = time.time()
+            t0 = time.monotonic()
             try:
                 out = fn(*a, **k)
                 _RESULTS["stages"][name] = {"ok": True,
-                                            "s": round(time.time() - t0, 1),
+                                            "s": round(time.monotonic() - t0, 1),
                                             "result": out}
-                mark(f"DONE {name} in {time.time() - t0:.1f}s: {out}")
+                mark(f"DONE {name} in {time.monotonic() - t0:.1f}s: {out}")
                 _finish(final=False)   # persist incrementally: a later
                 # wedged stage must not cost the whole record
                 return out
@@ -315,10 +316,10 @@ def main() -> None:
             batch, seqlen, windows = 2, 64, 2
         m, ids, cfg = llama_model(fused, flash_on, batch, seqlen, cfg_extra,
                                   base=base)
-        t0 = time.time()
+        t0 = time.monotonic()
         m.compile([ids], is_train=train, use_graph=True)
-        t_init = time.time() - t0
-        t0 = time.time()
+        t_init = time.monotonic() - t0
+        t0 = time.monotonic()
         if train:
             out = m.train_step(ids)
             _fetch(out[-1].data)
@@ -326,7 +327,7 @@ def main() -> None:
             m.eval()
             out = m(ids)
             jax.block_until_ready(out.data)
-        t_compile = time.time() - t0
+        t_compile = time.monotonic() - t0
 
         if train:
             holder = {}
@@ -427,11 +428,11 @@ def main() -> None:
         slots = ex.slots
         stepc = jnp.asarray(0, jnp.int32)
         rng = jax.random.PRNGKey(0)
-        t0 = time.time()
+        t0 = time.monotonic()
         losses, params, buffers, slots = jm(params, buffers, slots, stepc,
                                             rng, (ids.data,))
         _fetch(losses)
-        t_compile = time.time() - t0
+        t_compile = time.monotonic() - t0
         ts = []
         for _ in range(3):
             t0 = time.perf_counter()
@@ -643,15 +644,15 @@ def main() -> None:
                 mg.create_causal_mask = orig
         mark(f"gpt2 onnx export: {len(data)/1e6:.0f} MB")
 
-        t0 = time.time()
+        t0 = time.monotonic()
         rep = sonnx.prepare(data)
-        t_import = time.time() - t0
+        t_import = time.monotonic() - t0
         ids_np = ids_t.numpy().astype(np.int32)
-        t0 = time.time()
+        t0 = time.monotonic()
         outs = rep.run([ids_np])
         sx = np.asarray(outs[0] if isinstance(outs, (list, tuple)) else outs,
                         dtype=np.float32)
-        t_fwd = time.time() - t0
+        t_fwd = time.monotonic() - t0
 
         from singa_tpu.models import convert
         native = convert.from_hf_gpt2(hf)
@@ -664,9 +665,9 @@ def main() -> None:
         prompt = np.random.RandomState(0).randint(
             0, vocab, (B, P)).astype(np.int32)
         pdt = None if _SMOKE else jnp.bfloat16
-        t0 = time.time()
+        t0 = time.monotonic()
         native.generate(prompt, max_new_tokens=N, param_dtype=pdt)
-        t_first = time.time() - t0
+        t_first = time.monotonic() - t0
         ts = []
         for _ in range(3):
             t0 = time.perf_counter()
@@ -700,9 +701,9 @@ def main() -> None:
         gm.compile([tensor.from_numpy(prompt)], is_train=False,
                    use_graph=True)
         pdt = None if _SMOKE else jnp.bfloat16   # bf16 weight reads
-        t0 = time.time()
+        t0 = time.monotonic()
         gm.generate(prompt, max_new_tokens=N, param_dtype=pdt)
-        t_first = time.time() - t0
+        t_first = time.monotonic() - t0
         # median-of-3 (ADVICE r4: min was the most flattering statistic)
         ts = []
         for _ in range(3):
@@ -1096,7 +1097,7 @@ def _finish(final: bool = True) -> None:
     doc["platform"] = platform
     doc["smoke"] = _smoke_like()
     doc["device"] = str(_RESULTS.get("device", ""))
-    doc["created_at"] = _T0
+    doc["created_at"] = _STARTED_AT
     tmp = path + f".tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(doc, f, indent=1)

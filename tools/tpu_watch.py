@@ -74,11 +74,11 @@ def _run_session() -> bool:
 
 
 def main() -> None:
-    deadline = time.time() + _DEADLINE_H * 3600
+    deadline = time.monotonic() + _DEADLINE_H * 3600
     attempt = 0
     log(f"watch start: probe every {_PROBE_EVERY_S:.0f}s, "
         f"timeout {_PROBE_TIMEOUT_S:.0f}s, deadline {_DEADLINE_H:.1f}h")
-    while time.time() < deadline:
+    while time.monotonic() < deadline:
         attempt += 1
         try:
             r = subprocess.run(
