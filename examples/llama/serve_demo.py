@@ -25,7 +25,10 @@ def main():
     p = argparse.ArgumentParser(description="continuous-batching demo")
     p.add_argument("--slots", type=int, default=4)
     p.add_argument("--max-len", type=int, default=96)
-    p.add_argument("--prefill-len", type=int, default=24)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--shared-prefix", type=int, default=16,
+                   help="tokens of a shared system prompt prepended "
+                        "to every request (0 = fully private prompts)")
     p.add_argument("--requests", type=int, default=10)
     p.add_argument("--new-tokens", type=int, default=24)
     p.add_argument("--deadline", type=float, default=30.0,
@@ -33,6 +36,10 @@ def main():
     p.add_argument("--obs", default="",
                    help="JSONL telemetry sink path (SINGA_OBS)")
     args = p.parse_args()
+    if args.shared_prefix + 3 + args.new_tokens > args.max_len:
+        p.error(f"--shared-prefix {args.shared_prefix} + a >=3-token "
+                f"private suffix + --new-tokens {args.new_tokens} "
+                f"exceeds --max-len {args.max_len}")
     if args.obs:
         os.environ["SINGA_OBS"] = args.obs
 
@@ -46,11 +53,12 @@ def main():
     m.compile([tensor.from_numpy(np.zeros((1, 4), np.int32))],
               is_train=False, use_graph=False)
 
-    print(f"engine: {args.slots} slots x {args.max_len} positions "
-          f"(prefill_len {args.prefill_len})", flush=True)
+    print(f"engine: {args.slots} block-table rows x {args.max_len} "
+          f"positions, paged in {args.block_size}-token blocks",
+          flush=True)
     t0 = time.time()
     eng = serve.ServeEngine(m, args.slots, args.max_len,
-                            prefill_len=args.prefill_len,
+                            block_size=args.block_size,
                             heartbeat_timeout_s=120.0)
     # warm the two compiled programs before the traffic
     eng.submit(np.zeros(4, np.int32), max_new_tokens=2)
@@ -59,11 +67,18 @@ def main():
           flush=True)
 
     rng = np.random.RandomState(42)
-    lens = rng.randint(3, args.prefill_len + 1, size=args.requests)
+    max_private = args.max_len - args.new_tokens - args.shared_prefix
+    lens = rng.randint(3, max(4, min(max_private + 1,
+                                     max_private // 2 + 2)),
+                       size=args.requests)
+    shared = rng.randint(0, cfg.vocab_size,
+                         (args.shared_prefix,)).astype(np.int32)
     handles = []
     t0 = time.time()
     for i, plen in enumerate(lens):
-        prompt = rng.randint(0, cfg.vocab_size, (plen,)).astype(np.int32)
+        prompt = np.concatenate([
+            shared,
+            rng.randint(0, cfg.vocab_size, (plen,)).astype(np.int32)])
 
         def stream(tok, h, i=i):
             if len(h.tokens) == 1:
@@ -94,6 +109,9 @@ def main():
     snap = eng.metrics.snapshot()
     print(f"\nmetrics: admitted {snap['admitted']}, rejected "
           f"{snap['rejected']}, evicted {snap['evicted']}", flush=True)
+    print(f"prefix cache: {snap['prefix_hits']} hits, "
+          f"{snap['prefix_hit_tokens']} prompt tokens served without "
+          f"prefill", flush=True)
     if snap["ttft_ms"]:
         print(f"TTFT p50 {snap['ttft_ms']['p50']:.1f} ms, "
               f"p99 {snap['ttft_ms']['p99']:.1f} ms; per-token p50 "

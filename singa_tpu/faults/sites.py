@@ -57,7 +57,15 @@ SITES: Dict[str, Tuple[str, Tuple[str, ...]]] = {
         "serve engine prefill-into-slot dispatch (per admission)",
         ("error", "hang")),
     "serve.decode": (
-        "serve engine decode-over-slots dispatch (per tick)",
+        "serve engine decode-over-block-tables dispatch (per tick)",
+        ("error", "hang")),
+    "serve.block_alloc": (
+        "paged KV arena block allocation (admission reserve and "
+        "decode-time growth); fires BEFORE the host-side allocation, "
+        "so refcounts/tables are untouched — an injected error at "
+        "admission quarantines the request, mid-stream (growth) it "
+        "escalates to an arena rebuild that reconstructs block tables "
+        "and refcounts",
         ("error", "hang")),
     "train.step": (
         "TrainRunner's retried step region (the shared injector the "

@@ -27,7 +27,7 @@ import numpy as np
 from .. import autograd
 from ..tensor import Tensor
 
-__all__ = ["GenerateMixin", "prefill_step", "decode_step"]
+__all__ = ["GenerateMixin", "prefill_step", "decode_step", "resume_step"]
 
 
 @contextmanager
@@ -92,6 +92,27 @@ def decode_step(model):
         return logits.data[:, 0, :], caches
 
     return decode
+
+
+def resume_step(model):
+    """Build the chunked-prefill closure of the paged serving engine
+    (serve.engine): (params, buffers, ids (B, C), pos, caches) ->
+    (logits (B, C, V), caches).  Unlike :func:`prefill_step` it takes
+    the CALLER's caches and a traced scalar ``pos`` offset, so a prompt
+    prefills as a sequence of fixed-(B, C) chunks — each chunk writes
+    its k/v at [pos, pos+C) and attends the cache below ``pos + C``
+    (``cached_sdpa``'s bottom-right-aligned causal window), which is
+    what lets a shared-prefix request skip the chunks that are already
+    resident in the arena."""
+
+    def resume(params, buffers, ids, pos, caches):
+        with _bound(model, params, buffers):
+            t = Tensor(data=ids, device=_dev(model), requires_grad=False)
+            logits, caches = model.forward_cached(t, caches=caches,
+                                                  pos=pos)
+        return logits.data, caches
+
+    return resume
 
 
 class _GenSession:

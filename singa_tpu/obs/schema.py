@@ -37,19 +37,28 @@ from typing import Any, Dict, List, Optional, Tuple
 __all__ = ["SCHEMA_VERSION", "SchemaError", "require", "validate_entry",
            "validate_stage", "validate_session_doc", "validate_bench_doc",
            "validate_multichip_doc", "validate_serve_payload",
-           "validate_train_run_payload", "validate_incident_payload",
-           "entry_key"]
+           "validate_serve_load_payload", "validate_train_run_payload",
+           "validate_incident_payload", "entry_key"]
 
 #: bump when entry fields change incompatibly; validators dispatch on it
 SCHEMA_VERSION = 1
 
-_KINDS = ("session", "bench", "serve_throughput", "train_run", "incident")
+_KINDS = ("session", "bench", "serve_throughput", "serve_load",
+          "train_run", "incident")
 
 #: required numeric payload fields of a serve_throughput entry — the
 #: serving bench's headline quantities (tools/record_check.py lints
 #: committed serving records against these alongside the training ones)
 _SERVE_FIELDS = ("tokens_per_s", "speedup_vs_sequential", "ttft_p50_ms",
                  "ttft_p99_ms", "requests")
+
+#: required numeric payload fields of a serve_load entry — what one
+#: tools/loadgen.py open-loop traffic run commits: the offered load,
+#: how much of it survived, the SLO percentiles, and the overload
+#: outcomes (shed + rejected), so scheduler/paging changes are judged
+#: on p99 TTFT and tokens/s under overload rather than on unit tests
+_SERVE_LOAD_FIELDS = ("requests", "completed", "shed", "rejected",
+                      "tokens_per_s", "ttft_p50_ms", "ttft_p99_ms")
 
 #: required numeric payload fields of a train_run entry — what the
 #: training orchestrator (singa_tpu.train.TrainRunner) commits for
@@ -164,6 +173,9 @@ def validate_entry(entry: Any, ctx: str = "entry") -> None:
                 f"{type(payload).__name__}", field="payload")
         if kind == "serve_throughput":
             validate_serve_payload(payload, f"{ctx}: serve payload")
+        elif kind == "serve_load":
+            validate_serve_load_payload(payload,
+                                        f"{ctx}: serve_load payload")
         elif kind == "train_run":
             validate_train_run_payload(payload, f"{ctx}: train_run payload")
         elif kind == "incident":
@@ -187,6 +199,15 @@ def validate_serve_payload(payload: Any, ctx: str = "serve payload") -> None:
     missing TTFT percentile is the r5 silent-truncation failure mode
     wearing a new hat)."""
     _require_numeric_fields(payload, _SERVE_FIELDS, ctx)
+
+
+def validate_serve_load_payload(payload: Any,
+                                ctx: str = "serve_load payload") -> None:
+    """One loadgen traffic run's outcome: every field in
+    ``_SERVE_LOAD_FIELDS`` present and numeric — an overload run whose
+    shed/rejected counts went missing would let 'survived the chaos
+    run' masquerade as 'served every request'."""
+    _require_numeric_fields(payload, _SERVE_LOAD_FIELDS, ctx)
 
 
 def validate_train_run_payload(payload: Any,

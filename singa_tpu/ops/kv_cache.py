@@ -19,7 +19,8 @@ from typing import List, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["init_cache", "update_cache", "cached_sdpa"]
+__all__ = ["init_cache", "update_cache", "cached_sdpa",
+           "gather_block_kv", "scatter_block_kv", "scatter_token_kv"]
 
 
 def init_cache(num_layers: int, batch: int, max_len: int, num_kv_heads: int,
@@ -47,6 +48,51 @@ def update_cache(ck, cv, k_new, v_new, pos):
     cv = jax.lax.dynamic_update_slice_in_dim(cv, v_new.astype(cv.dtype),
                                              pos, axis=1)
     return ck, cv
+
+
+def gather_block_kv(ck, cv, table):
+    """Gather a contiguous per-request view out of a paged block arena.
+
+    ``ck``/``cv``: (num_blocks, block_size, K, D) block pools.
+    ``table``: (B, max_blocks) int32 block table — row b's logical block
+    i lives in physical block ``table[b, i]``.  Returns dense
+    (B, max_blocks * block_size, K, D) views.  The gather is a
+    fixed-shape ``jnp.take`` on the leading axis, so the paged arena
+    rides ONE compiled program no matter which physical blocks a
+    request holds (stale/unallocated table entries read garbage that
+    the attention ``limit`` mask makes unreachable)."""
+    B, M = table.shape
+    bs = ck.shape[1]
+
+    def dense(c):
+        g = jnp.take(c, table.reshape(-1), axis=0)        # (B*M, bs, K, D)
+        return g.reshape((B, M * bs) + c.shape[2:])
+
+    return dense(ck), dense(cv)
+
+
+def scatter_block_kv(ck, cv, block, k_blk, v_blk):
+    """Write one block's worth of k/v back into the paged arena.
+
+    ``block`` is a traced int32 scalar physical block id; ``k_blk`` /
+    ``v_blk`` are (block_size, K, D).  The chunked-prefill counterpart
+    of :func:`gather_block_kv` — a fixed-shape scatter at a dynamic
+    leading index, one compiled shape for every block."""
+    return (ck.at[block].set(k_blk.astype(ck.dtype)),
+            cv.at[block].set(v_blk.astype(cv.dtype)))
+
+
+def scatter_token_kv(ck, cv, block, offset, k_tok, v_tok):
+    """Write ONE position's k/v per batch row into the paged arena.
+
+    ``block``/``offset``: (B,) int32 vectors — row b's token lands at
+    ``[block[b], offset[b]]``.  ``k_tok``/``v_tok``: (B, K, D).  The
+    decode-over-block-tables counterpart of :func:`update_cache`'s
+    per-row vector path; rows sharing a target (inactive slots
+    redirected to the null block) resolve arbitrarily, which is safe
+    because the null block is never inside any row's validity window."""
+    return (ck.at[block, offset].set(k_tok.astype(ck.dtype)),
+            cv.at[block, offset].set(v_tok.astype(cv.dtype)))
 
 
 def cached_sdpa(q, ck, cv, limit, scale: float = None, mask=None,

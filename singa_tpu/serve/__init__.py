@@ -3,10 +3,13 @@
 The serving counterpart of the Graph/Scheduler training layer: the
 whole serving lifetime runs through exactly two compiled XLA programs.
 
-* :mod:`~singa_tpu.serve.slots` — :class:`SlotPool`, the fixed
-  (num_slots, max_len) KV-cache arena built on ``ops/kv_cache``;
-  admit/evict are pure index updates, freed slots are reused without
-  recompilation.
+* :mod:`~singa_tpu.serve.slots` — :class:`BlockPool`, the PAGED
+  KV-cache arena built on ``ops/kv_cache``: fixed-size blocks behind
+  per-request device-resident block tables, chain-hashed prefix-cache
+  sharing with refcounts, and an evictable LRU of resident prefixes.
+  Admit/evict/grow are pure index updates, freed blocks are reused
+  without recompilation.  (The PR 2 fixed-slot ``SlotPool`` is gone —
+  a default-sized ``BlockPool`` has capacity parity with it.)
 * :mod:`~singa_tpu.serve.scheduler` — FIFO queue, admission control
   (:class:`QueueFull` backpressure), per-request deadlines and token
   budgets, eviction policy.
@@ -29,8 +32,8 @@ backpressure semantics.
 from .engine import EngineClosed, ServeEngine
 from .scheduler import (EVICTED, FAILED, FINISHED, QUEUED, RUNNING,
                         QueueFull, RequestHandle, Scheduler)
-from .slots import SlotPool
+from .slots import BlockPool
 
-__all__ = ["ServeEngine", "SlotPool", "Scheduler", "RequestHandle",
+__all__ = ["ServeEngine", "BlockPool", "Scheduler", "RequestHandle",
            "QueueFull", "EngineClosed",
            "QUEUED", "RUNNING", "FINISHED", "EVICTED", "FAILED"]

@@ -1,55 +1,72 @@
-"""ServeEngine — continuous-batching inference over a slot arena.
+"""ServeEngine — continuous-batching inference over a paged KV arena.
 
 The engine turns the one-session decode loop of
 ``models/_generate.py`` into a multi-request server while keeping the
 training stack's single-compiled-module discipline: for a given
-(model, num_slots, max_len) it compiles exactly TWO XLA programs —
+(model, num_slots, max_len, block_size) it compiles exactly TWO XLA
+programs —
 
-* **prefill-into-slot** — one request's prompt (padded to
-  ``prefill_len``, true length passed as a traced scalar) runs the
-  model's cached forward against a fresh cache row, which is then
-  written into the arena at a traced slot index.  Variable prompt
-  lengths therefore never change the compiled shape.
-* **decode-over-slots** — ONE token for every slot per dispatch, with
-  per-slot positions: RoPE offsets, cache scatters and attention
-  limits are all (num_slots,) vectors inside the program (the ops
-  layer grew per-row variants for exactly this), and inactive slots
-  are masked — their position is clamped to 0 and their logits zeroed,
-  so a half-empty arena still runs the same program.
+* **prefill-chunk** — ``block_size`` tokens of one request's prompt at
+  a traced block-aligned offset: the slot's block-table row is
+  gathered into a dense cache view, the chunk's k/v are written at
+  [pos, pos+block_size) and exactly ONE physical block is scattered
+  back (``ops.kv_cache.scatter_block_kv``).  A prompt prefills as
+  ``ceil(len / block_size)`` dispatches of this one program — and a
+  request whose leading prompt blocks are already resident (prefix
+  cache) SKIPS those dispatches entirely: prefill cost scales with the
+  unshared suffix, which is the TTFT win paging buys.
+* **decode-over-block-tables** — ONE token for every slot per
+  dispatch: the (num_slots, max_blocks) block tables gather every
+  slot's dense view, per-slot positions drive RoPE offsets and
+  attention limits as (num_slots,) vectors, and each slot's new k/v is
+  scattered to ``[table[slot, pos // bs], pos % bs]``
+  (``scatter_token_kv``).  Inactive slots are masked — position
+  clamped to 0, writes redirected to the null block, token entries
+  frozen — so a half-empty arena still runs the same program.
 
 Both programs thread params/buffers as jit arguments through the same
 ``_bound`` rebinding as generation, so weights are never baked into the
 executables, and both donate the arena, so cache memory is updated in
-place.  Submitting, admitting and evicting requests are host-side index
-updates — no recompilation ever happens after warmup (asserted in
-tests/test_serve.py via the jit cache size).
+place.  Submitting, admitting, growing and evicting requests are
+host-side index updates — no recompilation ever happens after warmup
+(asserted in tests/test_serve.py via the jit cache size).
 
 Greedy decode through the engine is token-identical to
-``GenerateMixin.generate`` (same prefill/decode closures, same argmax),
-which anchors the whole subsystem's correctness to existing behavior.
+``GenerateMixin.generate`` (same cached forward, same argmax), which
+anchors the whole subsystem's correctness to existing behavior.
 
-Resilience (ISSUE 4) — the engine survives its failure modes the way
-``train.loop.TrainRunner`` survives training's, and every path below is
-exercised by deterministic chaos tests (``singa_tpu.faults``,
-tests/test_faults.py) rather than ad-hoc monkeypatching:
+Admission counts FREE BLOCKS, not slots: a request needs a table row
+AND enough blocks for its prompt (minus the shared prefix), and decode
+grows a slot by one block when its position crosses a block boundary.
+When growth finds no free or evictable block, the youngest running
+request is PREEMPTED — its blocks are released and it re-queues at the
+head, to be re-prefilled later from prompt + tokens-so-far (greedy
+decode makes the replay idempotent, so preemption never changes a
+stream).
+
+Resilience (ISSUE 4, extended to the paged arena) — every path below
+is exercised by deterministic chaos tests (``singa_tpu.faults``,
+tests/test_faults.py):
 
 * **retry** — transient dispatch failures (RuntimeError/OSError before
   the program launches) are retried with bounded exponential backoff;
   the ``serve.prefill``/``serve.decode`` injection sites fire *before*
   the jitted call, so an injected fault leaves the donated arena intact
   and the retry re-dispatches the same tick.
-* **quarantine** — a request whose prefill keeps failing is marked
-  ``failed`` on its handle (with the error message) instead of crashing
-  the engine; everyone else keeps decoding.
+* **quarantine** — a request whose prefill (or admission-time block
+  allocation, site ``serve.block_alloc``) keeps failing is marked
+  ``failed`` on its handle instead of crashing the engine.
 * **shedding** — deadline-aware overload control: queued requests whose
   deadline will expire before they could plausibly reach a slot are
   shed at the step boundary (reason ``shed``) instead of wasting a
   prefill.
-* **recovery** — when decode dies past retries, or a Heartbeat detects
-  a hang (``recover_on_hang=True``), the arena is rebuilt and every
-  in-flight request is re-prefilled from prompt + tokens-so-far.
-  Greedy decode makes the replay idempotent: recovered streams are
-  bit-identical to an uninterrupted run.
+* **recovery** — when decode or a decode-time block allocation dies
+  past retries, or a Heartbeat detects a hang (``recover_on_hang``),
+  the arena is rebuilt — fresh block pool, fresh tables, fresh
+  refcounts, empty prefix cache — and every in-flight request is
+  re-prefilled from prompt + tokens-so-far.  Greedy decode makes the
+  replay idempotent: recovered streams are bit-identical to an
+  uninterrupted run.
 * **drain/close** — ``drain()`` refuses new submissions while
   completing everything in the system; ``close()`` drains and releases
   the arena.
@@ -67,22 +84,23 @@ import threading
 import time
 import warnings
 from contextlib import nullcontext
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .. import faults
-from ..models._generate import _bound, decode_step, prefill_step
+from ..models._generate import _bound, decode_step, resume_step
 from ..obs import events
 from ..obs import record as obs_record
+from ..ops import kv_cache as kv_ops
 from ..utils import failure
 from ..utils.failure import Heartbeat
 from .metrics import ServeMetrics
-from .scheduler import (EVICTED, FAILED, FINISHED, RUNNING, QueueFull,
-                        Request, RequestHandle, Scheduler)
-from .slots import SlotPool
+from .scheduler import (EVICTED, FAILED, FINISHED, QUEUED, RUNNING,
+                        QueueFull, Request, RequestHandle, Scheduler)
+from .slots import BlockPool
 
 __all__ = ["ServeEngine", "QueueFull", "EngineClosed"]
 
@@ -97,7 +115,7 @@ class EngineClosed(RuntimeError):
 class ServeEngine:
     """Continuous-batching engine over one decoder model.
 
-        eng = ServeEngine(model, num_slots=8, max_len=256)
+        eng = ServeEngine(model, num_slots=8, max_len=256, block_size=32)
         h = eng.submit(prompt_ids, max_new_tokens=64, deadline_s=30.0)
         eng.run_until_idle()
         full = h.result()              # prompt + generated tokens
@@ -106,12 +124,20 @@ class ServeEngine:
     admit/prefill → decode), delivering one token to every live request
     and invoking their streaming ``on_token`` callbacks.
 
+    ``num_blocks`` sizes the physical block pool (default: capacity
+    parity with a fixed ``(num_slots, max_len)`` arena); a SMALLER pool
+    with MORE slots is how paging admits more concurrent requests in
+    the same memory.  ``share_prefix=False`` disables prefix-cache
+    sharing (every prompt block is private).
+
     Decoding is greedy — the serving counterpart of
     ``generate(temperature=0)`` and token-identical to it.
     """
 
     def __init__(self, model, num_slots: int, max_len: int, *,
-                 prefill_len: Optional[int] = None,
+                 block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 share_prefix: bool = True,
                  max_queue: Optional[int] = None,
                  param_dtype=None,
                  heartbeat_timeout_s: Optional[float] = None,
@@ -125,16 +151,12 @@ class ServeEngine:
                  run_id: Optional[str] = None,
                  _sleep: Callable[[float], None] = time.sleep):
         self.model = model
-        self.prefill_len = int(prefill_len or max_len - 1)
-        if not 0 < self.prefill_len < max_len:
-            raise ValueError(
-                f"prefill_len must be in (0, max_len), got "
-                f"{self.prefill_len} for max_len {max_len}")
         max_pos = getattr(getattr(model, "cfg", None), "max_position", None)
         if max_pos is not None and max_len > max_pos:
             raise ValueError(
                 f"max_len ({max_len}) exceeds the model's max_position "
                 f"({max_pos})")
+        self.share_prefix = bool(share_prefix)
         self.sched = Scheduler(
             max_queue=2 * num_slots if max_queue is None else max_queue)
         self.metrics = ServeMetrics()
@@ -178,7 +200,7 @@ class ServeEngine:
             # the arena must match the dtype init_caches picks under the
             # CAST params inside the prefill trace (models size their
             # caches off the bound weights' dtype) — otherwise the
-            # fresh-row splice type-mismatches at trace time.  eval_shape
+            # block scatter type-mismatches at trace time.  eval_shape
             # under the cast binding reads that dtype without allocating.
             with _bound(model, params, buffers):
                 spec = jax.eval_shape(lambda: model.init_caches(1, 2))
@@ -186,8 +208,11 @@ class ServeEngine:
         self._params, self._buffers = params, buffers
         # arena construction args kept for recovery rebuilds
         self._num_slots, self._max_len = num_slots, max_len
+        self._block_size, self._num_blocks = block_size, num_blocks
         self._arena_dtype = arena_dtype
-        self.pool = SlotPool(model, num_slots, max_len, dtype=arena_dtype)
+        self.pool = BlockPool(model, num_slots, max_len,
+                              block_size=block_size, num_blocks=num_blocks,
+                              dtype=arena_dtype)
 
         self._running: Dict[int, Request] = {}      # slot -> request
         # device-resident per-slot last tokens: written by prefill (the
@@ -198,43 +223,73 @@ class ServeEngine:
         self._toks = jnp.zeros((num_slots,), jnp.int32)
 
         # ---- the exactly-two compiled programs --------------------------
-        pf = prefill_step(model, max_len, last_only=False)
+        bs = self.pool.block_size
+        resume = resume_step(model)
 
-        def prefill_into_slot(params, buffers, ids, length, slot, toks,
-                              caches):
-            logits, fresh = pf(params, buffers, ids)
+        def prefill_chunk(params, buffers, ids, pos, last_idx, slot,
+                          tables, toks, caches):
+            # one block-aligned chunk of one request's prompt: gather
+            # the slot's dense view, run the cached forward at the
+            # traced offset, pick the chunk's last valid token
+            # in-program (only the final chunk's pick survives), and
+            # scatter the ONE block this chunk filled back to the arena
+            row = jax.lax.dynamic_index_in_dim(tables, slot, axis=0,
+                                               keepdims=True)   # (1, MB)
+            dense = [kv_ops.gather_block_kv(ck, cv, row)
+                     for ck, cv in caches]
+            logits, dense = resume(params, buffers, ids, pos, dense)
             last = jax.lax.dynamic_slice_in_dim(
-                logits, length - 1, 1, axis=1)[:, 0, :]
+                logits, last_idx, 1, axis=1)[:, 0, :]
             # greedy pick in-program (jnp.argmax — bit-identical to
             # _pick_impl's temperature-0 branch in generate())
             tok = jnp.argmax(last, axis=-1).astype(jnp.int32)[0]
             toks = toks.at[slot].set(tok)
-            new = [
-                (jax.lax.dynamic_update_slice_in_dim(ak, fk, slot, axis=0),
-                 jax.lax.dynamic_update_slice_in_dim(av, fv, slot, axis=0))
-                for (ak, av), (fk, fv) in zip(caches, fresh)]
+            wb = jax.lax.dynamic_index_in_dim(
+                row[0], pos // bs, keepdims=False)
+            new = []
+            for (ck, cv), (dk, dv) in zip(caches, dense):
+                kb = jax.lax.dynamic_slice_in_dim(dk[0], pos, bs, axis=0)
+                vb = jax.lax.dynamic_slice_in_dim(dv[0], pos, bs, axis=0)
+                new.append(kv_ops.scatter_block_kv(ck, cv, wb, kb, vb))
             return toks, new
 
         dec = decode_step(model)
 
-        def decode_over_slots(params, buffers, toks, pos, active, caches):
-            # inactive slots are masked: position clamped to 0 (their
-            # stale cache row is overwritten wholesale by the next
-            # prefill, so the position-0 scribble is harmless and keeps
-            # every row's attention window non-empty → no NaN softmax),
-            # and their token entry frozen so nothing downstream reads a
-            # garbage argmax
+        def decode_paged(params, buffers, toks, pos, active, tables,
+                         caches):
+            # inactive slots are masked: position clamped to 0 and the
+            # write redirected to the null block (their table row may
+            # point at blocks now owned by OTHER requests, so —
+            # unlike the fixed arena — scribbling through it is not
+            # harmless), and their token entry frozen so nothing
+            # downstream reads a garbage argmax
             posc = jnp.where(active, pos, 0)
-            logits, caches = dec(params, buffers, toks[:, None], posc,
-                                 caches)
+            dense = [kv_ops.gather_block_kv(ck, cv, tables)
+                     for ck, cv in caches]
+            logits, dense = dec(params, buffers, toks[:, None], posc,
+                                dense)
             picked = jnp.argmax(logits.astype(jnp.float32),
                                 axis=-1).astype(jnp.int32)
             new_toks = jnp.where(active, picked, toks)
             new_pos = jnp.where(active, pos + 1, pos)
-            return new_toks, new_pos, caches
+            wb = jnp.take_along_axis(tables, (posc // bs)[:, None],
+                                     axis=1)[:, 0]
+            wb = jnp.where(active, wb, 0)
+            off = jnp.where(active, posc % bs, 0)
 
-        self._prefill = jax.jit(prefill_into_slot, donate_argnums=(6,))
-        self._decode = jax.jit(decode_over_slots, donate_argnums=(5,))
+            def row_at(c, p):
+                return jax.lax.dynamic_slice_in_dim(c, p, 1, axis=0)[0]
+
+            new = []
+            for (ck, cv), (dk, dv) in zip(caches, dense):
+                k_tok = jax.vmap(row_at)(dk, posc)       # (S, K, D)
+                v_tok = jax.vmap(row_at)(dv, posc)
+                new.append(kv_ops.scatter_token_kv(ck, cv, wb, off,
+                                                   k_tok, v_tok))
+            return new_toks, new_pos, new
+
+        self._prefill = jax.jit(prefill_chunk, donate_argnums=(8,))
+        self._decode = jax.jit(decode_paged, donate_argnums=(6,))
 
     # -- introspection ----------------------------------------------------
     def compiled_counts(self):
@@ -261,10 +316,11 @@ class ServeEngine:
         rejected even while slots are free (size ``max_queue`` for the
         largest burst to absorb; default ``2 * num_slots``).  Raises
         ``ValueError`` when the request cannot ever fit the arena
-        (prompt longer than ``prefill_len``, or prompt + budget past
-        ``max_len`` — the arena guarantee that decode never writes out
-        of bounds is enforced here, at the door).  Raises
-        :class:`EngineClosed` while draining or after ``close()``."""
+        (prompt + budget past ``max_len`` — the guarantee that decode
+        never writes past a request's block budget is enforced here, at
+        the door; chunked prefill itself has no separate prompt cap).
+        Raises :class:`EngineClosed` while draining or after
+        ``close()``."""
         if self._closed:
             raise EngineClosed("submit() on a closed engine")
         if self._draining:
@@ -274,10 +330,6 @@ class ServeEngine:
         req = Request(prompt_ids, max_new_tokens, deadline_s, eos_id,
                       on_token)
         p = req.prompt.size
-        if p > self.prefill_len:
-            raise ValueError(
-                f"prompt ({p} tokens) exceeds prefill_len "
-                f"({self.prefill_len})")
         if p + req.max_new_tokens > self.pool.max_len:
             raise ValueError(
                 f"prompt ({p}) + max_new_tokens ({req.max_new_tokens}) "
@@ -295,9 +347,9 @@ class ServeEngine:
     def step(self) -> int:
         """One continuous-batching tick: recovery (if requested by the
         hang watchdog) → deadline eviction → overload shedding →
-        admission (prefill queued requests into free slots) → one decode
-        over all active slots.  Returns the number of tokens
-        delivered."""
+        admission (prefill queued requests into free slots while free
+        blocks cover them) → block-table growth → one decode over all
+        active slots.  Returns the number of tokens delivered."""
         if self._closed:
             raise EngineClosed("step() on a closed engine")
         with events.span("serve.step"):
@@ -313,7 +365,7 @@ class ServeEngine:
 
             # 1. deadline eviction — queued requests that died waiting
             #    and running requests past their deadline vacate first,
-            #    so their slots are admittable this same tick
+            #    so their slots/blocks are admittable this same tick
             for req in self.sched.expire_queued(now):
                 self.metrics.on_evict("deadline")
             for slot in [s for s, r in self._running.items()
@@ -328,25 +380,34 @@ class ServeEngine:
             for req in self.sched.shed_overload(now, self._eta_first_token):
                 self.metrics.on_evict("shed")
 
-            # 2. admission — prefill into free slots between decode steps
+            # 2. admission — prefill into free slots between decode
+            #    steps.  A slot row is not enough: the head-of-queue
+            #    request must also be coverable by free + evictable
+            #    blocks (FIFO: a too-big head blocks the line rather
+            #    than being overtaken)
             while self.pool.free_count:
-                req = self.sched.pop_for_admission()
-                if req is None:
+                req = self.sched.peek()
+                if req is None or not self._admittable(req):
                     break
+                self.sched.pop_for_admission()
                 delivered += self._admit(req)
 
-            # 3. one decode tick over the whole arena; a decode that
-            #    died past its retry budget escalates to an arena
+            # 3. block-table growth + one decode tick over the whole
+            #    arena; a decode (or a decode-time block allocation)
+            #    that died past its retry budget escalates to an arena
             #    rebuild + re-prefill instead of crashing the engine
             if self._running:
                 try:
-                    delivered += self._decode_tick()
+                    self._ensure_blocks()
+                    if self._running:
+                        delivered += self._decode_tick()
                 except (RuntimeError, OSError) as e:
                     if isinstance(e, failure.FailureDetected):
                         raise
                     self._recover(f"decode: {type(e).__name__}: {e}")
 
-            self.metrics.on_step(self.sched.depth, self.pool.active_count)
+            self.metrics.on_step(self.sched.depth, self.pool.active_count,
+                                 self.pool.blocks_in_use)
             dt = time.monotonic() - now
             self._tick_ewma = dt if self._tick_ewma is None else \
                 0.8 * self._tick_ewma + 0.2 * dt
@@ -462,44 +523,134 @@ class ServeEngine:
                 self.metrics.on_retry(site)
                 self._sleep(delay)
 
+    # -- paged-arena bookkeeping -------------------------------------------
+    def _share_limit(self, req: Request) -> int:
+        """How many leading blocks of this request's replay are
+        ELIGIBLE for prefix sharing: full blocks wholly inside the
+        ORIGINAL prompt (generated tokens are private), and never the
+        whole replay — at least one suffix token must run prefill so
+        the request has last-position logits to pick its first token
+        from."""
+        if not self.share_prefix:
+            return 0
+        bs = self.pool.block_size
+        return min(req.prompt.size // bs,
+                   (req.replay_ids().size - 1) // bs)
+
+    def _blocks_needed(self, req: Request, n_shared: int) -> int:
+        """Fresh blocks an admission must allocate: coverage for the
+        replay plus the first decode position, minus the shared
+        prefix."""
+        replay = req.replay_ids().size
+        return replay // self.pool.block_size + 1 - n_shared
+
+    def _req_keys(self, req: Request) -> list:
+        """The request's prefix chain keys, computed once (they depend
+        only on the immutable prompt) — a head-of-queue request waiting
+        on free blocks is probed every step and must not re-hash its
+        whole prefix each time."""
+        if not self.share_prefix:
+            return []
+        if req.prefix_keys is None:
+            req.prefix_keys = self.pool.prefix_keys(
+                req.prompt, req.prompt.size // self.pool.block_size)
+        return req.prefix_keys
+
+    def _admittable(self, req: Request) -> bool:
+        n_shared, n_lru = self.pool.probe_prefix(
+            req.prompt, self._share_limit(req), keys=self._req_keys(req))
+        # claiming shared blocks out of the evictable LRU consumes
+        # available_blocks too — only what remains can cover the fresh
+        # allocation
+        return (self.pool.available_blocks - n_lru
+                >= self._blocks_needed(req, n_shared))
+
+    def _alloc_blocks(self, n: int, rid: int) -> Optional[List[int]]:
+        """Claim ``n`` blocks through the ``serve.block_alloc``
+        injection site (fires BEFORE the host-side allocation, so an
+        injected error leaves refcounts untouched).  Returns None when
+        the pool genuinely cannot cover ``n`` — the preemption cue."""
+        faults.fire("serve.block_alloc", n=n, rid=rid)
+        return self.pool.alloc_blocks(n)
+
     def _admit(self, req: Request) -> int:
-        slot = self.pool.alloc()
+        slot = self.pool.alloc_slot()
         assert slot is not None, "admission with no free slot"
         # replay_ids == prompt for a fresh request; for a request
-        # re-admitted by arena recovery it is prompt + tokens-so-far,
-        # whose greedy prefill pick IS the next decode token — the
-        # recovery re-prefill is idempotent
+        # re-admitted by preemption or arena recovery it is prompt +
+        # tokens-so-far, whose greedy prefill pick IS the next decode
+        # token — the re-prefill is idempotent
         replay = req.replay_ids()
         P = replay.size
-        ids = np.zeros((1, self.prefill_len), np.int32)
-        ids[0, :P] = replay
+        bs = self.pool.block_size
         first = not req.tokens
+        owned: List[int] = []
+        shared_ids: List[int] = []
+        mapped = False
+        # the allocation site fires once (no retry loop); only the
+        # prefill dispatches below go through _dispatch's backoff —
+        # quarantine must attribute the failure to the seam that died
+        fail_site, fail_attempts = "serve.block_alloc", 1
         try:
-            with events.span("serve.prefill", slot=slot, prompt=P):
-                self._toks, self.pool.caches = self._dispatch(
-                    "serve.prefill", self._prefill,
-                    (self._params, self._buffers, jnp.asarray(ids),
-                     jnp.asarray(P, jnp.int32),
-                     jnp.asarray(slot, jnp.int32),
-                     self._toks, self.pool.caches),
-                    rid=req.rid)
+            n_shared, shared_ids = self.pool.match_prefix(
+                req.prompt, self._share_limit(req),
+                keys=self._req_keys(req))
+            owned = self._alloc_blocks(
+                self._blocks_needed(req, n_shared), req.rid) or []
+            if len(owned) < self._blocks_needed(req, n_shared):
+                # _admittable() held when we were popped and nothing
+                # ran since — an all-or-nothing alloc can only come up
+                # short through a bug; fail THIS request loudly
+                raise RuntimeError("block allocation came up short")
+            fail_site = "serve.prefill"
+            fail_attempts = self.max_dispatch_retries + 1
+            self.pool.map_slot(slot, shared_ids + owned)
+            mapped = True
+            start0 = n_shared * bs
+            if n_shared:
+                self.metrics.on_prefix_hit(start0)
+            with events.span("serve.prefill", slot=slot, prompt=P,
+                             shared=start0):
+                for start in range(start0, P, bs):
+                    ids = np.zeros((1, bs), np.int32)
+                    chunk = replay[start:start + bs]
+                    ids[0, :chunk.size] = chunk
+                    self._toks, self.pool.caches = self._dispatch(
+                        "serve.prefill", self._prefill,
+                        (self._params, self._buffers, jnp.asarray(ids),
+                         jnp.asarray(start, jnp.int32),
+                         jnp.asarray(chunk.size - 1, jnp.int32),
+                         jnp.asarray(slot, jnp.int32),
+                         self.pool.tables, self._toks, self.pool.caches),
+                        rid=req.rid)
                 tok = int(np.asarray(self._toks)[slot])
         except (RuntimeError, OSError) as e:
             if isinstance(e, failure.FailureDetected):
                 raise
-            # the injected/transient failure fired before dispatch, so
-            # the slot row was never touched — hand it back and fail
-            # only THIS request, not the engine
-            self.pool.release(slot)
-            self._quarantine(req, e)
+            # the injected/transient failure fired before a dispatch
+            # touched anything irreversible: unwind this request's
+            # claims (refcounts included) and fail only THIS request,
+            # not the engine
+            if mapped:
+                self.pool.release(slot)
+            else:
+                self.pool.unref_shared(shared_ids)
+                self.pool.free_blocks(owned)
+                self.pool.release_slot_row(slot)
+            self._quarantine(req, e, fail_site, fail_attempts)
             return 0
+        if self.share_prefix:
+            self.pool.register_prefix(req.prompt, slot,
+                                      req.prompt.size // bs,
+                                      keys=self._req_keys(req))
         self.pool.activate(slot, P)
         req.slot = slot
         req.state = RUNNING
         self._running[slot] = req
         if first:
-            # recovery re-prefills count under serve.recoveries, not
-            # here — ``admitted`` stays comparable to ``submitted``
+            # preemption/recovery re-prefills count under their own
+            # counters, not here — ``admitted`` stays comparable to
+            # ``submitted``
             self.metrics.on_admit()
         done = req.deliver(tok)       # prefill yields the (next) token
         if first:
@@ -510,20 +661,56 @@ class ServeEngine:
             self._finalize(slot)
         return 1
 
-    def _quarantine(self, req: Request, err: Exception) -> None:
-        """Repeatedly-poisoned prefill: surface a per-request failure
-        status (handle.failed / handle.error), never an engine crash."""
+    def _quarantine(self, req: Request, err: Exception,
+                    site: str = "serve.prefill",
+                    attempts: Optional[int] = None) -> None:
+        """Repeatedly-poisoned prefill/admission: surface a per-request
+        failure status (handle.failed / handle.error), never an engine
+        crash.  ``site``/``attempts`` name the seam that actually died
+        (block allocation fires once; prefill retries with backoff) so
+        the incident record stays honest evidence."""
+        if attempts is None:
+            attempts = self.max_dispatch_retries + 1
         req.state = FAILED
         req.finish_reason = "quarantined"
-        req.error = (f"prefill failed after "
-                     f"{self.max_dispatch_retries + 1} attempt(s): "
+        req.error = (f"{site} failed after {attempts} attempt(s): "
                      f"{type(err).__name__}: {err}")
         self.metrics.on_quarantine()
-        self._incident("serve.prefill", type(err).__name__,
-                       f"req:{req.rid}", "quarantined",
-                       self.max_dispatch_retries + 1)
+        self._incident(site, type(err).__name__,
+                       f"req:{req.rid}", "quarantined", attempts)
         warnings.warn(f"serve: request {req.rid} quarantined: "
                       f"{req.error}", stacklevel=2)
+
+    def _ensure_blocks(self) -> None:
+        """Decode-time growth: before the tick, every running slot
+        whose next write position crosses into an unmapped block gets
+        one more block — preempting the youngest running request when
+        the pool is exhausted (its blocks are released, it re-queues at
+        the head and replays later; greedy decode keeps its stream
+        bit-identical)."""
+        for slot in sorted(self._running):
+            req = self._running.get(slot)
+            if req is None:
+                continue
+            bs = self.pool.block_size
+            need = (req.prompt.size + len(req.tokens)) // bs + 1
+            while slot in self._running and \
+                    self.pool.mapped_count(slot) < need:
+                got = self._alloc_blocks(1, req.rid)
+                if got:
+                    self.pool.append_block(slot, got[0])
+                else:
+                    self._preempt_youngest()
+
+    def _preempt_youngest(self) -> None:
+        victim_slot = max(self._running,
+                          key=lambda s: self._running[s].rid)
+        req = self._running.pop(victim_slot)
+        self.pool.release(victim_slot)
+        req.state = QUEUED
+        req.slot = None
+        self.sched.requeue_front([req])
+        self.metrics.on_preempt()
 
     def _decode_tick(self) -> int:
         t0 = time.perf_counter()
@@ -531,7 +718,8 @@ class ServeEngine:
             self._toks, new_pos, self.pool.caches = self._dispatch(
                 "serve.decode", self._decode,
                 (self._params, self._buffers, self._toks,
-                 self.pool.pos, self.pool.active, self.pool.caches),
+                 self.pool.pos, self.pool.active, self.pool.tables,
+                 self.pool.caches),
                 active=len(self._running))
             toks = np.asarray(self._toks)    # tiny fetch: num_slots ints
         self.pool.pos = new_pos
@@ -557,15 +745,17 @@ class ServeEngine:
 
     # -- recovery ----------------------------------------------------------
     def recover(self, reason: str = "requested") -> None:
-        """Rebuild the arena and re-prefill every in-flight request —
-        the path behind Heartbeat hang detection, also callable directly
-        after an external device event.  Each running request is
-        requeued at the HEAD of the queue and re-prefilled from
-        ``prompt + tokens-so-far``; greedy decode makes that replay
-        idempotent, so however many times recovery runs, the final
-        streams are bit-identical to an uninterrupted run.  A request
-        whose replay no longer fits ``prefill_len`` is failed
-        (``unrecoverable``) rather than silently truncated."""
+        """Rebuild the arena — fresh block pool, block tables,
+        refcounts, empty prefix cache — and re-prefill every in-flight
+        request; the path behind Heartbeat hang detection, also
+        callable directly after an external device event.  Each running
+        request is requeued at the HEAD of the queue and re-prefilled
+        from ``prompt + tokens-so-far``; greedy decode makes that
+        replay idempotent, so however many times recovery runs, the
+        final streams are bit-identical to an uninterrupted run.
+        (Chunked prefill has no prompt-length cap below ``max_len``, so
+        — unlike the PR 2 fixed arena — every in-flight replay is
+        recoverable.)"""
         self._recover(reason)
 
     def _recover(self, reason: str) -> None:
@@ -579,20 +769,31 @@ class ServeEngine:
         with events.span("serve.recover", reason=reason):
             inflight = sorted(self._running.values(), key=lambda r: r.rid)
             self._running.clear()
-            # fresh arena + token buffer: same shapes/dtypes, so the two
-            # compiled programs are reused — recovery never recompiles
-            self.pool = SlotPool(self.model, self._num_slots,
-                                 self._max_len, dtype=self._arena_dtype)
+            # fresh arena + tables + token buffer: same shapes/dtypes,
+            # so the two compiled programs are reused — recovery never
+            # recompiles.  The prefix cache dies with the old pool
+            # (its blocks' contents are gone); re-prefills rebuild
+            # tables and refcounts from scratch.
+            self.pool = BlockPool(self.model, self._num_slots,
+                                  self._max_len,
+                                  block_size=self._block_size,
+                                  num_blocks=self._num_blocks,
+                                  dtype=self._arena_dtype)
             self._toks = jnp.zeros((self._num_slots,), jnp.int32)
             requeue = []
             for req in inflight:
-                if req.replay_ids().size > self.prefill_len:
+                if req.replay_ids().size >= self.pool.max_len:
+                    # defensive: unreachable while submit() enforces
+                    # prompt + budget <= max_len, but a replay that
+                    # could never decode again must fail loudly, not
+                    # silently truncate
                     req.state = FAILED
                     req.finish_reason = "unrecoverable"
                     req.error = (
                         f"cannot re-prefill after arena rebuild: prompt "
                         f"+ generated = {req.replay_ids().size} tokens "
-                        f"exceeds prefill_len ({self.prefill_len})")
+                        f"leaves no room to decode under max_len "
+                        f"({self.pool.max_len})")
                     self.metrics.on_evict("unrecoverable")
                     self._incident("serve.arena", reason,
                                    f"req:{req.rid}", "unrecoverable", 0)

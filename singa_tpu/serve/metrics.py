@@ -24,8 +24,18 @@ name                        kind       meaning
                                        (failed handle status)
 ``serve.recoveries``        counter    arena rebuild + re-prefill of
                                        in-flight requests
+``serve.preempted``         counter    a running request released its
+                                       blocks to an exhausted pool and
+                                       re-queued (replayed later,
+                                       stream unchanged)
+``serve.prefix_hits``       counter    an admission mapped >= 1 resident
+                                       shared-prefix block copy-free
+``serve.prefix_hit_tokens`` counter    prompt tokens whose prefill was
+                                       SKIPPED via the prefix cache
 ``serve.queue_depth``       gauge      waiting requests, after each step
 ``serve.active_slots``      gauge      live slots, after each step
+``serve.blocks_in_use``     gauge      referenced KV blocks, after each
+                                       step (the paged-arena footprint)
 ``serve.step``              span       one engine step (host wall clock)
 ``serve.prefill``           span       one prefill dispatch (+ fetch)
 ``serve.decode``            span       one decode dispatch (+ fetch)
@@ -66,6 +76,9 @@ class ServeMetrics:
         self.retries: Dict[str, int] = {}
         self.quarantined = 0
         self.recoveries = 0
+        self.preempted = 0
+        self.prefix_hits = 0
+        self.prefix_hit_tokens = 0
         self.steps = 0
         self._ttft = _Hist()
         self._token = _Hist()
@@ -100,6 +113,17 @@ class ServeMetrics:
         self.recoveries += 1
         events.counter("serve.recoveries", 1, inflight=inflight)
 
+    def on_preempt(self) -> None:
+        self.preempted += 1
+        events.counter("serve.preempted", 1)
+
+    # -- paged arena / prefix cache (ISSUE 6) ------------------------------
+    def on_prefix_hit(self, tokens: int) -> None:
+        self.prefix_hits += 1
+        self.prefix_hit_tokens += tokens
+        events.counter("serve.prefix_hits", 1)
+        events.counter("serve.prefix_hit_tokens", tokens)
+
     # -- latency ----------------------------------------------------------
     def on_first_token(self, ttft_s: float) -> None:
         self._ttft.observe(ttft_s * 1e3)
@@ -110,10 +134,12 @@ class ServeMetrics:
         events.histogram("serve.token_ms", latency_s * 1e3)
 
     # -- per-step levels ---------------------------------------------------
-    def on_step(self, queue_depth: int, active_slots: int) -> None:
+    def on_step(self, queue_depth: int, active_slots: int,
+                blocks_in_use: int = 0) -> None:
         self.steps += 1
         events.gauge("serve.queue_depth", queue_depth)
         events.gauge("serve.active_slots", active_slots)
+        events.gauge("serve.blocks_in_use", blocks_in_use)
 
     def snapshot(self) -> Dict[str, Any]:
         """Exact totals + THIS engine's latency summaries (None until
@@ -124,6 +150,9 @@ class ServeMetrics:
             "retries": dict(self.retries),
             "quarantined": self.quarantined,
             "recoveries": self.recoveries,
+            "preempted": self.preempted,
+            "prefix_hits": self.prefix_hits,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
             "steps": self.steps,
             "ttft_ms": self._ttft.summary(),
             "token_ms": self._token.summary(),

@@ -73,6 +73,8 @@ class Request:
         self.finish_reason: Optional[str] = None
         self.error: Optional[str] = None
         self.ttft_s: Optional[float] = None
+        self._replay: Optional[np.ndarray] = None   # replay_ids memo
+        self.prefix_keys: Optional[list] = None     # chain-key memo
         self.handle = RequestHandle(self)
 
     def replay_ids(self) -> np.ndarray:
@@ -82,9 +84,17 @@ class Request:
         Greedy decode makes the replay idempotent: the re-prefilled
         slot's next token is exactly the token decode would have
         produced next, so recovering any number of times leaves the
-        final stream bit-identical."""
-        return np.concatenate(
-            [self.prompt, np.asarray(self.tokens, np.int32)])
+        final stream bit-identical.
+
+        Memoized while the token list is unchanged: the admission path
+        asks for the replay several times per step for a head-of-queue
+        request waiting on free blocks (callers treat it read-only;
+        the user-facing copy is :meth:`RequestHandle.result`)."""
+        size = self.prompt.size + len(self.tokens)
+        if self._replay is None or self._replay.size != size:
+            self._replay = np.concatenate(
+                [self.prompt, np.asarray(self.tokens, np.int32)])
+        return self._replay
 
     # -- transitions (called by the engine) ------------------------------
     def deliver(self, tok: int) -> bool:
@@ -153,8 +163,9 @@ class RequestHandle:
         return self._req.ttft_s
 
     def result(self) -> np.ndarray:
-        """prompt + generated tokens as one int32 vector."""
-        return self._req.replay_ids()
+        """prompt + generated tokens as one int32 vector (a private
+        copy — the engine memoizes the underlying array)."""
+        return self._req.replay_ids().copy()
 
 
 class Scheduler:
@@ -224,6 +235,14 @@ class Scheduler:
             r.state = QUEUED
             r.slot = None
             self.queue.appendleft(r)
+
+    def peek(self) -> Optional[Request]:
+        """The request :meth:`pop_for_admission` would return, without
+        removing it — the engine checks the head's BLOCK need against
+        the paged arena before committing to admission (FIFO: a head
+        the free blocks cannot cover blocks the line rather than being
+        overtaken, so admission order stays deterministic)."""
+        return self.queue[0] if self.queue else None
 
     def pop_for_admission(self) -> Optional[Request]:
         """Next request to prefill into a free slot (FIFO), or None."""

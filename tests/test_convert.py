@@ -82,6 +82,8 @@ def test_converted_model_finetunes(hf_gpt2):
     assert losses[-1] < losses[0] * 0.9, losses
 
 
+@pytest.mark.slow  # 14 s composition variant: conversion parity and
+# pipelined training are each covered by cheaper tier-1 tests
 def test_converted_llama_trains_pipelined(hf_llama):
     """Conversion + pipeline compose: the HF weights drop into a
     pipelined instantiation (param paths are identical) and the model
@@ -266,6 +268,9 @@ def test_mixtral_conversion_matches():
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # 15 s variant: mixtral conversion parity is
+# tier-1 (test_mixtral_conversion_matches); finetune-after-convert is
+# tier-1 on llama (test_converted_model_finetunes)
 def test_mixtral_conversion_finetunes():
     torch.manual_seed(1)
     np.random.seed(1)
@@ -333,6 +338,9 @@ class TestMistral:
         out = win(tensor.from_numpy(ids)).to_numpy()
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.slow  # 28 s token-by-token replay: windowed forward
+    # parity vs HF stays tier-1 above; cached-equals-uncached decode is
+    # tier-1 per family in test_models (TestKVCacheGeneration)
     def test_windowed_cached_decode_equals_uncached(self):
         m = models.from_hf(self._hf(window=6))
         m.eval()

@@ -246,6 +246,9 @@ def test_dp8_checkpoint_resume_with_momentum(tmp_path):
                                    err_msg=f"param {n} diverged on DP resume")
 
 
+@pytest.mark.slow  # 23 s sweep: the int8 wire path stays tier-1 via
+# test_quantized_allreduce_error_bound + test_int8_ring_in_distopt_
+# training (cheaper, same code path)
 def test_ring_int8_allreduce_correctness():
     """wire='int8' ring variant: true int8 payloads, result within the
     widened-grid error bound of the exact mean."""

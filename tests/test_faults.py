@@ -427,7 +427,7 @@ def llama():
 def engine(llama):
     """The shared chaos engine: every test drains it back to idle, and
     recovery rebuilds reuse its two compiled programs."""
-    return ServeEngine(llama, num_slots=3, max_len=24, prefill_len=10,
+    return ServeEngine(llama, num_slots=3, max_len=24, block_size=8,
                        backoff_base=0.001, backoff_max=0.01)
 
 
@@ -495,8 +495,8 @@ class TestServeChaos:
         greedy streams (and reuses the compiled programs)."""
         hs = [engine.submit(p, max_new_tokens=6)
               for p in _prompts([4, 6, 8])]
-        # one tick = prefill wave + one decode: 2 tokens each, so the
-        # longest replay is 8 + 2 = prefill_len — still recoverable
+        # one tick = prefill wave + one decode: 2 tokens each — every
+        # replay re-prefills in block-aligned chunks
         engine.step()
         before = engine.metrics.recoveries
         engine.recover("test")
@@ -506,20 +506,64 @@ class TestServeChaos:
         assert engine.metrics.recoveries == before + 2
         assert engine.compiled_counts() == (1, 1)
 
-    def test_recovery_fails_oversized_replay_loudly(self, engine):
-        """A request whose prompt+generated no longer fits prefill_len
-        is failed as unrecoverable, not silently truncated — and the
-        others still complete."""
+    def test_recovery_replays_long_prompts(self, engine, llama):
+        """PR 2's fixed arena failed a replay past prefill_len as
+        unrecoverable; chunked prefill has no such cap — a mid-stream
+        rebuild re-prefills ANY in-flight replay under max_len and the
+        streams stay bit-identical to their references."""
         long_p, short_p = _prompts([9, 4], seed=5)
+        ref_long = llama.generate(long_p[None], max_new_tokens=8)[0, 9:]
+        ref_short = llama.generate(short_p[None], max_new_tokens=3)[0, 4:]
         h_long = engine.submit(long_p, max_new_tokens=8)
         h_short = engine.submit(short_p, max_new_tokens=3)
         engine.step()                   # long has 2 tokens: replay = 11
         engine.recover("test")
         engine.run_until_idle()
-        assert h_long.failed and h_long.finish_reason == "unrecoverable"
-        assert "prefill_len" in h_long.error
-        assert h_short.done and not h_short.failed
-        assert len(h_short.tokens) == 3
+        assert not h_long.failed and not h_short.failed
+        np.testing.assert_array_equal(ref_long, np.asarray(h_long.tokens))
+        np.testing.assert_array_equal(ref_short,
+                                      np.asarray(h_short.tokens))
+        assert engine.compiled_counts() == (1, 1)
+
+    def test_block_alloc_fault_mid_stream_recovers_bit_identical(
+            self, engine, baseline):
+        """ISSUE 6 chaos satellite: the paged arena's allocation seam
+        (`serve.block_alloc`) errors on a DECODE-TIME growth call —
+        mid-stream, after admission — and the engine rebuilds the
+        arena: fresh block pool, block tables and refcounts, every
+        in-flight request re-prefilled, streams bit-identical to the
+        fault-free run, nothing recompiled."""
+        # alloc call order is deterministic: admissions are calls 1-3
+        # ([4]->1, [6]->1, [8]->2 blocks), the first growth (slot of
+        # the 6-token prompt crossing its block boundary) is call 4
+        plan = FaultPlan([FaultSpec("serve.block_alloc", "error", at=4)])
+        before = engine.metrics.recoveries
+        with faults.active(plan):
+            hs = [engine.submit(p, max_new_tokens=6)
+                  for p in _prompts([4, 6, 8])]
+            engine.run_until_idle()
+        assert plan.fire_count() == 1
+        assert [h.tokens for h in hs] == baseline
+        assert engine.metrics.recoveries == before + 1
+        assert engine.compiled_counts() == (1, 1)
+        # the rebuilt pool's refcounts are consistent: fully drained
+        assert (engine.pool.ref == 0).all()
+        assert engine.pool.free_count == engine.pool.num_slots
+
+    def test_block_alloc_fault_at_admission_quarantines(self, engine):
+        """An allocation fault BEFORE any block is claimed fails only
+        that request (refcounts untouched), mirroring the poisoned-
+        prefill quarantine path."""
+        plan = FaultPlan([FaultSpec("serve.block_alloc", "error",
+                                    every=1, times=3)])
+        with faults.active(plan):
+            with pytest.warns(UserWarning, match="quarantined"):
+                h = engine.submit(_prompts([5], seed=11)[0],
+                                  max_new_tokens=3)
+                engine.run_until_idle()
+        assert h.failed and h.finish_reason == "quarantined"
+        assert (engine.pool.ref == 0).all()
+        assert engine.pool.free_count == engine.pool.num_slots
 
     def test_zero_overhead_when_off(self, engine, baseline, tmp_path):
         """Acceptance: with no plan active no obs event is emitted on
@@ -543,6 +587,10 @@ class TestServeChaos:
             engine.run_until_idle()
         assert probe.calls["serve.prefill"] == 2
         assert probe.calls["serve.decode"] >= 3
+        # the paged arena's allocation seam is reached too: one call
+        # per admission, plus one growth when the 6-token prompt's
+        # stream crosses its first block boundary (6 + 2 = 8)
+        assert probe.calls["serve.block_alloc"] == 3
         assert probe.fired == []
         assert engine.compiled_counts() == (1, 1)
 
@@ -592,11 +640,11 @@ class TestServeChaos:
 
     def test_submit_validates_at_admission(self, engine):
         """Satellite: an impossible request is rejected with a clear
-        ValueError at the door, never inside the padded prefill
+        ValueError at the door, never inside the chunked prefill
         program."""
-        with pytest.raises(ValueError, match="prefill_len"):
-            engine.submit(np.arange(11, dtype=np.int32),
-                          max_new_tokens=2)        # prompt > prefill_len
+        with pytest.raises(ValueError, match="max_len"):
+            engine.submit(np.arange(23, dtype=np.int32),
+                          max_new_tokens=2)        # 25 > max_len 24
         with pytest.raises(ValueError, match="max_len"):
             engine.submit(np.arange(8, dtype=np.int32),
                           max_new_tokens=40)       # past the arena end
@@ -608,7 +656,7 @@ class TestDrainClose:
                                                              llama):
         refused = []
 
-        eng = ServeEngine(llama, num_slots=2, max_len=24, prefill_len=10,
+        eng = ServeEngine(llama, num_slots=2, max_len=24, block_size=8,
                           backoff_base=0.001)
 
         def try_submit(tok, handle):
@@ -687,7 +735,7 @@ class TestHangRecoverySlow:
         """An injected decode hang outlasting the Heartbeat timeout is
         detected on the monitor thread, recovery runs at the next step
         boundary, and the greedy streams are unchanged."""
-        eng = ServeEngine(llama, num_slots=3, max_len=24, prefill_len=10,
+        eng = ServeEngine(llama, num_slots=3, max_len=24, block_size=8,
                           backoff_base=0.001,
                           heartbeat_timeout_s=0.15,
                           recover_on_hang=True)
@@ -700,11 +748,65 @@ class TestHangRecoverySlow:
         assert [h.tokens for h in hs] == baseline
         assert eng.metrics.recoveries == 1
 
+    def test_block_alloc_hang_drives_recovery(self, llama, engine,
+                                              baseline):
+        """The heavy variant of the block_alloc chaos satellite: the
+        growth-call hang outlasts the Heartbeat, the monitor requests a
+        rebuild, and the recovered streams (tables + refcounts built
+        from scratch) are unchanged."""
+        eng = ServeEngine(llama, num_slots=3, max_len=24, block_size=8,
+                          backoff_base=0.001,
+                          heartbeat_timeout_s=0.15,
+                          recover_on_hang=True)
+        plan = FaultPlan([FaultSpec("serve.block_alloc", "hang", at=4,
+                                    delay_s=0.6)])
+        with faults.active(plan):
+            hs = [eng.submit(p, max_new_tokens=6)
+                  for p in _prompts([4, 6, 8])]
+            eng.run_until_idle()
+        assert [h.tokens for h in hs] == baseline
+        assert eng.metrics.recoveries == 1
+        assert (eng.pool.ref == 0).all()
+
+    def test_loadgen_overload_soak_survives_chaos(self, llama,
+                                                  tmp_path):
+        """The loadgen acceptance scenario in-process: an open-loop
+        overload run with transient prefill/decode errors AND a
+        block_alloc fault completes with no engine crash, every request
+        accounted for, and a schema-valid serve_load record."""
+        from singa_tpu.obs import record as obs_record
+        from tools import loadgen
+
+        eng = ServeEngine(llama, num_slots=4, max_len=32, block_size=8,
+                          backoff_base=0.001, backoff_max=0.01,
+                          max_recoveries=50)
+        plan = FaultPlan([
+            FaultSpec("serve.prefill", "error", every=4, times=2),
+            FaultSpec("serve.decode", "error", every=10, times=2),
+            FaultSpec("serve.block_alloc", "error", at=10),
+        ], seed=7)
+        wl = loadgen.build_workload(30, rate_rps=200.0, seed=2,
+                                    prompt_lens=(4, 8, 12),
+                                    new_tokens=(3, 6),
+                                    tenants=2, shared_len=8)
+        with faults.active(plan):
+            payload = loadgen.run_load(eng, wl, deadline_s=5.0)
+        assert eng.pending == 0
+        assert plan.fire_count() >= 3
+        accounted = (payload["completed"] + payload["shed"]
+                     + payload["rejected"]
+                     + payload["detail"]["deadline_evicted"]
+                     + payload["detail"]["quarantined"])
+        assert accounted == 30
+        store = loadgen.append_record(payload,
+                                      str(tmp_path / "records.jsonl"))
+        assert obs_record.RunRecord(store).validate() == []
+
     def test_hang_without_recovery_calls_on_failure(self, llama):
         """recover_on_hang=False keeps the PR-2 abort contract: the
         user's on_failure observes the hang."""
         fired = []
-        eng = ServeEngine(llama, num_slots=2, max_len=24, prefill_len=10,
+        eng = ServeEngine(llama, num_slots=2, max_len=24, block_size=8,
                           heartbeat_timeout_s=0.15,
                           on_failure=lambda age, step: fired.append(age))
         plan = FaultPlan([FaultSpec("serve.prefill", "hang", at=1,

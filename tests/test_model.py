@@ -479,6 +479,8 @@ class TestRemat:
         assert np.isfinite(float(ls.to_numpy()))
         assert any("side-channel" in str(x.message) for x in w)
 
+    @pytest.mark.slow  # 21 s config variant: remat-trajectory parity
+    # and remat+dropout training stay tier-1 in this class/file
     def test_llama_remat_config(self):
         """cfg.remat trains the same trajectory and still generates."""
         import dataclasses

@@ -341,6 +341,9 @@ class TestBf16ComputePath:
         device.set_default_device(dev)
         return dev
 
+    @pytest.mark.slow  # 21 s dtype variant: fp32 resnet training is
+    # tier-1 (test_resnet18_cifar_trains); the bf16 compute path is
+    # tier-1 on the cheaper transformer tests in this class
     def test_resnet_trains_bf16(self):
         dev = self._bf16_dev()
         tensor.set_seed(0)
@@ -460,6 +463,9 @@ class TestSamplingControls:
         assert any(not np.array_equal(greedy, o) for o in outs)
 
 
+@pytest.mark.slow  # 19 s per-family variant: remat-trajectory parity
+# stays tier-1 in test_model.py (TestRemat::test_remat_matches_plain_
+# trajectory, ~3 s)
 def test_gpt2_remat_matches_plain_trajectory():
     """GPT2Config.remat: Adam trajectory must equal the plain model
     (exercises name-keyed slot integrity through the wrapper)."""

@@ -119,6 +119,9 @@ def test_two_process_resume_equals_uninterrupted(tmp_path):
                               "after resume")
 
 
+@pytest.mark.slow  # 11 s optimizer variant: 2-proc resume stays
+# tier-1 (test_two_process_resume_equals_uninterrupted, adam) and
+# adafactor dict-slot checkpointing stays tier-1 in test_model
 def test_two_process_adafactor_resume(tmp_path):
     """Adafactor's DICT slots (factored vr/vc) checkpoint and resume
     across 2 REAL processes, reproducing the uninterrupted big-batch

@@ -406,6 +406,8 @@ class TestPipelineExtras:
             parallel.set_mesh(None)
 
 
+@pytest.mark.slow  # 32 s byte-count perf guard (TP x PP); functional
+# TP-inside-PP correctness stays tier-1 via the GPipe parity tests
 def test_stacked_block_weights_tp_shard_inside_pipeline():
     """Under TP x PP the stacked block weights must carry the model's
     TP rules (trace-scoped SHARD_RULES handoff) — without them every

@@ -161,6 +161,8 @@ def test_zero1_update_grad_residency_reported():
         -(-plan.param_bytes_per_device // 8) + 64
 
 
+@pytest.mark.slow  # 18 s real-dims execution smoke: the plan math,
+# mesh planning and post-planning usability stay tier-1 in this file
 def test_8b_single_block_executes_at_real_dims():
     """VERDICT r3 item 7: one llama3-8B block (REAL dim/ffn/head dims)
     forward+backward+update actually executes on the 8-device virtual

@@ -1,13 +1,18 @@
-"""Continuous-batching serving engine (singa_tpu.serve, ISSUE 2) —
-tier-1 CPU coverage on LlamaConfig.tiny().
+"""Continuous-batching serving engine (singa_tpu.serve, ISSUE 2;
+paged KV arena + prefix sharing, ISSUE 6) — tier-1 CPU coverage on
+LlamaConfig.tiny().
 
 The invariants under test are the subsystem's contract:
   * greedy decode through the engine is token-identical to
-    GenerateMixin.generate for the same prompts;
-  * exactly TWO compiled programs per (model, num_slots, max_len) —
-    submitting, evicting and reusing slots never recompiles (asserted
-    via the jit cache size);
-  * admission control rejects loudly when the queue is full;
+    GenerateMixin.generate for the same prompts — including through
+    chunked prefill, prefix-cache sharing and preemption;
+  * exactly TWO compiled programs per (model, num_slots, max_len,
+    block_size) — submitting, evicting, growing block tables and
+    reusing blocks never recompiles (asserted via the jit cache size);
+  * prefix-cache refcounts drain to zero, and evicting a referenced
+    shared block is impossible (asserted in the pool);
+  * admission control rejects loudly when the queue is full, and
+    admits on free BLOCKS, not just free slots;
   * deadlines evict both queued and running requests;
   * serving metrics flow through the shared obs sink, and the
     histogram primitive's summary semantics hold.
@@ -37,7 +42,7 @@ def llama():
 def engine(llama):
     """Shared engine for the stateless-between-runs tests (each test
     must drain it: run_until_idle leaves every slot free again)."""
-    return ServeEngine(llama, num_slots=4, max_len=32, prefill_len=12)
+    return ServeEngine(llama, num_slots=4, max_len=32, block_size=8)
 
 
 def _prompts(n, lens, vocab=256, seed=7):
@@ -76,7 +81,7 @@ class TestGreedyEquivalence:
         prompt = _prompts(1, [6], seed=11)[0]
         ref = llama.generate(prompt[None], max_new_tokens=8,
                              param_dtype=jnp.bfloat16)[0, 6:]
-        eng = ServeEngine(llama, num_slots=2, max_len=24, prefill_len=8,
+        eng = ServeEngine(llama, num_slots=2, max_len=24, block_size=8,
                           param_dtype=jnp.bfloat16)
         assert eng.pool.caches[0][0].dtype == jnp.bfloat16
         h = eng.submit(prompt, max_new_tokens=8)
@@ -93,7 +98,7 @@ class TestGreedyEquivalence:
         prompts = _prompts(3, [4, 6, 9])
         refs = [m.generate(p[None], max_new_tokens=6)[0, p.size:]
                 for p in prompts]
-        eng = ServeEngine(m, num_slots=2, max_len=24, prefill_len=10)
+        eng = ServeEngine(m, num_slots=2, max_len=24, block_size=8)
         hs = [eng.submit(p, max_new_tokens=6) for p in prompts]
         eng.run_until_idle()
         for ref, h in zip(refs, hs):
@@ -149,11 +154,16 @@ class TestAdmissionControl:
         assert h.done and h.finish_reason == "length"
         assert engine.metrics.admitted - adm0 == 9
 
-    def test_oversized_requests_refused_at_the_door(self, engine):
-        with pytest.raises(ValueError, match="prefill_len"):
-            engine.submit(np.zeros(13, np.int32), max_new_tokens=2)
+    def test_oversized_requests_refused_at_the_door(self, engine, llama):
         with pytest.raises(ValueError, match="max_len"):
             engine.submit(np.zeros(10, np.int32), max_new_tokens=30)
+        # the PR 2 prefill_len cap is GONE: chunked prefill serves any
+        # prompt that leaves room for its token budget under max_len
+        long_p = _prompts(1, [27], seed=21)[0]
+        ref = llama.generate(long_p[None], max_new_tokens=5)[0, 27:]
+        h = engine.submit(long_p, max_new_tokens=5)
+        engine.run_until_idle()
+        np.testing.assert_array_equal(ref, np.asarray(h.tokens))
 
     def test_deadline_evicts_queued_and_running(self, engine):
         import time
@@ -229,6 +239,163 @@ class TestStreamingAndMetrics:
         assert snap["evicted"] == {"length": 2}
         assert snap["ttft_ms"]["count"] == 2
         assert snap["token_ms"]["count"] == 4   # 2 reqs x 2 decode tokens
+
+
+class TestPrefixSharing:
+    """ISSUE 6 satellite: prefix-cache sharing correctness — streams
+    token-identical to independent generate() calls, refcounts drain
+    to zero, and a referenced shared block can never be evicted."""
+
+    def _shared_prompts(self, n_suffixes=2, prefix_len=19, seed=3):
+        rng = np.random.RandomState(seed)
+        sysp = rng.randint(0, 256, (prefix_len,)).astype(np.int32)
+        sufs = [rng.randint(0, 256, (4 + 3 * i,)).astype(np.int32)
+                for i in range(n_suffixes)]
+        return [np.concatenate([sysp, s]) for s in sufs]
+
+    def test_divergent_suffixes_match_independent_generate(self, llama,
+                                                           engine):
+        """Two requests share a 19-token system prompt (2 full blocks
+        at block_size 8) with divergent suffixes, CONCURRENTLY: the
+        second maps the first's prompt blocks copy-free (visible in
+        serve.prefix_hit_tokens) and both streams equal their
+        independent generate() references."""
+        prompts = self._shared_prompts()
+        refs = [llama.generate(p[None], max_new_tokens=6)[0, p.size:]
+                for p in prompts]
+        hits0 = engine.metrics.prefix_hit_tokens
+        hs = [engine.submit(p, max_new_tokens=6) for p in prompts]
+        engine.run_until_idle()
+        for ref, h in zip(refs, hs):
+            np.testing.assert_array_equal(ref, np.asarray(h.tokens))
+        # the second admission skipped its 2 shared prompt blocks
+        assert engine.metrics.prefix_hit_tokens - hits0 == 16
+        assert engine.compiled_counts() == (1, 1)
+
+    def test_refcounts_drain_to_zero_after_both_finish(self, llama,
+                                                       engine):
+        prompts = self._shared_prompts(seed=13)
+        hs = [engine.submit(p, max_new_tokens=5) for p in prompts]
+        engine.step()               # both running: shared blocks ref=2
+        shared = [b for b in range(engine.pool.num_blocks)
+                  if engine.pool.ref[b] > 1]
+        assert shared, "no block was actually shared while both ran"
+        engine.run_until_idle()
+        assert all(h.done for h in hs)
+        assert (engine.pool.ref == 0).all()
+        # content survives refcount-0 (evictable, reusable): a third
+        # request with the same prefix still hits
+        h3 = engine.submit(prompts[0], max_new_tokens=3)
+        engine.run_until_idle()
+        assert h3.done
+        assert engine.metrics.prefix_hits >= 1
+
+    def test_evicting_referenced_block_is_impossible(self, llama,
+                                                     engine):
+        """The pool's core invariant, asserted at the eviction site: a
+        block any request still references can never be reclaimed —
+        even if it is (wrongly) offered to the LRU."""
+        h = engine.submit(self._shared_prompts(seed=17)[0],
+                          max_new_tokens=6)
+        engine.step()               # running: its blocks have ref >= 1
+        pool = engine.pool
+        held = next(b for b in range(pool.num_blocks) if pool.ref[b] > 0)
+        pool._lru[held] = None      # corrupt: evictable-while-referenced
+        taken = []
+        with pytest.raises(AssertionError, match="refcount"):
+            while True:             # drain the free list into the evictor
+                got = pool.alloc_blocks(1)
+                assert got is not None
+                taken.append(got[0])
+        pool.free_blocks(taken)     # restore the shared engine's pool
+        # the refused eviction must not have freed the referenced block
+        assert held not in pool._lru
+        assert pool.ref[held] >= 1
+        engine.run_until_idle()
+        assert h.done
+
+    def test_share_prefix_off_never_hits(self, llama):
+        eng = ServeEngine(llama, num_slots=2, max_len=32, block_size=8,
+                          share_prefix=False)
+        prompts = self._shared_prompts(seed=23)
+        refs = [llama.generate(p[None], max_new_tokens=4)[0, p.size:]
+                for p in prompts]
+        hs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+        eng.run_until_idle()
+        for ref, h in zip(refs, hs):
+            np.testing.assert_array_equal(ref, np.asarray(h.tokens))
+        assert eng.metrics.prefix_hits == 0
+        assert eng.metrics.prefix_hit_tokens == 0
+
+
+class TestPagedArena:
+    """Admission counts free blocks (not slots), decode grows block
+    tables in place, and an exhausted pool preempts — never corrupts —
+    a stream."""
+
+    def test_admission_defers_until_blocks_free(self, llama):
+        """9 slot rows but only enough physical blocks for two 23-token
+        prompts: the third request waits for BLOCKS even though 7 slot
+        rows are free, then completes correctly once blocks release."""
+        eng = ServeEngine(llama, num_slots=9, max_len=32, block_size=8,
+                          num_blocks=9)      # 8 usable blocks
+        prompts = _prompts(3, [23], seed=31)
+        refs = [llama.generate(p[None], max_new_tokens=9)[0, 23:]
+                for p in prompts]
+        hs = [eng.submit(p, max_new_tokens=9) for p in prompts]
+        eng.step()
+        # each prompt needs 3 blocks at admission: two admit (6 of 8
+        # blocks), the third defers on blocks, not slots
+        assert eng.pool.active_count == 2
+        assert eng.pool.free_count == 7
+        eng.run_until_idle()
+        for ref, h in zip(refs, hs):
+            np.testing.assert_array_equal(ref, np.asarray(h.tokens))
+        assert eng.compiled_counts() == (1, 1)
+        assert (eng.pool.ref == 0).all()
+
+    def test_preemption_keeps_streams_bit_identical(self, llama):
+        """Both requests outgrow the pool mid-decode: the youngest is
+        preempted (blocks released, requeued at the head, replayed)
+        and every stream still equals its reference."""
+        eng = ServeEngine(llama, num_slots=2, max_len=32, block_size=8,
+                          num_blocks=6)      # 5 usable blocks
+        prompts = _prompts(2, [7], seed=37)
+        refs = [llama.generate(p[None], max_new_tokens=16)[0, 7:]
+                for p in prompts]
+        hs = [eng.submit(p, max_new_tokens=16) for p in prompts]
+        eng.run_until_idle()
+        for ref, h in zip(refs, hs):
+            np.testing.assert_array_equal(ref, np.asarray(h.tokens))
+        assert eng.metrics.preempted >= 1
+        assert eng.compiled_counts() == (1, 1)
+
+
+def test_loadgen_quick_run_emits_valid_record(llama, engine, tmp_path):
+    """tools/loadgen.py end-to-end against the shared engine: an
+    open-loop burst completes, every request is accounted for
+    (completed + shed + deadline + rejected + failed == offered), and
+    the serve_load record validates against the schema."""
+    from singa_tpu.obs import record as obs_record
+    from tools import loadgen
+
+    wl = loadgen.build_workload(10, rate_rps=500.0, seed=5,
+                                prompt_lens=(4, 6), new_tokens=(2, 3),
+                                tenants=2, shared_len=8)
+    payload = loadgen.run_load(engine, wl, deadline_s=30.0)
+    assert payload["requests"] == 10
+    accounted = (payload["completed"] + payload["shed"]
+                 + payload["rejected"]
+                 + payload["detail"]["deadline_evicted"]
+                 + payload["detail"]["quarantined"])
+    assert accounted == 10
+    store = loadgen.append_record(payload,
+                                  str(tmp_path / "records.jsonl"))
+    assert obs_record.RunRecord(store).validate() == []
+    entry = obs_record.RunRecord(store).entries()[0]
+    assert entry["kind"] == "serve_load"
+    assert engine.pending == 0
+    assert engine.compiled_counts() == (1, 1)
 
 
 class TestHistogramPrimitive:

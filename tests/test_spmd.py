@@ -217,6 +217,8 @@ def test_ring_spec_tp_heads_sharded():
         parallel.set_mesh(None)
 
 
+@pytest.mark.slow  # 37 s Pallas-interpret composition sweep: the
+# einsum ring stays tier-1 above, per-kernel flash parity in test_flash
 def test_ring_attention_flash_blocks_match_einsum():
     """SP x flash composition: per-block Pallas flash (interpret mode on
     CPU) + cross-block lse merge must equal the einsum ring, forward and
@@ -254,6 +256,8 @@ def test_ring_attention_flash_blocks_match_einsum():
                                        err_msg=f"grad causal={causal}")
 
 
+@pytest.mark.slow  # 18 s Pallas-interpret variant (see above); GQA
+# head-grouping correctness stays tier-1 in test_flash / ops tests
 def test_ring_attention_flash_gqa_no_replication():
     """Flash ring blocks consume grouped-query KV natively: result must
     equal the einsum ring on pre-repeated heads (fwd + grads)."""

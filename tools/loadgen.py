@@ -1,0 +1,242 @@
+"""Open-loop traffic generator for the paged serving engine.
+
+Closed-loop drivers (submit, wait, submit) let a slow server set its
+own pace and hide queueing collapse; an OPEN-loop generator arrives on
+its own clock — Poisson inter-arrivals at a configured rate, mixed
+prompt/output lengths, a tenant mix whose requests share per-tenant
+system prompts — so scheduler and paging changes are judged on what
+production cares about: p99 TTFT, tokens/s, and how gracefully load is
+shed when the offered rate exceeds capacity.
+
+    python -m tools.loadgen --rate 20 --requests 80 --deadline 10
+    SINGA_FAULTS="serve.decode=error:every=40" python -m tools.loadgen ...
+
+The run drives ``ServeEngine.step()`` directly (arrivals are submitted
+the tick their timestamp passes; ``QueueFull`` rejections count as
+overload outcomes, not errors) and reports SLO percentiles from the
+engine's obs histograms.  The headline lands in the run-record store as
+a ``serve_load`` entry (``obs/schema.py``; linted by ``python -m
+tools.lint --records``) with the offered/completed/shed/rejected
+counts and TTFT p50/p99 — and the whole thing is runnable under a
+``SINGA_FAULTS`` chaos plan, where the resilience claim is simply "the
+engine finished the run" (every fired fault shows up in the detail).
+
+Importable: :func:`build_workload` + :func:`run_load` are used by
+tests/test_serve.py against a prebuilt engine (the CLI builds its own
+model on CPU).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@dataclass
+class _Arrival:
+    at_s: float
+    prompt: np.ndarray
+    max_new: int
+    tenant: int
+
+
+def build_workload(n_requests: int, rate_rps: float, seed: int, *,
+                   prompt_lens: Sequence[int] = (6, 10, 16, 24),
+                   new_tokens: Sequence[int] = (4, 8, 16),
+                   tenants: int = 3, shared_len: int = 16,
+                   vocab: int = 256) -> List[_Arrival]:
+    """A reproducible open-loop trace: Poisson arrivals at ``rate_rps``,
+    prompts drawn as ``tenant system prefix (shared_len tokens) +
+    private suffix (prompt_lens mix)``, output budgets from
+    ``new_tokens``.  ``tenants=0`` or ``shared_len=0`` disables
+    sharing (every prompt fully private)."""
+    rng = np.random.RandomState(seed)
+    at = np.cumsum(rng.exponential(1.0 / rate_rps, n_requests))
+    prefixes = [rng.randint(0, vocab, (shared_len,)).astype(np.int32)
+                for _ in range(tenants)] if tenants and shared_len else []
+    out = []
+    for i in range(n_requests):
+        tenant = int(rng.randint(0, tenants)) if prefixes else -1
+        suffix = rng.randint(
+            0, vocab,
+            (int(prompt_lens[rng.randint(0, len(prompt_lens))]),)
+        ).astype(np.int32)
+        prompt = (np.concatenate([prefixes[tenant], suffix])
+                  if prefixes else suffix)
+        out.append(_Arrival(float(at[i]), prompt,
+                            int(new_tokens[rng.randint(0,
+                                                       len(new_tokens))]),
+                            tenant))
+    return out
+
+
+def run_load(engine, workload: List[_Arrival], *,
+             deadline_s: Optional[float] = None,
+             eos_id: Optional[int] = None,
+             max_wall_s: float = 300.0) -> dict:
+    """Drive ``engine`` through ``workload`` open-loop and return the
+    ``serve_load`` payload (plus a ``detail`` sub-dict that is NOT part
+    of the schema contract).  Never raises on overload outcomes —
+    ``QueueFull`` is a counted result; only an engine CRASH (the thing
+    chaos runs assert cannot happen) propagates."""
+    from singa_tpu.serve import QueueFull
+
+    handles = []
+    n = len(workload)
+    i = 0
+    t0 = time.monotonic()
+    while True:
+        now = time.monotonic() - t0
+        while i < n and workload[i].at_s <= now:
+            try:
+                handles.append(engine.submit(
+                    workload[i].prompt,
+                    max_new_tokens=workload[i].max_new,
+                    deadline_s=deadline_s, eos_id=eos_id))
+            except QueueFull:
+                handles.append(None)       # counted via metrics.rejected
+            i += 1
+        if engine.pending:
+            engine.step()
+        elif i < n:
+            # idle gap before the next arrival: sleep it off instead of
+            # spinning (open loop — we must not pull arrivals early)
+            time.sleep(min(workload[i].at_s - now, 0.05))
+        else:
+            break
+        if now > max_wall_s:
+            break
+    wall = time.monotonic() - t0
+    snap = engine.metrics.snapshot()
+    done = [h for h in handles if h is not None]
+    completed = sum(1 for h in done
+                    if h.finish_reason in ("eos", "length"))
+    tokens = sum(len(h.tokens) for h in done)
+    ttft = snap["ttft_ms"] or {}
+    payload = {
+        "requests": n,
+        "completed": completed,
+        "shed": int(snap["evicted"].get("shed", 0)),
+        "rejected": int(snap["rejected"]),
+        "tokens_per_s": round(tokens / wall, 1) if wall else 0.0,
+        "ttft_p50_ms": round(ttft.get("p50", 0.0), 3),
+        "ttft_p99_ms": round(ttft.get("p99", 0.0), 3),
+    }
+    payload["detail"] = {
+        "wall_s": round(wall, 3),
+        "generated_tokens": tokens,
+        "deadline_evicted": int(snap["evicted"].get("deadline", 0)),
+        "quarantined": int(snap["quarantined"]),
+        "preempted": int(snap["preempted"]),
+        "recoveries": int(snap["recoveries"]),
+        "prefix_hits": int(snap["prefix_hits"]),
+        "prefix_hit_tokens": int(snap["prefix_hit_tokens"]),
+        "retries": dict(snap["retries"]),
+        "token_p50_ms": round((snap["token_ms"] or {}).get("p50", 0.0),
+                              3),
+    }
+    return payload
+
+
+def append_record(payload: dict, store: Optional[str] = None) -> str:
+    """Write the headline (schema-required fields + numeric extras;
+    the ``detail`` sub-dict stays out of the durable record) as a
+    ``serve_load`` entry.  Returns the store path."""
+    import jax
+
+    from singa_tpu.obs import record as obs_record
+    from singa_tpu.obs import schema
+
+    body = {k: v for k, v in payload.items() if k != "detail"}
+    body.update({k: v for k, v in payload["detail"].items()
+                 if isinstance(v, (int, float))})
+    platform = jax.default_backend()
+    dev = jax.devices()[0]
+    entry = obs_record.new_entry(
+        "serve_load", platform, platform != "tpu",
+        getattr(dev, "device_kind", "") or platform,
+        run_id=obs_record.new_run_id("load"), payload=body)
+    schema.validate_entry(entry)           # fail before touching disk
+    store = store or os.path.join(_REPO, obs_record.DEFAULT_STORE)
+    obs_record.RunRecord(store).append(entry)
+    return store
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="open-loop Poisson traffic through the paged "
+                    "serving engine (SLO readout + serve_load record)")
+    ap.add_argument("--requests", type=int, default=60)
+    ap.add_argument("--rate", type=float, default=20.0,
+                    help="offered arrivals/s (push past capacity to "
+                         "study overload)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--tenants", type=int, default=3,
+                    help="tenant count for the shared-prefix mix "
+                         "(0 = no sharing)")
+    ap.add_argument("--shared-prefix", type=int, default=16,
+                    help="system-prompt tokens shared per tenant")
+    ap.add_argument("--deadline", type=float, default=30.0,
+                    help="per-request SLO deadline (s); drives "
+                         "shedding under overload")
+    ap.add_argument("--num-slots", type=int, default=8)
+    ap.add_argument("--max-len", type=int, default=64)
+    ap.add_argument("--block-size", type=int, default=8)
+    ap.add_argument("--num-blocks", type=int, default=None)
+    ap.add_argument("--no-share", action="store_true",
+                    help="disable prefix-cache sharing in the engine")
+    ap.add_argument("--store", default=None,
+                    help="run-record store path (default: "
+                         "runs/records.jsonl)")
+    ap.add_argument("--no-record", action="store_true")
+    args = ap.parse_args(argv)
+
+    from singa_tpu import models, tensor
+    from singa_tpu.obs import record as obs_record
+    from singa_tpu.serve import ServeEngine
+
+    # one resolved store for BOTH record producers: the engine's
+    # incident entries (quarantine/recovery under chaos) and the final
+    # serve_load headline — otherwise a default-args chaos soak would
+    # silently drop its incident evidence
+    store = (None if args.no_record else
+             args.store or os.path.join(_REPO, obs_record.DEFAULT_STORE))
+
+    tensor.set_seed(0)
+    m = models.Llama(models.LlamaConfig.tiny())
+    m.eval()
+    m.compile([tensor.from_numpy(np.zeros((1, 4), np.int32))],
+              is_train=False, use_graph=False)
+    eng = ServeEngine(m, args.num_slots, args.max_len,
+                      block_size=args.block_size,
+                      num_blocks=args.num_blocks,
+                      share_prefix=not args.no_share,
+                      backoff_base=0.005, backoff_max=0.05,
+                      # a chaos soak may recover many times; the
+                      # engine-default budget of 2 is tuned for unit
+                      # scenarios, not sustained injection
+                      max_recoveries=100,
+                      record_store=store)
+    wl = build_workload(args.requests, args.rate, args.seed,
+                        tenants=args.tenants,
+                        shared_len=args.shared_prefix,
+                        vocab=m.cfg.vocab_size)
+    payload = run_load(eng, wl, deadline_s=args.deadline)
+    print(json.dumps(payload, indent=2))
+    if store is not None:
+        append_record(payload, store)
+        print(f"# serve_load entry appended to {store}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
