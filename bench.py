@@ -943,6 +943,7 @@ def main() -> None:
                                "vs_baseline": 0.0})
         platform = "none"
     _record_bench(headline, platform)
+    _record_hlo_audit()
     # The driver parses a bounded tail of this process's output
     # (BENCH_r03: stderr noise after the early headline pushed it out of
     # the capture).  The LAST stdout line is always the headline JSON.
@@ -968,6 +969,45 @@ def _record_bench(headline: str, platform: str) -> None:
         print(f"# bench entry appended to {store}", file=sys.stderr)
     except Exception as e:  # noqa: BLE001
         print(f"# bench store append failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+
+def _record_hlo_audit() -> None:
+    """Append the compiled-program audit summary (tools/lint/hlo.py —
+    fusion/collective/donation structure of the flagship train and
+    serve programs) to the run-record store next to the bench headline,
+    so the structural drift history accumulates with the perf
+    trajectory: when a future headline moves, runs/records.jsonl can
+    answer "did the compiled program change underneath it".
+
+    Runs in a CPU subprocess — the gate pins the virtual-CPU backend
+    itself, so this can never touch the axon tunnel no matter which
+    platform the bench ran on.  Never fatal: the stdout contract
+    outranks telemetry."""
+    import subprocess
+    try:
+        from singa_tpu.utils.virtcpu import with_device_count_flag
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = with_device_count_flag(
+            env.get("XLA_FLAGS", ""), 8)
+        env["JAX_PLATFORMS"] = "cpu"
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.lint", "--hlo", "--json"],
+            env=env, capture_output=True, text=True, timeout=180,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        doc = json.loads(r.stdout)       # emitted for exit 0 AND 1
+        from singa_tpu.obs import record as obs_record
+        entry = obs_record.new_entry(
+            "hlo_audit", "cpu", True, "cpu",
+            run_id=obs_record.new_run_id("hloaudit"),
+            payload=doc["hlo"])
+        store = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             obs_record.DEFAULT_STORE)
+        obs_record.RunRecord(store).append(entry)
+        print(f"# hlo_audit entry appended to {store} "
+              f"(drifted={doc['hlo']['drifted']})", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001
+        print(f"# hlo_audit record skipped: {type(e).__name__}: {e}",
               file=sys.stderr)
 
 

@@ -38,13 +38,14 @@ __all__ = ["SCHEMA_VERSION", "SchemaError", "require", "validate_entry",
            "validate_stage", "validate_session_doc", "validate_bench_doc",
            "validate_multichip_doc", "validate_serve_payload",
            "validate_serve_load_payload", "validate_train_run_payload",
-           "validate_incident_payload", "entry_key"]
+           "validate_incident_payload", "validate_hlo_audit_payload",
+           "entry_key"]
 
 #: bump when entry fields change incompatibly; validators dispatch on it
 SCHEMA_VERSION = 1
 
 _KINDS = ("session", "bench", "serve_throughput", "serve_load",
-          "train_run", "incident")
+          "train_run", "incident", "hlo_audit")
 
 #: required numeric payload fields of a serve_throughput entry — the
 #: serving bench's headline quantities (tools/record_check.py lints
@@ -65,6 +66,14 @@ _SERVE_LOAD_FIELDS = ("requests", "completed", "shed", "rejected",
 #: every run: how far it got, how long it took, how many checkpoints
 #: it landed, and where it resumed from (-1 = fresh start)
 _TRAIN_RUN_FIELDS = ("steps", "wall_s", "ckpt_count", "resumed_from")
+
+#: required numeric payload fields of an hlo_audit entry — one run of
+#: the compiled-program invariant gate (tools/lint/hlo.py): how many
+#: flagship programs were lowered, how many findings drifted, and the
+#: aggregate structural quantities (fusions, collectives, while loops)
+#: whose trajectory the drift history tracks next to the perf records
+_HLO_AUDIT_FIELDS = ("programs", "drifted", "fusions", "collectives",
+                     "while_loops")
 
 #: required string payload fields of an incident entry — one fired
 #: fault or recovery action (singa_tpu.faults / ServeEngine resilience):
@@ -180,6 +189,8 @@ def validate_entry(entry: Any, ctx: str = "entry") -> None:
             validate_train_run_payload(payload, f"{ctx}: train_run payload")
         elif kind == "incident":
             validate_incident_payload(payload, f"{ctx}: incident payload")
+        elif kind == "hlo_audit":
+            validate_hlo_audit_payload(payload, f"{ctx}: hlo_audit payload")
 
 
 def _require_numeric_fields(payload: Any, fields: Tuple[str, ...],
@@ -216,6 +227,15 @@ def validate_train_run_payload(payload: Any,
     ``_TRAIN_RUN_FIELDS`` present and numeric, so a run that aborted
     mid-write can never masquerade as a complete record."""
     _require_numeric_fields(payload, _TRAIN_RUN_FIELDS, ctx)
+
+
+def validate_hlo_audit_payload(payload: Any,
+                               ctx: str = "hlo_audit payload") -> None:
+    """One compiled-program audit run: every field in
+    ``_HLO_AUDIT_FIELDS`` present and numeric — a drift-history entry
+    whose counts went missing could not answer 'when did the fusion
+    count change' later, which is the entire point of keeping it."""
+    _require_numeric_fields(payload, _HLO_AUDIT_FIELDS, ctx)
 
 
 def validate_incident_payload(payload: Any,

@@ -294,8 +294,29 @@ class ServeEngine:
     # -- introspection ----------------------------------------------------
     def compiled_counts(self):
         """(prefill, decode) jit-cache entry counts — the no-recompile
-        invariant says both stay at 1 after warmup (tested)."""
+        invariant says both stay at 1 after warmup (tested via
+        tools.lint.hlo.assert_program_count, shared with the HLO gate)."""
         return (self._prefill._cache_size(), self._decode._cache_size())
+
+    def lower_programs(self):
+        """jax ``Lowered`` handles of the exactly-two programs, keyed
+        ``prefill_chunk`` / ``decode`` — the hook ``tools/lint/hlo.py``
+        compiles to optimized HLO and audits (fusions, donation of the
+        KV arena, op histogram).  Lowering is abstract: nothing
+        executes, nothing is donated, and the jit caches
+        (:meth:`compiled_counts`) are untouched.  The traced shapes are
+        exactly the runtime dispatch shapes, so the audited modules ARE
+        the serving modules."""
+        bs = self.pool.block_size
+        zero = jnp.asarray(0, jnp.int32)
+        prefill = self._prefill.lower(
+            self._params, self._buffers, jnp.zeros((1, bs), jnp.int32),
+            zero, jnp.asarray(bs - 1, jnp.int32), zero,
+            self.pool.tables, self._toks, self.pool.caches)
+        decode = self._decode.lower(
+            self._params, self._buffers, self._toks, self.pool.pos,
+            self.pool.active, self.pool.tables, self.pool.caches)
+        return {"prefill_chunk": prefill, "decode": decode}
 
     @property
     def pending(self) -> int:
@@ -623,7 +644,7 @@ class ServeEngine:
                          jnp.asarray(slot, jnp.int32),
                          self.pool.tables, self._toks, self.pool.caches),
                         rid=req.rid)
-                tok = int(np.asarray(self._toks)[slot])
+                tok = int(np.asarray(self._toks)[slot])  # singalint: disable=SGL008 the designed per-admission sync: one num_slots-int fetch delivers the prefill token
         except (RuntimeError, OSError) as e:
             if isinstance(e, failure.FailureDetected):
                 raise
@@ -721,7 +742,7 @@ class ServeEngine:
                  self.pool.pos, self.pool.active, self.pool.tables,
                  self.pool.caches),
                 active=len(self._running))
-            toks = np.asarray(self._toks)    # tiny fetch: num_slots ints
+            toks = np.asarray(self._toks)    # singalint: disable=SGL008 the designed per-tick sync: ONE num_slots-int fetch per decode dispatch is the engine's hot-loop host traffic
         self.pool.pos = new_pos
         dt = time.perf_counter() - t0
         delivered = 0

@@ -32,6 +32,7 @@ from singa_tpu.obs import record as obs_record
 from singa_tpu.obs import schema
 from singa_tpu.serve import EngineClosed, ServeEngine
 from singa_tpu.utils.data import DataLoader
+from tools.lint.hlo import assert_program_count
 
 
 @pytest.fixture(autouse=True)
@@ -443,7 +444,7 @@ def baseline(engine):
     hs = [engine.submit(p, max_new_tokens=6)
           for p in _prompts([4, 6, 8])]
     engine.run_until_idle()
-    assert engine.compiled_counts() == (1, 1)
+    assert_program_count(engine, (1, 1))
     return [h.tokens for h in hs]
 
 
@@ -480,7 +481,7 @@ class TestServeChaos:
         assert poisoned.finish_reason == "quarantined"
         assert "prefill failed" in poisoned.error
         assert engine.pending == 0
-        assert engine.compiled_counts() == (1, 1)
+        assert_program_count(engine, (1, 1))
         # 3 poisoned-prefill fires + 1 hang + 2 decode errors
         assert plan.fire_count() == 6
         assert engine.metrics.retries.get("serve.decode") == 2
@@ -504,7 +505,7 @@ class TestServeChaos:
         engine.run_until_idle()
         assert [h.tokens for h in hs] == baseline
         assert engine.metrics.recoveries == before + 2
-        assert engine.compiled_counts() == (1, 1)
+        assert_program_count(engine, (1, 1))
 
     def test_recovery_replays_long_prompts(self, engine, llama):
         """PR 2's fixed arena failed a replay past prefill_len as
@@ -523,7 +524,7 @@ class TestServeChaos:
         np.testing.assert_array_equal(ref_long, np.asarray(h_long.tokens))
         np.testing.assert_array_equal(ref_short,
                                       np.asarray(h_short.tokens))
-        assert engine.compiled_counts() == (1, 1)
+        assert_program_count(engine, (1, 1))
 
     def test_block_alloc_fault_mid_stream_recovers_bit_identical(
             self, engine, baseline):
@@ -545,7 +546,7 @@ class TestServeChaos:
         assert plan.fire_count() == 1
         assert [h.tokens for h in hs] == baseline
         assert engine.metrics.recoveries == before + 1
-        assert engine.compiled_counts() == (1, 1)
+        assert_program_count(engine, (1, 1))
         # the rebuilt pool's refcounts are consistent: fully drained
         assert (engine.pool.ref == 0).all()
         assert engine.pool.free_count == engine.pool.num_slots
@@ -592,7 +593,7 @@ class TestServeChaos:
         # stream crosses its first block boundary (6 + 2 = 8)
         assert probe.calls["serve.block_alloc"] == 3
         assert probe.fired == []
-        assert engine.compiled_counts() == (1, 1)
+        assert_program_count(engine, (1, 1))
 
     def test_run_until_idle_terminates_when_all_deadline_evicted(
             self, engine):
@@ -728,7 +729,7 @@ class TestHangRecoverySlow:
             engine.run_until_idle()
         assert [h.tokens for h in hs] == baseline
         assert engine.metrics.recoveries >= 1
-        assert engine.compiled_counts() == (1, 1)
+        assert_program_count(engine, (1, 1))
 
     def test_heartbeat_hang_drives_recovery(self, llama, engine,
                                             baseline):

@@ -1,0 +1,294 @@
+"""hloaudit (ISSUE 7) — the compiled-program invariant gate
+(tools/lint/hlo.py), tier-1 lean.
+
+The invariants under test are the gate's contract:
+  * the committed baselines under tools/lint/data/hlo/ are CLEAN
+    against a fresh lowering of all four flagship programs — so any
+    future change that moves a fusion, collective, donation or opcode
+    fails CI with a named finding until it is reviewed via
+    ``--update-baselines``;
+  * a deliberately defused CE-chunk variant (fused_loss=False) is
+    flagged (exit 1, HLO002 fusion finding) and a collective moved
+    in/out of the loop body is flagged (HLO004) — the two seeded
+    regressions the acceptance criteria name;
+  * ``--update-baselines`` roundtrips (update -> clean -> mutate ->
+    findings -> update -> clean) and prints a human-readable diff;
+  * baseline waivers follow the singalint suppression contract
+    (reason REQUIRED, unknown codes are findings, HLO000 unwaivable);
+  * the ``hlo_audit`` record kind roundtrips through the obs schema
+    (the record_check CI contract for the drift history).
+
+Budget discipline: ONE module fixture lowers all four programs
+(~15 s); every other test diffs summaries in memory.  The defused
+variant is the only extra compile.
+"""
+
+import json
+import os
+
+import pytest
+
+from tools.lint import hlo
+from tools.lint.__main__ import main as lint_main
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture(scope="module")
+def summaries():
+    """All four flagship programs lowered + summarized ONCE — the
+    file's whole compile budget; tests share and never mutate it."""
+    return hlo.flagship_summaries()
+
+
+def codes_of(findings):
+    return [f.code for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: committed baselines are clean
+# ---------------------------------------------------------------------------
+
+def test_committed_baselines_are_clean(summaries):
+    """`python -m tools.lint --hlo` exits 0 on this tree: the lowered
+    flagship programs match tools/lint/data/hlo/ exactly.  A finding
+    here means a perf-relevant structural change — review it, then
+    re-baseline with `--hlo --update-baselines` (docs/static-analysis.md
+    has the policy)."""
+    findings = hlo.gate_findings(summaries)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_summaries_encode_the_flagship_invariants(summaries):
+    """The metrics the gate protects are non-vacuous in the baselines:
+    the CE-chunk scan IS a while loop, the train step DOES donate
+    params/opt state, the DP step DOES carry collectives, and both
+    serve programs DO donate the KV arena."""
+    for name, s in summaries.items():
+        assert s["schema"] == hlo.SUMMARY_SCHEMA
+        assert s["program"] == name
+        assert s["fusions"]["total"] == sum(s["fusions"]["kinds"].values())
+        assert s["fusions"]["total"] > 0
+        assert s["op_histogram"].get("fusion") == s["fusions"]["total"]
+        assert s["entry_params"] > 0
+    assert summaries["train_step"]["while_loops"] >= 1
+    assert summaries["train_step"]["donated_outputs"] > 0
+    assert summaries["train_step"]["collectives"]["total"] == 0
+    assert summaries["train_step_dp2"]["collectives"]["total"] > 0
+    assert "all-reduce" in \
+        summaries["train_step_dp2"]["collectives"]["by_op"]
+    assert summaries["prefill_chunk"]["donated_outputs"] > 0
+    assert summaries["decode"]["donated_outputs"] > 0
+
+
+# ---------------------------------------------------------------------------
+# seeded regressions (the acceptance scenarios)
+# ---------------------------------------------------------------------------
+
+def test_defused_ce_chunk_is_flagged_with_exit_1(summaries, monkeypatch):
+    """A train step whose CE-chunk fusion is broken (fused_loss=False —
+    the (B*T, V) logits materialize again) must fail the gate: exit 1
+    and a named HLO002 fusion finding for train_step."""
+    txt = hlo.lower_train_step(fused_loss=False)
+    broken = dict(summaries)
+    broken["train_step"] = hlo.summarize_hlo(txt, "train_step")
+    findings = hlo.gate_findings(broken)
+    assert "HLO002" in codes_of(findings)
+    assert all("[train_step]" in f.message for f in findings)
+    fus = [f for f in findings if f.code == "HLO002"][0]
+    assert "fusion structure drifted" in fus.message
+    # and through the front door: `python -m tools.lint --hlo` exits 1
+    monkeypatch.setattr(hlo, "flagship_summaries",
+                        lambda programs=None: broken)
+    assert lint_main(["--hlo"]) == 1
+
+
+def test_moved_collective_is_flagged_with_exit_1(summaries, monkeypatch,
+                                                 capsys):
+    """A collective migrating between the entry computation and a loop
+    body (the overlap path) must fail the gate with the named HLO004
+    placement finding."""
+    real = summaries["train_step_dp2"]
+    moved = dict(summaries)
+    moved["train_step_dp2"] = dict(real, collectives=dict(
+        real["collectives"],
+        in_loop_body=real["collectives"]["total"]))
+    findings = hlo.gate_findings(moved)
+    assert codes_of(findings) == ["HLO004"]
+    assert "collective placement drifted" in findings[0].message
+    monkeypatch.setattr(hlo, "flagship_summaries",
+                        lambda programs=None: moved)
+    assert lint_main(["--hlo", "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["count"] == 1
+    assert doc["findings"][0]["code"] == "HLO004"
+
+
+# ---------------------------------------------------------------------------
+# --update-baselines roundtrip + waiver contract (in-memory, no compiles)
+# ---------------------------------------------------------------------------
+
+def test_update_baselines_roundtrip(summaries, tmp_path):
+    d = str(tmp_path / "hlo")
+    diff = hlo.update_baselines(summaries, d)
+    assert "NEW baseline" in diff
+    assert sorted(os.listdir(d)) == sorted(
+        f"{p}.json" for p in hlo.FLAGSHIP_PROGRAMS)
+    assert hlo.gate_findings(summaries, d) == []
+
+    # a lost donation drifts exactly one named metric...
+    mutated = dict(summaries)
+    mutated["decode"] = dict(summaries["decode"], donated_outputs=0)
+    findings = hlo.gate_findings(mutated, d)
+    assert codes_of(findings) == ["HLO005"]
+    assert "LOST" in findings[0].message
+    # ...and one reviewed update command accepts it, with a diff
+    diff2 = hlo.update_baselines(mutated, d)
+    assert "HLO005" in diff2 and "unchanged" in diff2
+    assert hlo.gate_findings(mutated, d) == []
+
+    # stale/missing baselines are loud in both directions
+    only = {"decode": mutated["decode"]}
+    stale = hlo.gate_findings(only, d)
+    assert codes_of(stale) == ["HLO001"] * 3
+    missing = hlo.gate_findings(summaries, str(tmp_path / "empty"))
+    assert codes_of(missing) == ["HLO001"] * 4
+    assert all("--update-baselines" in f.message for f in missing)
+
+
+def test_update_preserves_waivers_and_prunes_stale(summaries, tmp_path):
+    d = str(tmp_path / "hlo")
+    hlo.update_baselines(summaries, d)
+    # hand-add a waiver, then re-update: the waiver survives
+    path = os.path.join(d, "decode.json")
+    doc = json.load(open(path))
+    doc["suppress"] = {"HLO006": "tracked upstream XLA churn"}
+    json.dump(doc, open(path, "w"))
+    hlo.update_baselines(summaries, d)
+    assert json.load(open(path))["suppress"] == \
+        {"HLO006": "tracked upstream XLA churn"}
+    # a program that stops being lowered loses its baseline, loudly
+    subset = {p: s for p, s in summaries.items() if p != "decode"}
+    diff = hlo.update_baselines(subset, d)
+    assert "REMOVED" in diff
+    assert not os.path.exists(path)
+    assert hlo.gate_findings(subset, d) == []
+
+
+def test_baseline_waiver_contract(summaries, tmp_path):
+    """A waived metric stays quiet WITH a reason; an empty reason or an
+    unknown code is itself a finding (HLO000) — the singalint
+    suppression contract, ported to baselines."""
+    d = str(tmp_path / "hlo")
+    hlo.update_baselines(summaries, d)
+    path = os.path.join(d, "decode.json")
+    mutated = dict(summaries)
+    mutated["decode"] = dict(summaries["decode"], donated_outputs=0)
+
+    doc = json.load(open(path))
+    doc["suppress"] = {"HLO005": "arena aliasing unsupported here"}
+    json.dump(doc, open(path, "w"))
+    assert hlo.gate_findings(mutated, d) == []
+
+    doc["suppress"] = {"HLO005": "   "}
+    json.dump(doc, open(path, "w"))
+    out = hlo.gate_findings(mutated, d)
+    assert codes_of(out) == ["HLO000", "HLO005"]
+    assert "no reason" in out[0].message
+
+    doc["suppress"] = {"HLO942": "because"}
+    json.dump(doc, open(path, "w"))
+    out = hlo.gate_findings(mutated, d)
+    assert "HLO000" in codes_of(out) and "HLO005" in codes_of(out)
+    assert "HLO942" in out[0].message
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes + JSON schema (front door, lowering stubbed)
+# ---------------------------------------------------------------------------
+
+def test_cli_clean_exit_0_and_json_payload(summaries, monkeypatch,
+                                           capsys):
+    monkeypatch.setattr(hlo, "flagship_summaries",
+                        lambda programs=None: summaries)
+    assert lint_main(["--hlo"]) == 0
+    assert "hlo_audit: clean" in capsys.readouterr().out
+    assert lint_main(["--hlo", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == 1 and doc["count"] == 0
+    assert doc["findings"] == []
+    # the drift-history payload rides the JSON output (bench.py appends
+    # it to the record store)
+    assert doc["hlo"]["programs"] == len(summaries)
+    assert doc["hlo"]["drifted"] == 0
+    for k in ("fusions", "collectives", "while_loops"):
+        assert isinstance(doc["hlo"][k], int) and doc["hlo"][k] >= 0
+
+
+def test_cli_update_baselines_prints_reviewable_diff(summaries,
+                                                     monkeypatch,
+                                                     tmp_path, capsys):
+    monkeypatch.setattr(hlo, "flagship_summaries",
+                        lambda programs=None: summaries)
+    monkeypatch.setattr(hlo, "BASELINE_DIR", str(tmp_path / "hlo"))
+    assert lint_main(["--hlo", "--update-baselines"]) == 0
+    out = capsys.readouterr().out
+    assert "NEW baseline" in out and "baselines updated" in out
+    assert lint_main(["--hlo"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# the hlo_audit record kind (drift history in runs/records.jsonl)
+# ---------------------------------------------------------------------------
+
+def test_hlo_audit_record_schema_roundtrip(summaries, tmp_path):
+    """An hlo_audit store entry validates end-to-end (the record_check
+    CI contract); a truncated one is named-field rejected."""
+    from singa_tpu.obs import record as obs_record
+    from singa_tpu.obs import schema
+
+    payload = hlo.audit_payload(summaries, [])
+    assert payload["programs"] == len(summaries)
+    store = obs_record.RunRecord(str(tmp_path / "records.jsonl"))
+    entry = obs_record.new_entry("hlo_audit", "cpu", True, "cpu",
+                                 payload=payload)
+    store.append(entry)
+    assert store.validate() == []
+    bad = dict(entry)
+    bad["payload"] = {"programs": 4}
+    with pytest.raises(schema.SchemaError, match="drifted|fusions"):
+        schema.validate_entry(bad)
+
+
+# ---------------------------------------------------------------------------
+# the shared jit-cache helper (no jax)
+# ---------------------------------------------------------------------------
+
+class _FakeJitted:
+    def __init__(self, n):
+        self._n = n
+
+    def _cache_size(self):
+        return self._n
+
+
+class _FakeEngine:
+    def __init__(self, counts):
+        self._c = counts
+
+    def compiled_counts(self):
+        return self._c
+
+
+class TestAssertProgramCount:
+    def test_engine_form(self):
+        hlo.assert_program_count(_FakeEngine((1, 1)), (1, 1))
+        with pytest.raises(AssertionError, match="no-recompile"):
+            hlo.assert_program_count(_FakeEngine((1, 2)), (1, 1))
+
+    def test_function_forms(self):
+        hlo.assert_program_count(_FakeJitted(1), 1)
+        hlo.assert_program_count([_FakeJitted(1), _FakeJitted(2)], (1, 2))
+        with pytest.raises(AssertionError, match="expected \\(1, 1\\)"):
+            hlo.assert_program_count([_FakeJitted(1), _FakeJitted(2)],
+                                     (1, 1))

@@ -40,9 +40,9 @@ def lint(src, code):
 # rule catalogue
 # ---------------------------------------------------------------------------
 
-def test_catalogue_covers_the_six_invariants():
+def test_catalogue_covers_the_invariants():
     assert set(RULES) >= {"SGL001", "SGL002", "SGL003", "SGL004",
-                          "SGL005", "SGL006", "SGL007"}
+                          "SGL005", "SGL006", "SGL007", "SGL008"}
     for code, cls in RULES.items():
         assert cls.code == code and cls.name and cls.description
 
@@ -455,6 +455,75 @@ class TestRegistryRules:
 
 
 # ---------------------------------------------------------------------------
+# SGL008 host-sync hazard
+# ---------------------------------------------------------------------------
+
+class TestHostSync:
+    def test_fires_on_asarray_in_engine_step(self):
+        out = lint("""
+            import numpy as np
+
+            class FooEngine:
+                def step(self):
+                    toks = np.asarray(self._toks)
+                    return toks
+        """, "SGL008")
+        assert codes_of(out) == ["SGL008"]
+        assert "np.asarray" in out[0].message
+        assert "FooEngine.step()" in out[0].message
+
+    def test_fires_one_helper_level_deep(self):
+        out = lint("""
+            import jax
+
+            class BarRunner:
+                def run(self):
+                    self._emit()
+
+                def _emit(self):
+                    jax.device_get(self.loss)
+        """, "SGL008")
+        assert codes_of(out) == ["SGL008"]
+        assert "called from run()" in out[0].message
+
+    def test_fires_on_item_and_float_in_step_region(self):
+        out = lint("""
+            class BazRunner:
+                def _step_once(self, x):
+                    a = x.item()
+                    b = float(self.loss)
+                    return a + b
+        """, "SGL008")
+        assert codes_of(out) == ["SGL008", "SGL008"]
+
+    def test_clean_outside_hot_regions_and_classes(self):
+        # a cold method on a hot class, and a hot-named method on a
+        # cold class, are both out of scope
+        out = lint("""
+            import numpy as np
+
+            class FooEngine:
+                def snapshot(self):
+                    return np.asarray(self._toks)
+
+            class Helper:
+                def step(self):
+                    return np.asarray(self.buf)
+        """, "SGL008")
+        assert out == []
+
+    def test_suppression_with_reason_is_honored(self):
+        out = lint("""
+            import numpy as np
+
+            class FooEngine:
+                def step(self):
+                    return np.asarray(self._toks)  # singalint: disable=SGL008 one num_slots-int fetch per tick is the designed sync
+        """, "SGL008")
+        assert out == []
+
+
+# ---------------------------------------------------------------------------
 # suppression contract
 # ---------------------------------------------------------------------------
 
@@ -543,6 +612,55 @@ class TestOutputAndCli:
             lint_main(["singa_tpu", "--ckpt", "somedir"])
         with pytest.raises(SystemExit):
             lint_main(["--records", "--ckpt", "somedir"])
+        with pytest.raises(SystemExit):
+            lint_main(["singa_tpu", "--hlo"])
+        with pytest.raises(SystemExit):
+            lint_main(["--hlo", "--records"])
+
+    def test_cli_select_covers_audit_modes(self, tmp_path, monkeypatch):
+        """--select enumerates/filters audit modes alongside SGL codes:
+        mode names apply to the bare full-audit invocation only, and
+        ckpt (which needs its DIR) points at --ckpt."""
+        bad = tmp_path / "bad.py"
+        bad.write_text("import time\nt = time.time()\n")
+        # a mode name mixed with explicit lint paths is a usage error
+        with pytest.raises(SystemExit):
+            lint_main(["--select", "hlo", str(bad)])
+        with pytest.raises(SystemExit):
+            lint_main(["--select", "ckpt"])
+        # bare --select records runs just that audit (stubbed: jax)
+        from tools.lint import __main__ as cli
+        seen = []
+        monkeypatch.setattr(cli.audit, "records_main",
+                            lambda root: seen.append(root) or 0)
+        assert lint_main(["--select", "records"]) == 0
+        assert seen == [cli.audit._REPO_ROOT]
+
+    def test_bare_invocation_runs_static_and_hlo(self, monkeypatch,
+                                                 capsys):
+        """`python -m tools.lint` with no paths and no mode flags is
+        the full audit: static rules over the repo trees AND the HLO
+        gate (stubbed here — the real gate runs in test_hlo_audit.py),
+        exit code ORed across both halves."""
+        from tools.lint import __main__ as cli
+        from tools.lint import hlo as hlo_mod
+        calls = []
+
+        def fake_hlo_main(update=False, json_out=False, **kw):
+            calls.append(json_out)
+            return 0
+
+        monkeypatch.setattr(hlo_mod, "hlo_main", fake_hlo_main)
+        monkeypatch.setattr(
+            cli, "run_paths",
+            lambda paths, codes=None: [] if [p for p in paths] else [])
+        assert lint_main([]) == 0
+        assert calls == [False]
+        assert "singalint: clean" in capsys.readouterr().out
+        # a failing gate fails the full audit even when static is clean
+        monkeypatch.setattr(hlo_mod, "hlo_main",
+                            lambda **kw: 1)
+        assert lint_main([]) == 1
 
     def test_cli_records_root_resolution(self, monkeypatch):
         """Bare --records means repo root; an explicit '.' means cwd
@@ -556,9 +674,16 @@ class TestOutputAndCli:
         assert seen == [cli.audit._REPO_ROOT, "."]
 
     def test_cli_list_rules(self, capsys):
+        """The front door is discoverable from --list-rules alone:
+        every SGL rule, every audit mode, every HLO metric code."""
+        from tools.lint.hlo import HLO_CODES
         assert lint_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
         for code in RULES:
+            assert code in out
+        for mode in ("records", "ckpt", "hlo"):
+            assert f"\n  {mode}" in out
+        for code in HLO_CODES:
             assert code in out
 
     def test_cli_json(self, tmp_path, capsys):

@@ -26,6 +26,7 @@ import pytest
 from singa_tpu import models, tensor
 from singa_tpu.obs import events
 from singa_tpu.serve import QueueFull, ServeEngine
+from tools.lint.hlo import assert_program_count
 
 
 @pytest.fixture(scope="module")
@@ -116,7 +117,7 @@ class TestCompileDiscipline:
                   for p in _prompts(6, [2, 4, 7, 9], seed=wave)]
             engine.run_until_idle()
             assert all(h.done for h in hs)
-        assert engine.compiled_counts() == (1, 1)
+        assert_program_count(engine, (1, 1))
         assert engine.pool.free_count == engine.pool.num_slots
 
     def test_eos_eviction_frees_slot_without_recompile(self, llama,
@@ -132,7 +133,7 @@ class TestCompileDiscipline:
         assert h.finish_reason == "eos"
         assert h.tokens == [int(t) for t in ref[:k + 1]]
         assert engine.pool.free_count == engine.pool.num_slots
-        assert engine.compiled_counts() == (1, 1)
+        assert_program_count(engine, (1, 1))
 
 
 class TestAdmissionControl:
@@ -270,7 +271,7 @@ class TestPrefixSharing:
             np.testing.assert_array_equal(ref, np.asarray(h.tokens))
         # the second admission skipped its 2 shared prompt blocks
         assert engine.metrics.prefix_hit_tokens - hits0 == 16
-        assert engine.compiled_counts() == (1, 1)
+        assert_program_count(engine, (1, 1))
 
     def test_refcounts_drain_to_zero_after_both_finish(self, llama,
                                                        engine):
@@ -351,7 +352,7 @@ class TestPagedArena:
         eng.run_until_idle()
         for ref, h in zip(refs, hs):
             np.testing.assert_array_equal(ref, np.asarray(h.tokens))
-        assert eng.compiled_counts() == (1, 1)
+        assert_program_count(eng, (1, 1))
         assert (eng.pool.ref == 0).all()
 
     def test_preemption_keeps_streams_bit_identical(self, llama):
@@ -368,7 +369,7 @@ class TestPagedArena:
         for ref, h in zip(refs, hs):
             np.testing.assert_array_equal(ref, np.asarray(h.tokens))
         assert eng.metrics.preempted >= 1
-        assert eng.compiled_counts() == (1, 1)
+        assert_program_count(eng, (1, 1))
 
 
 def test_loadgen_quick_run_emits_valid_record(llama, engine, tmp_path):
@@ -395,7 +396,7 @@ def test_loadgen_quick_run_emits_valid_record(llama, engine, tmp_path):
     entry = obs_record.RunRecord(store).entries()[0]
     assert entry["kind"] == "serve_load"
     assert engine.pending == 0
-    assert engine.compiled_counts() == (1, 1)
+    assert_program_count(engine, (1, 1))
 
 
 class TestHistogramPrimitive:

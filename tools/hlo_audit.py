@@ -1,0 +1,42 @@
+"""Thin shim: the compiled-program invariant gate lives in
+``tools.lint.hlo`` (hloaudit).
+
+``python -m tools.lint --hlo`` is the front door; this file keeps a
+standalone CLI (``python tools/hlo_audit.py [--update-baselines]
+[--json]``) and re-exports the API (``summarize_hlo``, ``gate_findings``,
+``assert_program_count``) for callers that want the analysis layer
+without the lint front door.  See ``docs/static-analysis.md`` ("HLO
+audit") for the metric catalogue and the baseline-update policy.
+
+Exit code 0 = every flagship program matches its committed baseline
+under ``tools/lint/data/hlo/``; 1 = named findings printed, one per
+drifted metric.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+from tools.lint.hlo import (  # noqa: E402,F401
+    BASELINE_DIR,
+    FLAGSHIP_PROGRAMS,
+    assert_program_count,
+    audit_payload,
+    gate_findings,
+    hlo_main,
+    lower_flagship_texts,
+    summarize_hlo,
+    update_baselines,
+)
+
+
+def main(argv: list[str]) -> int:
+    return hlo_main(update="--update-baselines" in argv,
+                    json_out="--json" in argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,16 +1,26 @@
 """``python -m tools.lint`` — the one audit front door.
 
-Static (default)::
+Static (explicit paths)::
 
     python -m tools.lint singa_tpu tools          # lint trees/files
     python -m tools.lint --json singa_tpu         # machine-readable
     python -m tools.lint --select SGL005 singa_tpu
     python -m tools.lint --list-rules
 
+Full audit (no paths, no mode flags): static rules over the repo's own
+trees (``singa_tpu``, ``tools``) AND the compiled-program HLO gate::
+
+    python -m tools.lint
+
 Dynamic audits (same checks the old standalone CLIs ran)::
 
     python -m tools.lint --records [ROOT]         # telemetry records
     python -m tools.lint --ckpt DIR [DIR ...]     # checkpoint fsck
+    python -m tools.lint --hlo                    # compiled-program gate
+    python -m tools.lint --hlo --update-baselines # reviewed re-baseline
+
+``--select`` filters audit modes too (``--select hlo``,
+``--select records``, or mixed with SGL codes in the full audit).
 
 Exit codes: 0 clean, 1 findings/errors, 2 usage error.
 """
@@ -18,6 +28,7 @@ Exit codes: 0 clean, 1 findings/errors, 2 usage error.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from typing import List, Optional
 
@@ -28,30 +39,61 @@ from . import audit
 #: user cannot type, so an explicit ``--records .`` still means cwd
 _RECORDS_DEFAULT = "\0repo-root"
 
+#: the dynamic-audit modes --select/--list-rules enumerate alongside
+#: the SGL rules; ckpt needs its DIR argument so it is flag-only
+_AUDIT_MODES = {
+    "records": "validate telemetry records (sessions, BENCH/MULTICHIP "
+               "docs, runs/records.jsonl) — also via --records [ROOT]",
+    "ckpt": "checkpoint-directory fsck (commit markers, manifests) — "
+            "via --ckpt DIR [DIR ...] only, it needs the directory",
+    "hlo": "compiled-program invariant gate: lower the flagship train/"
+           "prefill/decode programs and diff fusions, collectives, "
+           "donation vs tools/lint/data/hlo/ — also via --hlo",
+}
+
+#: the trees the bare full-audit invocation lints (repo-relative) —
+#: the same set the tier-1 repo-is-clean gate pins
+_DEFAULT_TREES = ("singa_tpu", "tools")
+
 
 def _list_rules() -> str:
+    from .hlo import HLO_CODES
     lines = ["singalint rules:"]
     for code, cls in RULES.items():
         lines.append(f"  {code}  {cls.name:<17} {cls.description}")
     lines.append("  SGL000 suppression-hygiene  a '# singalint: "
                  "disable=CODE' without a reason, or naming an unknown "
                  "code, is itself a finding and cannot be suppressed")
+    lines.append("audit modes (run via their flag, or --select MODE):")
+    for mode, desc in _AUDIT_MODES.items():
+        lines.append(f"  {mode:<7} {desc}")
+    lines.append("hlo gate finding codes (named finding per drifted "
+                 "metric; waive per-baseline via a 'suppress' entry "
+                 "with a reason):")
+    for code, (name, desc) in HLO_CODES.items():
+        lines.append(f"  {code}  {name:<21} {desc}")
     return "\n".join(lines)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.lint",
-        description="singalint: AST invariant linter + dynamic audits")
+        description="singalint: AST invariant linter + dynamic audits "
+                    "(records, ckpt, hlo); bare invocation runs the "
+                    "full audit: static rules + the HLO gate")
     parser.add_argument("paths", nargs="*",
-                        help="files or directories to lint (static rules)")
+                        help="files or directories to lint (static "
+                             "rules); omit everything for the full "
+                             "audit (static + HLO gate)")
     parser.add_argument("--json", action="store_true",
                         help="emit findings as JSON")
     parser.add_argument("--select", metavar="CODES",
-                        help="comma-separated rule codes to run "
+                        help="comma-separated rule codes and/or audit "
+                             "modes (records, hlo) to run "
                              "(default: all)")
     parser.add_argument("--list-rules", action="store_true",
-                        help="print the rule catalogue and exit")
+                        help="print the rule + audit-mode catalogue "
+                             "and exit")
     parser.add_argument("--records", nargs="?", const=_RECORDS_DEFAULT,
                         metavar="ROOT", default=None,
                         help="validate telemetry records under ROOT "
@@ -59,33 +101,91 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--ckpt", nargs="+", metavar="DIR", default=None,
                         help="fsck checkpoint directories instead of "
                              "linting")
+    parser.add_argument("--hlo", action="store_true",
+                        help="run the compiled-program invariant gate "
+                             "against tools/lint/data/hlo/ baselines")
+    parser.add_argument("--update-baselines", action="store_true",
+                        help="re-lower the flagship programs and "
+                             "rewrite the HLO baselines, printing a "
+                             "human-readable metric diff (implies "
+                             "--hlo)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
         print(_list_rules())
         return 0
-    if args.records is not None and args.ckpt is not None:
-        parser.error("--records and --ckpt are separate audit modes")
-    if (args.records is not None or args.ckpt is not None) and args.paths:
+    if args.update_baselines:
+        args.hlo = True
+    mode_flags = [f for f, on in (("--records", args.records is not None),
+                                  ("--ckpt", args.ckpt is not None),
+                                  ("--hlo", args.hlo)) if on]
+    if len(mode_flags) > 1:
+        parser.error(f"{' and '.join(mode_flags)} are separate audit "
+                     f"modes")
+    if mode_flags and args.paths:
         parser.error("audit modes take no lint paths — run the static "
                      "lint as a separate invocation")
+
+    # --select: SGL codes and/or audit-mode names
+    codes = None
+    selected_modes: List[str] = []
+    if args.select:
+        raw = [c.strip() for c in args.select.split(",") if c.strip()]
+        selected_modes = [c for c in raw if c in _AUDIT_MODES]
+        codes = [c for c in raw if c in RULES]
+        unknown = [c for c in raw if c not in RULES
+                   and c not in _AUDIT_MODES]
+        if unknown:
+            parser.error(f"unknown rule code(s)/mode(s): "
+                         f"{', '.join(unknown)} (see --list-rules)")
+        if "ckpt" in selected_modes:
+            parser.error("the ckpt audit needs its directories — run "
+                         "it as --ckpt DIR [DIR ...]")
+        if selected_modes and (args.paths or mode_flags):
+            parser.error("--select with audit-mode names applies to "
+                         "the bare full-audit invocation only")
+
     if args.records is not None:
         root = (audit._REPO_ROOT if args.records == _RECORDS_DEFAULT
                 else args.records)
         return audit.records_main(root)
     if args.ckpt is not None:
         return audit.ckpt_main(args.ckpt)
+    if args.hlo:
+        from .hlo import hlo_main
+        try:
+            return hlo_main(update=args.update_baselines,
+                            json_out=args.json)
+        except RuntimeError as e:
+            parser.error(str(e))
 
     if not args.paths:
-        parser.error("no paths given (or use --list-rules / --records / "
-                     "--ckpt)")
-    codes = None
-    if args.select:
-        codes = [c.strip() for c in args.select.split(",") if c.strip()]
-        unknown = [c for c in codes if c not in RULES]
-        if unknown:
-            parser.error(f"unknown rule code(s): {', '.join(unknown)} "
-                         f"(see --list-rules)")
+        # the full audit: static rules over the repo trees + the HLO
+        # gate (or the --select'ed subset of both)
+        run_static = codes is None or bool(codes)
+        run_hlo = not args.select or "hlo" in selected_modes
+        run_records = "records" in selected_modes
+        rc = 0
+        if run_static:
+            trees = [os.path.join(audit._REPO_ROOT, t)
+                     for t in _DEFAULT_TREES]
+            try:
+                findings = run_paths(trees, codes)
+            except ValueError as e:
+                parser.error(str(e))
+            print(render_json(findings) if args.json
+                  else render_human(findings))
+            rc = max(rc, 1 if findings else 0)
+        if run_records:
+            rc = max(rc, audit.records_main(audit._REPO_ROOT))
+        if run_hlo:
+            from .hlo import hlo_main
+            try:
+                rc = max(rc, hlo_main(json_out=args.json))
+            except RuntimeError as e:
+                parser.error(str(e))
+        return rc
+
     try:
         findings = run_paths(args.paths, codes)
     except ValueError as e:

@@ -1,0 +1,646 @@
+"""hloaudit — the compiled-program invariant gate (ROADMAP item 5).
+
+singalint's AST rules guard the *Python* half of this repo's
+invariants; the performance truth of a TPU-native framework lives in
+what XLA actually emitted — fusion decisions dominate achieved
+throughput ("Operator Fusion in XLA", arXiv:2301.13062) and schedules
+have to be audited at the compiled-program level (FADiff,
+arXiv:2511.22348).  This module turns the hand-rolled one-off
+assertions ("jit cache size == 2", "'all-reduce' in compiled_hlo()")
+into a general regression gate:
+
+1. **lower** the flagship programs — the Llama train step (fused
+   CE-chunk loss; single-device and 2-way data-parallel variants) and
+   the serve engine's prefill-chunk / decode-over-block-tables — to
+   *optimized* HLO text on the CPU backend with tiny configs (no chips
+   needed; ``ServeEngine.lower_programs()`` and the graph executor's
+   ``CapturedGraph.compiled`` are the hooks);
+2. **summarize** each module structurally: fusion count and kinds, op
+   histogram, collective ops and whether they sit inside a loop body
+   (the overlap path), while/remat bodies, entry parameter count, and
+   donation aliasing (``input_output_alias`` — the KV arena and
+   optimizer-state donations);
+3. **diff** the summaries against committed per-program baselines under
+   ``tools/lint/data/hlo/``, failing loudly (exit 1) with a named
+   finding per drifted metric — a new op splitting the CE-chunk fusion,
+   a collective migrating out of the loop body, a lost donation.
+
+Intentional changes are one reviewed command:
+``python -m tools.lint --hlo --update-baselines`` rewrites the
+baselines and prints a human-readable metric diff for the PR.
+
+A baseline file may carry ``"suppress": {"HLO006": "<reason>"}`` to
+waive one metric for one program — the reason is REQUIRED (an empty
+one is itself a finding, HLO000), mirroring the singalint suppression
+contract.
+
+Everything jax lives behind function-local imports: importing this
+module (e.g. for :func:`assert_program_count` in tests) must stay as
+cheap as importing the AST rules.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .framework import Finding
+
+__all__ = ["assert_program_count", "summarize_hlo", "diff_summaries",
+           "gate_findings", "lower_flagship_texts", "lower_train_step",
+           "update_baselines", "load_baselines", "audit_payload",
+           "hlo_main", "BASELINE_DIR", "FLAGSHIP_PROGRAMS", "HLO_CODES",
+           "SUMMARY_SCHEMA"]
+
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+#: committed per-program baselines live here, one JSON file per program
+BASELINE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "data", "hlo")
+
+#: the audited programs, in lowering order.  train_step is the flagship
+#: decoder's compiled step (fused CE-chunk loss — the lax.scan while
+#: body the gate protects); train_step_dp2 is the same step under a
+#: 2-way 'data' mesh with DistOpt, which is what puts real all-reduce
+#: ops into the module so collective count/placement are non-vacuous;
+#: prefill_chunk / decode are the serve engine's exactly-two programs.
+FLAGSHIP_PROGRAMS = ("train_step", "train_step_dp2", "prefill_chunk",
+                     "decode")
+
+#: summary format version — bump on incompatible metric changes; a
+#: baseline with another version fails the gate (HLO001) instead of
+#: diffing garbage
+SUMMARY_SCHEMA = 1
+
+#: finding codes, one per metric (the "named finding per drifted
+#: metric" contract) — enumerated by ``--list-rules``
+HLO_CODES = {
+    "HLO000": ("suppression-hygiene", "a baseline 'suppress' entry "
+               "without a reason, or naming an unknown metric code, is "
+               "itself a finding and cannot be waived"),
+    "HLO001": ("program-set", "every audited program has a committed, "
+               "parseable, same-schema baseline — and every baseline "
+               "has a lowered program"),
+    "HLO002": ("fusion", "fusion count and kind histogram match the "
+               "baseline (a new op splitting the CE-chunk fusion lands "
+               "here)"),
+    "HLO003": ("collective", "collective op count and opcode set match "
+               "the baseline"),
+    "HLO004": ("collective-placement", "collectives inside loop bodies "
+               "stay there (a collective migrating off the overlap "
+               "path lands here)"),
+    "HLO005": ("donation", "input/output buffer aliasing "
+               "(donate_argnums: the KV arena, params/opt state) is "
+               "not lost"),
+    "HLO006": ("op-histogram", "the module's opcode histogram matches "
+               "the baseline"),
+    "HLO007": ("while-loop", "while/remat body count matches the "
+               "baseline (the CE-chunk scan, remat replays)"),
+    "HLO008": ("interface", "entry-computation parameter count matches "
+               "the baseline"),
+}
+
+#: HLO opcodes that are cross-device collectives
+_COLLECTIVE_OPS = frozenset({
+    "all-reduce", "all-reduce-start", "all-reduce-done",
+    "all-gather", "all-gather-start", "all-gather-done",
+    "reduce-scatter", "collective-permute", "collective-permute-start",
+    "collective-permute-done", "all-to-all", "collective-broadcast",
+})
+
+
+# ---------------------------------------------------------------------------
+# the shared jit-cache helper (no jax import needed)
+# ---------------------------------------------------------------------------
+
+def assert_program_count(obj, expected) -> None:
+    """Assert the compiled-program count of an engine or jitted
+    function(s) — the ONE implementation of the serve two-program
+    contract, shared by tests/test_serve.py, tests/test_faults.py and
+    this gate (an engine that silently recompiles would drift every
+    HLO metric at once; an assertion names the drift immediately).
+
+    ``obj`` may be a ServeEngine (``compiled_counts()``), a sequence of
+    jitted functions, or one jitted function; ``expected`` is the
+    matching tuple (or int for a single function)."""
+    if hasattr(obj, "compiled_counts"):
+        actual: object = tuple(obj.compiled_counts())
+        expected = tuple(expected)
+    elif isinstance(obj, (tuple, list)):
+        actual = tuple(f._cache_size() for f in obj)
+        expected = tuple(expected)
+    else:
+        actual = obj._cache_size()
+        expected = int(expected)
+    assert actual == expected, (
+        f"compiled-program count drifted: expected {expected}, got "
+        f"{actual} — a new input shape/dtype leaked into a jitted "
+        f"program (the no-recompile contract; see docs/serving.md)")
+
+
+# ---------------------------------------------------------------------------
+# HLO text -> structural summary
+# ---------------------------------------------------------------------------
+
+_COMP_HEADER_RE = re.compile(
+    r"^(ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->")
+_INSTR_RE = re.compile(
+    r"^\s+(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.*)$")
+_OPCODE_RE = re.compile(r"\s([a-z][a-z0-9\-]*)\(")
+_CALLED_RE = re.compile(
+    r"(?:calls|body|condition|to_apply|branch_computations|"
+    r"true_computation|false_computation)=\{?%?([\w.\-]+)")
+_FUSION_KIND_RE = re.compile(r"\bkind=(\w+)")
+_WHILE_BODY_RE = re.compile(r"\bwhile\(.*\bbody=%?([\w.\-]+)")
+
+
+def _alias_count(text: str) -> int:
+    """Number of aliased (donated) outputs in the module header's
+    ``input_output_alias={ {out}: (arg, {}, may-alias), ... }``."""
+    m = re.search(r"input_output_alias=\{", text)
+    if m is None:
+        return 0
+    i, depth, start = m.end() - 1, 0, m.end() - 1
+    while i < len(text):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+        i += 1
+    return text[start:i].count("-alias")
+
+
+def summarize_hlo(text: str, program: str) -> Dict:
+    """Parse one optimized-HLO module's text into the structural
+    summary the gate diffs.  Purely textual — no jax."""
+    comps: Dict[str, List[str]] = {}          # computation -> opcodes
+    called: Dict[str, List[str]] = {}         # computation -> callees
+    entry: Optional[str] = None
+    cur: Optional[str] = None
+    while_bodies: List[str] = []
+    fusion_kinds: Dict[str, int] = {}
+
+    for line in text.splitlines():
+        if line and not line[0].isspace():
+            mh = _COMP_HEADER_RE.match(line)
+            if mh:
+                cur = mh.group(2)
+                comps.setdefault(cur, [])
+                if mh.group(1):
+                    entry = cur
+                continue
+        mi = _INSTR_RE.match(line)
+        if mi is None or cur is None:
+            continue
+        rhs = mi.group(1)
+        mo = _OPCODE_RE.search(" " + rhs)
+        if mo is None:
+            continue
+        op = mo.group(1)
+        comps[cur].append(op)
+        for mc in _CALLED_RE.finditer(rhs):
+            called.setdefault(cur, []).append(mc.group(1))
+        if op == "fusion":
+            mk = _FUSION_KIND_RE.search(rhs)
+            kind = mk.group(1) if mk else "unknown"
+            fusion_kinds[kind] = fusion_kinds.get(kind, 0) + 1
+        if op == "while":
+            mw = _WHILE_BODY_RE.search(rhs)
+            if mw:
+                while_bodies.append(mw.group(1))
+
+    # computations reachable from a while body = "inside the loop"
+    in_loop: set = set()
+    frontier = list(while_bodies)
+    while frontier:
+        c = frontier.pop()
+        if c in in_loop:
+            continue
+        in_loop.add(c)
+        frontier.extend(called.get(c, []))
+
+    histogram: Dict[str, int] = {}
+    coll_by_op: Dict[str, int] = {}
+    coll_in_loop = 0
+    for comp, ops in comps.items():
+        for op in ops:
+            histogram[op] = histogram.get(op, 0) + 1
+            if op in _COLLECTIVE_OPS:
+                coll_by_op[op] = coll_by_op.get(op, 0) + 1
+                if comp in in_loop:
+                    coll_in_loop += 1
+
+    entry_params = (comps.get(entry, []).count("parameter")
+                    if entry is not None else 0)
+    return {
+        "schema": SUMMARY_SCHEMA,
+        "program": program,
+        "entry_params": entry_params,
+        "donated_outputs": _alias_count(text),
+        "fusions": {"total": sum(fusion_kinds.values()),
+                    "kinds": dict(sorted(fusion_kinds.items()))},
+        "while_loops": histogram.get("while", 0),
+        "collectives": {"total": sum(coll_by_op.values()),
+                        "by_op": dict(sorted(coll_by_op.items())),
+                        "in_loop_body": coll_in_loop},
+        "op_histogram": dict(sorted(histogram.items())),
+    }
+
+
+# ---------------------------------------------------------------------------
+# summary diff -> findings
+# ---------------------------------------------------------------------------
+
+def _histogram_drift(base: Dict[str, int],
+                     cur: Dict[str, int]) -> List[str]:
+    """Human fragments for opcode-set and count changes, worst first."""
+    out = []
+    for op in sorted(set(cur) - set(base)):
+        out.append(f"new op {op!r} (x{cur[op]})")
+    for op in sorted(set(base) - set(cur)):
+        out.append(f"op {op!r} vanished (was x{base[op]})")
+    for op in sorted(set(base) & set(cur)):
+        if base[op] != cur[op]:
+            out.append(f"{op}: {base[op]} -> {cur[op]}")
+    return out
+
+
+def _suppressions_of(baseline: Dict, path: str) -> Tuple[set, List[Finding]]:
+    """Waived metric codes of one baseline, plus hygiene findings for
+    waivers without a reason / naming unknown codes (HLO000 — which,
+    like SGL000, cannot itself be waived)."""
+    sup = baseline.get("suppress", {})
+    waived: set = set()
+    bad: List[Finding] = []
+    for code, reason in sorted(sup.items() if isinstance(sup, dict) else ()):
+        if code not in HLO_CODES or code == "HLO000":
+            bad.append(Finding(path, 1, 0, "HLO000",
+                               f"baseline waives unknown metric code "
+                               f"{code!r} (known: "
+                               f"{', '.join(sorted(HLO_CODES))})"))
+        elif not (isinstance(reason, str) and reason.strip()):
+            bad.append(Finding(path, 1, 0, "HLO000",
+                               f"baseline waiver of {code} carries no "
+                               f"reason — an unexplained waiver is the "
+                               f"silent drift this gate exists to stop"))
+        else:
+            waived.add(code)
+    return waived, bad
+
+
+def diff_summaries(program: str, baseline: Dict, current: Dict,
+                   path: str) -> List[Finding]:
+    """Named finding per drifted metric of one program."""
+    waived, findings = _suppressions_of(baseline, path)
+
+    def fnd(code: str, msg: str) -> None:
+        if code in waived:
+            return
+        findings.append(Finding(path, 1, 0, code,
+                                f"[{program}] {msg} — if intentional, "
+                                f"re-baseline with 'python -m tools.lint "
+                                f"--hlo --update-baselines'"))
+
+    if baseline.get("schema") != current.get("schema"):
+        findings.append(Finding(
+            path, 1, 0, "HLO001",
+            f"[{program}] baseline summary schema "
+            f"{baseline.get('schema')!r} does not match the auditor's "
+            f"{current.get('schema')!r} — regenerate with "
+            f"--update-baselines"))
+        return findings
+
+    bf, cf = baseline.get("fusions", {}), current.get("fusions", {})
+    if bf.get("total") != cf.get("total") or \
+            bf.get("kinds") != cf.get("kinds"):
+        fnd("HLO002",
+            f"fusion structure drifted: {bf.get('total')} fusions "
+            f"{bf.get('kinds')} -> {cf.get('total')} fusions "
+            f"{cf.get('kinds')} (an op falling out of a fusion — e.g. "
+            f"a defused CE chunk — lands here)")
+
+    bc = baseline.get("collectives", {})
+    cc = current.get("collectives", {})
+    if bc.get("total") != cc.get("total") or \
+            bc.get("by_op") != cc.get("by_op"):
+        fnd("HLO003",
+            f"collective ops drifted: {bc.get('by_op')} -> "
+            f"{cc.get('by_op')}")
+    if bc.get("in_loop_body") != cc.get("in_loop_body"):
+        fnd("HLO004",
+            f"collective placement drifted: {bc.get('in_loop_body')} "
+            f"inside loop bodies -> {cc.get('in_loop_body')} (a "
+            f"collective migrated {'out of' if (cc.get('in_loop_body') or 0) < (bc.get('in_loop_body') or 0) else 'into'} "
+            f"the loop/overlap path)")
+
+    if baseline.get("donated_outputs") != current.get("donated_outputs"):
+        b, c = baseline.get("donated_outputs"), current.get("donated_outputs")
+        fnd("HLO005",
+            f"donation aliasing drifted: {b} aliased outputs -> {c}"
+            f"{' (a donation was LOST: the arena/state now copies every dispatch)' if (c or 0) < (b or 0) else ''}")
+
+    drift = _histogram_drift(baseline.get("op_histogram", {}),
+                             current.get("op_histogram", {}))
+    if drift:
+        shown = "; ".join(drift[:8])
+        more = len(drift) - 8
+        fnd("HLO006",
+            f"op histogram drifted ({len(drift)} opcode(s)): {shown}"
+            f"{f'; ... {more} more' if more > 0 else ''}")
+
+    if baseline.get("while_loops") != current.get("while_loops"):
+        fnd("HLO007",
+            f"while/remat body count drifted: "
+            f"{baseline.get('while_loops')} -> "
+            f"{current.get('while_loops')}")
+
+    if baseline.get("entry_params") != current.get("entry_params"):
+        fnd("HLO008",
+            f"entry parameter count drifted: "
+            f"{baseline.get('entry_params')} -> "
+            f"{current.get('entry_params')}")
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# baselines on disk
+# ---------------------------------------------------------------------------
+
+def _baseline_path(program: str, baseline_dir: str) -> str:
+    return os.path.join(baseline_dir, f"{program}.json")
+
+
+def load_baselines(baseline_dir: Optional[str] = None
+                   ) -> Tuple[Dict[str, Dict], List[Finding]]:
+    """All committed baselines, plus HLO001 findings for unreadable
+    files.  A missing DIRECTORY is not a finding here — the gate
+    reports per-program misses so the message can name the program."""
+    baseline_dir = baseline_dir or BASELINE_DIR
+    out: Dict[str, Dict] = {}
+    bad: List[Finding] = []
+    if not os.path.isdir(baseline_dir):
+        return out, bad
+    for name in sorted(os.listdir(baseline_dir)):
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(baseline_dir, name)
+        try:
+            with open(path, encoding="utf-8") as f:
+                out[name[:-len(".json")]] = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            bad.append(Finding(path, 1, 0, "HLO001",
+                               f"unreadable baseline: {e}"))
+    return out, bad
+
+
+def gate_findings(summaries: Dict[str, Dict],
+                  baseline_dir: Optional[str] = None) -> List[Finding]:
+    """Diff lowered summaries against the committed baselines; the
+    gate's whole verdict as findings ([] = clean)."""
+    baseline_dir = baseline_dir or BASELINE_DIR
+    baselines, findings = load_baselines(baseline_dir)
+    for program, summary in summaries.items():
+        path = _baseline_path(program, baseline_dir)
+        base = baselines.get(program)
+        if base is None:
+            findings.append(Finding(
+                path, 1, 0, "HLO001",
+                f"[{program}] no committed baseline — run 'python -m "
+                f"tools.lint --hlo --update-baselines' and review the "
+                f"summary it writes"))
+            continue
+        findings.extend(diff_summaries(program, base, summary, path))
+    for program in sorted(set(baselines) - set(summaries)):
+        findings.append(Finding(
+            _baseline_path(program, baseline_dir), 1, 0, "HLO001",
+            f"[{program}] baseline exists but the program was not "
+            f"lowered — renamed/removed program, or a partial audit; "
+            f"delete the stale baseline or fix the lowering"))
+    return sorted(findings, key=lambda f: (f.path, f.code))
+
+
+def update_baselines(summaries: Dict[str, Dict],
+                     baseline_dir: Optional[str] = None) -> str:
+    """Write the summaries as the new baselines (preserving each
+    program's ``suppress`` block) and return the human-readable metric
+    diff — the reviewed artifact of an intentional change."""
+    baseline_dir = baseline_dir or BASELINE_DIR
+    os.makedirs(baseline_dir, exist_ok=True)
+    old, _bad = load_baselines(baseline_dir)
+    lines: List[str] = []
+    for program, summary in summaries.items():
+        path = _baseline_path(program, baseline_dir)
+        base = old.get(program)
+        if base is None:
+            lines.append(f"{program}: NEW baseline "
+                         f"({summary['fusions']['total']} fusions, "
+                         f"{summary['collectives']['total']} collectives, "
+                         f"{summary['while_loops']} while loops, "
+                         f"{summary['donated_outputs']} donated outputs)")
+        else:
+            drifted = diff_summaries(program, base, summary, path)
+            if drifted:
+                lines.append(f"{program}:")
+                lines.extend(f"  {f.code} {f.message}" for f in drifted)
+            else:
+                lines.append(f"{program}: unchanged")
+            sup = base.get("suppress")
+            if sup:
+                summary = dict(summary, suppress=sup)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(summary, f, indent=2, sort_keys=True)
+            f.write("\n")
+    for program in sorted(set(old) - set(summaries)):
+        os.remove(_baseline_path(program, baseline_dir))
+        lines.append(f"{program}: baseline REMOVED (program no longer "
+                     f"lowered)")
+    return "\n".join(lines)
+
+
+def audit_payload(summaries: Dict[str, Dict],
+                  findings: Iterable[Finding]) -> Dict:
+    """The ``hlo_audit`` record payload (obs.schema): the drift-history
+    quantities that accumulate in runs/records.jsonl next to the perf
+    trajectory."""
+    return {
+        "programs": len(summaries),
+        "drifted": len(list(findings)),
+        "fusions": sum(s["fusions"]["total"] for s in summaries.values()),
+        "collectives": sum(s["collectives"]["total"]
+                           for s in summaries.values()),
+        "while_loops": sum(s["while_loops"] for s in summaries.values()),
+    }
+
+
+# ---------------------------------------------------------------------------
+# lowering the flagship programs (jax from here down)
+# ---------------------------------------------------------------------------
+
+def _ensure_cpu_backend() -> None:
+    """Pin the virtual-CPU platform (the canonical recipe — this
+    image's sitecustomize force-registers the TPU plugin).  8 devices
+    to match tests/conftest.py exactly, so baselines generated by the
+    CLI and checked under pytest see the same platform."""
+    import sys
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    from singa_tpu.utils.virtcpu import pin_virtual_cpu
+    if not pin_virtual_cpu(8):
+        raise RuntimeError(
+            "the HLO audit needs the virtual-CPU backend but another "
+            "JAX backend is already initialized in this process — run "
+            "it in a fresh process (python -m tools.lint --hlo)")
+    import jax
+    # conftest.py sets this for every test process; the audit must
+    # lower the same programs the tests see
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def lower_train_step(dp: bool = False, fused_loss: bool = True) -> str:
+    """Optimized-HLO text of the flagship (tiny-config) compiled train
+    step: Llama + fused CE-chunk loss + SGD, through the real graph
+    executor — so the audited module IS the module training runs.  With
+    ``dp``, the same step under a 2-way 'data' mesh with DistOpt (the
+    in-graph gradient all-reduce).  ``fused_loss=False`` builds the
+    deliberately-defused variant the regression tests feed the gate."""
+    _ensure_cpu_backend()
+    import numpy as np
+    from singa_tpu import models, opt, parallel, tensor
+
+    tensor.set_seed(0)
+    np.random.seed(0)
+    # ONE transformer block: XLA compile time scales with instruction
+    # count (layer count — measured 3x the gate latency at tiny()'s two
+    # blocks), and one block already carries every audited structure:
+    # the fused CE-chunk scan, attention/FFN fusions, params/opt-state
+    # donation, and the DP gradient all-reduces.  The serve programs
+    # keep tiny()'s two layers — the repeated per-layer paging pattern
+    # is itself an audited structure there.
+    cfg = models.LlamaConfig.tiny()
+    cfg.num_layers = 1
+    cfg.fused_loss = fused_loss
+    saved_mesh = parallel.current_mesh()
+    try:
+        if dp:
+            parallel.set_mesh(parallel.make_mesh({"data": 2}))
+        else:
+            parallel.set_mesh(None)
+        m = models.Llama(cfg)
+        m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.01, momentum=0.9))
+                        if dp else opt.SGD(lr=0.01, momentum=0.9))
+        ids = tensor.from_numpy(np.zeros((2, 16), np.int32))
+        m.compile([ids], is_train=True, use_graph=True)
+        m.train_step(ids)
+        return m.graph.compiled_hlo()
+    finally:
+        parallel.set_mesh(saved_mesh)
+
+
+def _lower_serve_programs() -> Dict[str, str]:
+    """Optimized-HLO texts of the serve engine's exactly-two programs
+    (tiny Llama, 2 slots) via ``ServeEngine.lower_programs()``."""
+    _ensure_cpu_backend()
+    import numpy as np
+    from singa_tpu import models, tensor
+    from singa_tpu.serve import ServeEngine
+
+    tensor.set_seed(0)
+    np.random.seed(0)
+    m = models.Llama(models.LlamaConfig.tiny())
+    m.eval()
+    m.compile([tensor.from_numpy(np.zeros((1, 4), np.int32))],
+              is_train=False, use_graph=False)
+    eng = ServeEngine(m, num_slots=2, max_len=16, block_size=8)
+    texts = {name: lowered.compile().as_text()
+             for name, lowered in eng.lower_programs().items()}
+    # lowering must never have touched the engine's own executables
+    assert_program_count(eng, (0, 0))
+    return texts
+
+
+def lower_flagship_texts(programs: Optional[Iterable[str]] = None
+                         ) -> Dict[str, str]:
+    """Optimized-HLO text per flagship program (CPU backend, tiny
+    configs).  ``programs`` restricts the set — the test fixture lowers
+    everything once and shares it."""
+    wanted = tuple(programs) if programs is not None else FLAGSHIP_PROGRAMS
+    unknown = set(wanted) - set(FLAGSHIP_PROGRAMS)
+    if unknown:
+        raise ValueError(f"unknown program(s): {sorted(unknown)} "
+                         f"(known: {FLAGSHIP_PROGRAMS})")
+    texts: Dict[str, str] = {}
+    if "train_step" in wanted:
+        texts["train_step"] = lower_train_step()
+    if "train_step_dp2" in wanted:
+        texts["train_step_dp2"] = lower_train_step(dp=True)
+    if "prefill_chunk" in wanted or "decode" in wanted:
+        serve = _lower_serve_programs()
+        for name in ("prefill_chunk", "decode"):
+            if name in wanted:
+                texts[name] = serve[name]
+    return {name: texts[name] for name in wanted}
+
+
+def flagship_summaries(programs: Optional[Iterable[str]] = None
+                       ) -> Dict[str, Dict]:
+    return {name: summarize_hlo(text, name)
+            for name, text in lower_flagship_texts(programs).items()}
+
+
+# ---------------------------------------------------------------------------
+# CLI body (shared by `python -m tools.lint --hlo` and tools/hlo_audit.py)
+# ---------------------------------------------------------------------------
+
+def hlo_main(update: bool = False, json_out: bool = False,
+             baseline_dir: Optional[str] = None,
+             record_store: Optional[str] = None) -> int:
+    """Lower, summarize, and gate (or re-baseline).  Exit codes follow
+    the lint front door: 0 clean, 1 findings.  With ``record_store``,
+    append an ``hlo_audit`` entry so drift history lands in the durable
+    run-record store (bench.py passes runs/records.jsonl)."""
+    from .framework import render_human, render_json
+
+    summaries = flagship_summaries()
+    if update:
+        diff = update_baselines(summaries, baseline_dir)
+        print(diff)
+        print(f"hlo_audit: baselines updated under "
+              f"{baseline_dir or BASELINE_DIR} — review the diff above")
+        return 0
+    findings = gate_findings(summaries, baseline_dir)
+    if json_out:
+        doc = json.loads(render_json(findings))
+        doc["hlo"] = audit_payload(summaries, findings)
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        # same rendering as the static rules; only the banner differs
+        print(render_human(findings).replace("singalint:", "hlo_audit:"))
+    if record_store:
+        _append_record(record_store, summaries, findings)
+    return 1 if findings else 0
+
+
+def _append_record(store: str, summaries: Dict[str, Dict],
+                   findings: List[Finding]) -> None:
+    """Best-effort ``hlo_audit`` entry append — the record is drift
+    evidence, not a dependency."""
+    import sys
+    import warnings
+    try:
+        import jax
+        from singa_tpu.obs import record as obs_record
+        platform = jax.default_backend()
+        entry = obs_record.new_entry(
+            "hlo_audit", platform, platform != "tpu", platform,
+            run_id=obs_record.new_run_id("hloaudit"),
+            payload=audit_payload(summaries, findings))
+        obs_record.RunRecord(store).append(entry)
+        print(f"hlo_audit: entry appended to {store}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001
+        warnings.warn(f"could not append hlo_audit record: "
+                      f"{type(e).__name__}: {e}", stacklevel=2)

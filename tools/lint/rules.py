@@ -9,6 +9,7 @@
 | SGL005 | wall-clock       | time.time() is banned (monotonic-only rule)    |
 | SGL006 | obs-kind         | record kinds are members of obs.schema._KINDS  |
 | SGL007 | fault-site       | faults.fire/corrupt sites exist in the registry|
+| SGL008 | host-sync        | no device fetches in hot engine/runner loops   |
 
 Rules are module-local static analysis: each builds a one-level call
 graph inside the file it lints (jit roots -> direct helper calls,
@@ -823,3 +824,78 @@ class FaultSiteRule(Rule):
                     f"faults.sites.SITES ({', '.join(sorted(sites))}) — "
                     f"an unregistered site never fires; register it or "
                     f"fix the typo")
+
+
+# ---------------------------------------------------------------------------
+# SGL008 host-sync hazard
+# ---------------------------------------------------------------------------
+
+#: class-name suffixes whose step loops are "hot": one host sync per
+#: tick serializes every dispatch behind a device round trip (r5 probe
+#: 3 measured ~RTT per blocking fetch on the tunneled chip)
+_HOT_CLASS_SUFFIXES = ("Engine", "Runner")
+#: hot entry points on those classes; the step region proper
+_HOT_ROOT_NAMES = frozenset({"step", "run", "run_until_idle"})
+#: canonical dotted paths that force a device->host transfer
+_HOST_SYNC_CALLS = {"jax.device_get", "numpy.asarray", "numpy.array"}
+
+
+@register
+class HostSyncRule(Rule):
+    code = "SGL008"
+    name = "host-sync"
+    description = ("device fetches (.item(), float(x), np.asarray, "
+                   "jax.device_get) must not sit in hot engine/runner "
+                   "loops (*Engine/*Runner step/run regions, one helper "
+                   "level) — each one serializes the loop behind a "
+                   "device round trip; suppress with the measured "
+                   "justification when the fetch IS the product")
+
+    def _hot_bodies(self, cls: ast.ClassDef):
+        """(method name, body, how) for hot roots plus ONE level of
+        ``self.helper()`` calls from them — the same reachability
+        discipline as SGL004."""
+        methods = _methods(cls)
+        roots = {name: "hot entry point" for name in methods
+                 if name in _HOT_ROOT_NAMES or name.startswith("_step")}
+        reach = dict(roots)
+        for name in list(roots):
+            for node in ast.walk(methods[name]):
+                if isinstance(node, ast.Call):
+                    h = _self_method(node.func)
+                    if h and h in methods and h not in reach:
+                        reach[h] = f"called from {name}()"
+        return [(name, methods[name], how) for name, how in reach.items()]
+
+    def check(self, tree: ast.Module, src: str,
+              path: str) -> Iterable[Finding]:
+        imports = import_map(tree)
+        for cls in [n for n in module_nodes(tree)
+                    if isinstance(n, ast.ClassDef)
+                    and n.name.endswith(_HOT_CLASS_SUFFIXES)]:
+            for mname, body, how in self._hot_bodies(cls):
+                for node in ast.walk(body):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    shown = None
+                    full = resolve(node.func, imports)
+                    if isinstance(node.func, ast.Attribute) and \
+                            node.func.attr == "item" and not node.args:
+                        shown = f"{dotted_name(node.func) or '.item'}()"
+                    elif full in _HOST_SYNC_CALLS:
+                        shown = f"{dotted_name(node.func) or full}()"
+                    elif isinstance(node.func, ast.Name) and \
+                            node.func.id == "float" and \
+                            len(node.args) == 1 and isinstance(
+                                node.args[0],
+                                (ast.Name, ast.Attribute, ast.Subscript)):
+                        shown = "float(...)"
+                    if shown is None:
+                        continue
+                    yield self.finding(
+                        path, node,
+                        f"host-sync hazard: {shown} in "
+                        f"{cls.name}.{mname}() ({how}) blocks on a "
+                        f"device->host transfer inside the hot loop — "
+                        f"keep values device-resident, batch the fetch, "
+                        f"or suppress with the measured justification")
