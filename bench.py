@@ -973,12 +973,15 @@ def _record_bench(headline: str, platform: str) -> None:
 
 
 def _record_hlo_audit() -> None:
-    """Append the compiled-program audit summary (tools/lint/hlo.py —
-    fusion/collective/donation structure of the flagship train and
-    serve programs) to the run-record store next to the bench headline,
-    so the structural drift history accumulates with the perf
-    trajectory: when a future headline moves, runs/records.jsonl can
-    answer "did the compiled program change underneath it".
+    """Append the compiled-program audit summary (tools/lint/hlo.py
+    structure + tools/lint/cost.py analytic cost — fusion/collective/
+    donation structure AND flops/HBM/peak/wire numerics of the flagship
+    train and serve programs, one shared lowering) to the run-record
+    store next to the bench headline, so drift AND cost history
+    accumulate with the perf trajectory: when a future headline moves,
+    runs/records.jsonl can answer "did the compiled program change
+    underneath it" and feed the record-driven autotuner's
+    ``cost_features()`` inputs (ROADMAP item 4).
 
     Runs in a CPU subprocess — the gate pins the virtual-CPU backend
     itself, so this can never touch the axon tunnel no matter which
@@ -1005,7 +1008,10 @@ def _record_hlo_audit() -> None:
                              obs_record.DEFAULT_STORE)
         obs_record.RunRecord(store).append(entry)
         print(f"# hlo_audit entry appended to {store} "
-              f"(drifted={doc['hlo']['drifted']})", file=sys.stderr)
+              f"(drifted={doc['hlo']['drifted']}, "
+              f"flops={doc['hlo'].get('flops', 0):,}, "
+              f"peak={doc['hlo'].get('peak_bytes', 0):,} B)",
+              file=sys.stderr)
     except Exception as e:  # noqa: BLE001
         print(f"# hlo_audit record skipped: {type(e).__name__}: {e}",
               file=sys.stderr)

@@ -68,12 +68,21 @@ _SERVE_LOAD_FIELDS = ("requests", "completed", "shed", "rejected",
 _TRAIN_RUN_FIELDS = ("steps", "wall_s", "ckpt_count", "resumed_from")
 
 #: required numeric payload fields of an hlo_audit entry — one run of
-#: the compiled-program invariant gate (tools/lint/hlo.py): how many
-#: flagship programs were lowered, how many findings drifted, and the
-#: aggregate structural quantities (fusions, collectives, while loops)
-#: whose trajectory the drift history tracks next to the perf records
+#: the compiled-program invariant gates (tools/lint/hlo.py structure +
+#: tools/lint/cost.py cost): how many flagship programs were lowered,
+#: how many findings drifted, the aggregate structural quantities
+#: (fusions, collectives, while loops) AND the analytic cost numerics
+#: (total flops / HBM traffic / collective wire bytes, max per-program
+#: peak live bytes) whose trajectory the drift history tracks next to
+#: the perf records — the bench trajectory accumulates cost history
+#: for the record-driven autotuner (ROADMAP item 4).  The cost fields
+#: joined the required set WITHOUT a SCHEMA_VERSION bump because no
+#: committed store anywhere carried an hlo_audit entry yet (verified at
+#: the time of the change) — were one to exist, this would need the
+#: version dance instead
 _HLO_AUDIT_FIELDS = ("programs", "drifted", "fusions", "collectives",
-                     "while_loops")
+                     "while_loops", "flops", "hbm_bytes", "peak_bytes",
+                     "wire_bytes")
 
 #: required string payload fields of an incident entry — one fired
 #: fault or recovery action (singa_tpu.faults / ServeEngine resilience):

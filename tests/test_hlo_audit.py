@@ -1,44 +1,84 @@
-"""hloaudit (ISSUE 7) — the compiled-program invariant gate
-(tools/lint/hlo.py), tier-1 lean.
+"""hloaudit + hlocost (ISSUES 7 & 9) — the compiled-program invariant
+gates (tools/lint/hlo.py structure, tools/lint/cost.py cost), tier-1
+lean.
 
-The invariants under test are the gate's contract:
-  * the committed baselines under tools/lint/data/hlo/ are CLEAN
-    against a fresh lowering of all four flagship programs — so any
-    future change that moves a fusion, collective, donation or opcode
-    fails CI with a named finding until it is reviewed via
-    ``--update-baselines``;
-  * a deliberately defused CE-chunk variant (fused_loss=False) is
-    flagged (exit 1, HLO002 fusion finding) and a collective moved
-    in/out of the loop body is flagged (HLO004) — the two seeded
-    regressions the acceptance criteria name;
-  * ``--update-baselines`` roundtrips (update -> clean -> mutate ->
-    findings -> update -> clean) and prints a human-readable diff;
-  * baseline waivers follow the singalint suppression contract
-    (reason REQUIRED, unknown codes are findings, HLO000 unwaivable);
-  * the ``hlo_audit`` record kind roundtrips through the obs schema
-    (the record_check CI contract for the drift history).
+The invariants under test are the gates' contract:
+  * the committed baselines under tools/lint/data/hlo/ (structure) and
+    tools/lint/data/hlo/cost/ (cost) are CLEAN against a fresh lowering
+    of all four flagship programs — so any future change that moves a
+    fusion, collective, donation, flop count, HBM byte, peak-memory
+    byte or wire byte fails CI with a named finding until it is
+    reviewed via ``--update-baselines``;
+  * the three seeded cost regressions from the ISSUE-9 acceptance
+    criteria are each caught with a named COST00x finding and exit 1:
+    a raised CE-chunk count (flops/HBM drift, COST002/COST003), a
+    broken KV-arena donation (peak-memory inflation, COST004), and a
+    changed mesh size (DP wire bytes, COST005) — and
+    ``--update-baselines`` round-trips each with a human-readable
+    metric diff;
+  * the structural seeds from ISSUE 7 still fire (defused CE chunk ->
+    HLO002, moved collective -> HLO004);
+  * ``--hlo`` runs BOTH gates off ONE lowering pass per program
+    (counted via a stub) — the "lower once, audit twice" contract that
+    keeps the combined lane inside its ~18 s tier-1 budget;
+  * baseline waivers follow the singalint suppression contract in both
+    families (reason REQUIRED, unknown codes are findings, the hygiene
+    code unwaivable);
+  * the extended ``hlo_audit`` record kind (peak_bytes/flops/hbm_bytes/
+    wire_bytes) roundtrips through the obs schema, and
+    ``cost_features()`` returns the stable documented dict per program.
 
 Budget discipline: ONE module fixture lowers all four programs
-(~15 s); every other test diffs summaries in memory.  The defused
-variant is the only extra compile.
+(~15 s); every other test summarizes texts or diffs summaries in
+memory.  The defused and many-chunk train-step variants are the only
+extra compiles (tiny 1-block config — the cheap lowering).  Per-metric
+sweep variants beyond these seeds are deliberately absent: the three
+seeds plus the in-memory mutations cover every COST code without
+another compile (ROADMAP item 6).
 """
 
 import json
 import os
+import re
 
 import pytest
 
-from tools.lint import hlo
+from tools.lint import cost, hlo
 from tools.lint.__main__ import main as lint_main
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 
 @pytest.fixture(scope="module")
-def summaries():
-    """All four flagship programs lowered + summarized ONCE — the
-    file's whole compile budget; tests share and never mutate it."""
-    return hlo.flagship_summaries()
+def texts():
+    """All four flagship programs lowered ONCE — the file's whole
+    compile budget (plus the two seeded train-step variants); tests
+    share and never mutate it."""
+    return hlo.lower_flagship_texts()
+
+
+@pytest.fixture(scope="module")
+def summaries(texts):
+    return hlo.flagship_summaries(texts=texts)
+
+
+@pytest.fixture(scope="module")
+def costs(texts):
+    return cost.cost_summaries(texts)
+
+
+@pytest.fixture()
+def stub_lowering(texts, monkeypatch):
+    """Route the CLI's single lowering call to the fixture texts and
+    count how often it happens."""
+    calls = []
+
+    def fake_lower(programs=None):
+        calls.append(programs)
+        return dict(texts)
+
+    monkeypatch.setattr(hlo, "lower_flagship_texts", fake_lower)
+    return calls
 
 
 def codes_of(findings):
@@ -46,16 +86,23 @@ def codes_of(findings):
 
 
 # ---------------------------------------------------------------------------
-# the tier-1 gate: committed baselines are clean
+# the tier-1 gates: committed baselines are clean
 # ---------------------------------------------------------------------------
 
 def test_committed_baselines_are_clean(summaries):
-    """`python -m tools.lint --hlo` exits 0 on this tree: the lowered
-    flagship programs match tools/lint/data/hlo/ exactly.  A finding
-    here means a perf-relevant structural change — review it, then
-    re-baseline with `--hlo --update-baselines` (docs/static-analysis.md
-    has the policy)."""
+    """`python -m tools.lint --hlo` structure half exits 0 on this
+    tree: the lowered flagship programs match tools/lint/data/hlo/
+    exactly.  A finding here means a perf-relevant structural change —
+    review it, then re-baseline with `--hlo --update-baselines`
+    (docs/static-analysis.md has the policy)."""
     findings = hlo.gate_findings(summaries)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_committed_cost_baselines_are_clean(costs):
+    """The cost half of the same gate: flops/HBM/peak/wire of every
+    flagship program within tolerance of tools/lint/data/hlo/cost/."""
+    findings = cost.cost_gate_findings(costs)
     assert findings == [], "\n".join(f.render() for f in findings)
 
 
@@ -81,11 +128,57 @@ def test_summaries_encode_the_flagship_invariants(summaries):
     assert summaries["decode"]["donated_outputs"] > 0
 
 
+def test_cost_summaries_encode_the_flagship_invariants(costs):
+    """The cost metrics are non-vacuous and mutually consistent: real
+    flops everywhere, per-participant DP flops exactly half the
+    single-device step (the batch splits two ways), wire bytes only in
+    the DP program (= the f32 gradient payload under the ring model's
+    2(P-1)/P factor), donated bytes on every donating program, and the
+    tiny configs all memory-bound."""
+    for name, s in costs.items():
+        assert s["schema"] == cost.COST_SCHEMA
+        assert s["program"] == name
+        assert s["flops"] > 0
+        assert s["hbm_bytes"] > 0
+        assert s["peak_bytes"] > 0
+        assert s["intensity"] == pytest.approx(
+            s["flops"] / s["hbm_bytes"], rel=1e-3)
+        assert s["roofline"] in ("memory-bound", "compute-bound")
+        total_fusions = sum(s["fusion_classes"].values())
+        assert total_fusions > 0
+    assert costs["train_step"]["flops"] == \
+        2 * costs["train_step_dp2"]["flops"]
+    assert costs["train_step"]["wire_bytes"] == 0
+    assert costs["train_step_dp2"]["wire_bytes"] > 0
+    # donation is weighed, not just counted: train step (params/opt
+    # state) and both serve programs (KV arena) carry donated bytes
+    assert costs["train_step"]["donated_bytes"] > 0
+    assert costs["decode"]["donated_bytes"] > 0
+    assert costs["prefill_chunk"]["donated_bytes"] > 0
+
+
 # ---------------------------------------------------------------------------
-# seeded regressions (the acceptance scenarios)
+# the shared-lowering contract ("lower once, audit twice")
 # ---------------------------------------------------------------------------
 
-def test_defused_ce_chunk_is_flagged_with_exit_1(summaries, monkeypatch):
+def test_hlo_and_cost_gates_share_one_lowering(stub_lowering, capsys):
+    """`--hlo` runs the structure gate AND the cost gate from ONE
+    lowering pass per program — the compile cost that keeps the
+    combined audit lane within its tier-1 budget.  A second
+    lower_flagship_texts() call here would double it."""
+    assert lint_main(["--hlo"]) == 0
+    assert stub_lowering == [None], (
+        f"expected exactly one lowering pass for the combined "
+        f"structure+cost audit, saw {len(stub_lowering)}")
+    assert "hlo_audit: clean" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# seeded structural regressions (the ISSUE-7 acceptance scenarios)
+# ---------------------------------------------------------------------------
+
+def test_defused_ce_chunk_is_flagged_with_exit_1(texts, summaries,
+                                                 monkeypatch):
     """A train step whose CE-chunk fusion is broken (fused_loss=False —
     the (B*T, V) logits materialize again) must fail the gate: exit 1
     and a named HLO002 fusion finding for train_step."""
@@ -98,13 +191,16 @@ def test_defused_ce_chunk_is_flagged_with_exit_1(summaries, monkeypatch):
     fus = [f for f in findings if f.code == "HLO002"][0]
     assert "fusion structure drifted" in fus.message
     # and through the front door: `python -m tools.lint --hlo` exits 1
-    monkeypatch.setattr(hlo, "flagship_summaries",
-                        lambda programs=None: broken)
+    # on the defused TEXT (both gates see it — the cost gate flags the
+    # re-materialized logits too)
+    broken_texts = dict(texts, train_step=txt)
+    monkeypatch.setattr(hlo, "lower_flagship_texts",
+                        lambda programs=None: broken_texts)
     assert lint_main(["--hlo"]) == 1
 
 
-def test_moved_collective_is_flagged_with_exit_1(summaries, monkeypatch,
-                                                 capsys):
+def test_moved_collective_is_flagged_with_exit_1(texts, summaries,
+                                                 monkeypatch, capsys):
     """A collective migrating between the entry computation and a loop
     body (the overlap path) must fail the gate with the named HLO004
     placement finding."""
@@ -116,12 +212,101 @@ def test_moved_collective_is_flagged_with_exit_1(summaries, monkeypatch,
     findings = hlo.gate_findings(moved)
     assert codes_of(findings) == ["HLO004"]
     assert "collective placement drifted" in findings[0].message
+    monkeypatch.setattr(hlo, "lower_flagship_texts",
+                        lambda programs=None: dict(texts))
     monkeypatch.setattr(hlo, "flagship_summaries",
-                        lambda programs=None: moved)
+                        lambda programs=None, texts=None: moved)
     assert lint_main(["--hlo", "--json"]) == 1
     doc = json.loads(capsys.readouterr().out)
     assert doc["count"] == 1
     assert doc["findings"][0]["code"] == "HLO004"
+
+
+# ---------------------------------------------------------------------------
+# seeded cost regressions (the ISSUE-9 acceptance scenarios)
+# ---------------------------------------------------------------------------
+
+def test_raised_ce_chunk_count_drifts_flops_and_hbm(texts, costs,
+                                                    monkeypatch, capsys):
+    """Acceptance seed 1: lowering the train step with 8-row CE chunks
+    (4 scan iterations instead of 1) changes analytic flops AND HBM
+    traffic beyond tolerance — named COST002 + COST003 findings, exit 1
+    through the front door, and --update-baselines round-trips with a
+    human-readable metric diff."""
+    txt = hlo.lower_train_step(ce_chunk=8)
+    chunked = dict(costs)
+    chunked["train_step"] = cost.summarize_cost(txt, "train_step")
+    findings = cost.cost_gate_findings(chunked)
+    got = set(codes_of(findings))
+    assert "COST002" in got and "COST003" in got
+    flops_f = [f for f in findings if f.code == "COST002"][0]
+    assert "analytic flops drifted" in flops_f.message
+    assert "%" in flops_f.message and "tolerance" in flops_f.message
+    # front door: exit 1 on the chunked TEXT
+    chunk_texts = dict(texts, train_step=txt)
+    monkeypatch.setattr(hlo, "lower_flagship_texts",
+                        lambda programs=None: chunk_texts)
+    assert lint_main(["--hlo"]) == 1
+    assert "COST002" in capsys.readouterr().out
+    # --update-baselines accepts it with a reviewable diff...
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        diff = cost.update_cost_baselines(costs, d)
+        assert "NEW cost baseline" in diff
+        diff2 = cost.update_cost_baselines(chunked, d)
+        assert "COST002" in diff2 and "COST003" in diff2
+        assert "cost unchanged" in diff2       # the other programs
+        # ...and the gate is clean against the accepted numbers
+        assert cost.cost_gate_findings(chunked, d) == []
+
+
+def test_broken_kv_arena_donation_inflates_peak(texts, costs):
+    """Acceptance seed 2: stripping the decode program's
+    input_output_alias (the KV-arena donation) zeroes its donated
+    bytes — the arena now needs a fresh allocation on top of the
+    still-live argument every dispatch — and the gate names it COST004
+    with the byte cost."""
+    stripped = re.sub(r"input_output_alias=\{.*?\},\s*", "",
+                      texts["decode"], count=1)
+    broken = dict(costs)
+    broken["decode"] = cost.summarize_cost(stripped, "decode")
+    assert broken["decode"]["donated_bytes"] == 0
+    assert costs["decode"]["donated_bytes"] > 0
+    findings = cost.cost_gate_findings(broken)
+    assert "COST004" in codes_of(findings)
+    msg = [f for f in findings if f.code == "COST004"][0].message
+    assert "donation was LOST" in msg
+    assert "peak live memory" in msg
+    # the train step's params/opt-state donation is big enough that the
+    # modeled liveness peak itself inflates too
+    tstripped = re.sub(r"input_output_alias=\{.*?\},\s*", "",
+                       texts["train_step"], count=1)
+    tbroken = cost.summarize_cost(tstripped, "train_step")
+    assert tbroken["peak_bytes"] > costs["train_step"]["peak_bytes"]
+
+
+def test_changed_mesh_size_shifts_wire_bytes(texts, costs, monkeypatch,
+                                             capsys):
+    """Acceptance seed 3: the same all-reduces over a 4-way group
+    instead of 2-way shift per-participant wire bytes by the ring
+    factor (2(P-1)/P: 1.0 -> 1.5, +50%) — named COST005, exit 1."""
+    mesh4 = texts["train_step_dp2"].replace(
+        "replica_groups={{0,1}}", "replica_groups={{0,1,2,3}}")
+    assert mesh4 != texts["train_step_dp2"]
+    shifted = dict(costs)
+    shifted["train_step_dp2"] = cost.summarize_cost(mesh4,
+                                                    "train_step_dp2")
+    assert shifted["train_step_dp2"]["wire_bytes"] == pytest.approx(
+        1.5 * costs["train_step_dp2"]["wire_bytes"], rel=1e-6)
+    findings = cost.cost_gate_findings(shifted)
+    assert codes_of(findings) == ["COST005"]
+    assert "wire bytes" in findings[0].message
+    mesh_texts = dict(texts, train_step_dp2=mesh4)
+    monkeypatch.setattr(hlo, "lower_flagship_texts",
+                        lambda programs=None: mesh_texts)
+    assert lint_main(["--hlo", "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert [f["code"] for f in doc["findings"]] == ["COST005"]
 
 
 # ---------------------------------------------------------------------------
@@ -154,6 +339,23 @@ def test_update_baselines_roundtrip(summaries, tmp_path):
     missing = hlo.gate_findings(summaries, str(tmp_path / "empty"))
     assert codes_of(missing) == ["HLO001"] * 4
     assert all("--update-baselines" in f.message for f in missing)
+
+
+def test_cost_update_prunes_stale_and_reports_missing(costs, tmp_path):
+    """The cost gate mirrors the structural program-set contract:
+    missing baselines, stale baselines and removals are all loud."""
+    d = str(tmp_path / "cost")
+    missing = cost.cost_gate_findings(costs, d)
+    assert codes_of(missing) == ["COST001"] * 4
+    cost.update_cost_baselines(costs, d)
+    assert cost.cost_gate_findings(costs, d) == []
+    subset = {p: s for p, s in costs.items() if p != "decode"}
+    stale = cost.cost_gate_findings(subset, d)
+    assert codes_of(stale) == ["COST001"]
+    diff = cost.update_cost_baselines(subset, d)
+    assert "REMOVED" in diff
+    assert not os.path.exists(os.path.join(d, "decode.json"))
+    assert cost.cost_gate_findings(subset, d) == []
 
 
 def test_update_preserves_waivers_and_prunes_stale(summaries, tmp_path):
@@ -203,14 +405,39 @@ def test_baseline_waiver_contract(summaries, tmp_path):
     assert "HLO942" in out[0].message
 
 
+def test_cost_baseline_waiver_contract(costs, tmp_path):
+    """The SAME waiver contract on the cost family: COST000 hygiene,
+    reasons required, unknown codes loud — one shared implementation
+    (hlo._baseline_suppressions) so the two families cannot drift."""
+    d = str(tmp_path / "cost")
+    cost.update_cost_baselines(costs, d)
+    path = os.path.join(d, "train_step_dp2.json")
+    mutated = dict(costs)
+    mutated["train_step_dp2"] = dict(costs["train_step_dp2"],
+                                     wire_bytes=0)
+
+    doc = json.load(open(path))
+    doc["suppress"] = {"COST005": "wire model tracked upstream"}
+    json.dump(doc, open(path, "w"))
+    assert cost.cost_gate_findings(mutated, d) == []
+
+    doc["suppress"] = {"COST005": ""}
+    json.dump(doc, open(path, "w"))
+    out = cost.cost_gate_findings(mutated, d)
+    assert codes_of(out) == ["COST000", "COST005"]
+
+    doc["suppress"] = {"COST942": "because"}
+    json.dump(doc, open(path, "w"))
+    out = cost.cost_gate_findings(mutated, d)
+    assert "COST000" in codes_of(out)
+    assert "COST942" in out[0].message
+
+
 # ---------------------------------------------------------------------------
 # CLI exit codes + JSON schema (front door, lowering stubbed)
 # ---------------------------------------------------------------------------
 
-def test_cli_clean_exit_0_and_json_payload(summaries, monkeypatch,
-                                           capsys):
-    monkeypatch.setattr(hlo, "flagship_summaries",
-                        lambda programs=None: summaries)
+def test_cli_clean_exit_0_and_json_payload(costs, stub_lowering, capsys):
     assert lint_main(["--hlo"]) == 0
     assert "hlo_audit: clean" in capsys.readouterr().out
     assert lint_main(["--hlo", "--json"]) == 0
@@ -218,46 +445,168 @@ def test_cli_clean_exit_0_and_json_payload(summaries, monkeypatch,
     assert doc["version"] == 1 and doc["count"] == 0
     assert doc["findings"] == []
     # the drift-history payload rides the JSON output (bench.py appends
-    # it to the record store)
-    assert doc["hlo"]["programs"] == len(summaries)
+    # it to the record store) — now extended with the cost numerics
+    assert doc["hlo"]["programs"] == len(hlo.FLAGSHIP_PROGRAMS)
     assert doc["hlo"]["drifted"] == 0
-    for k in ("fusions", "collectives", "while_loops"):
+    for k in ("fusions", "collectives", "while_loops",
+              "flops", "hbm_bytes", "peak_bytes", "wire_bytes"):
         assert isinstance(doc["hlo"][k], int) and doc["hlo"][k] >= 0
+    assert doc["hlo"]["flops"] == sum(s["flops"] for s in costs.values())
+    assert doc["hlo"]["peak_bytes"] == max(s["peak_bytes"]
+                                           for s in costs.values())
+    assert set(doc["hlo"]["cost_per_program"]) == set(costs)
 
 
-def test_cli_update_baselines_prints_reviewable_diff(summaries,
+def test_cli_update_baselines_prints_reviewable_diff(stub_lowering,
                                                      monkeypatch,
                                                      tmp_path, capsys):
-    monkeypatch.setattr(hlo, "flagship_summaries",
-                        lambda programs=None: summaries)
     monkeypatch.setattr(hlo, "BASELINE_DIR", str(tmp_path / "hlo"))
+    monkeypatch.setattr(cost, "COST_BASELINE_DIR",
+                        str(tmp_path / "hlo" / "cost"))
     assert lint_main(["--hlo", "--update-baselines"]) == 0
     out = capsys.readouterr().out
-    assert "NEW baseline" in out and "baselines updated" in out
+    assert "NEW baseline" in out and "NEW cost baseline" in out
+    assert "baselines updated" in out
     assert lint_main(["--hlo"]) == 0
 
 
 # ---------------------------------------------------------------------------
-# the hlo_audit record kind (drift history in runs/records.jsonl)
+# the tools/hlo_audit.py shim (deprecated standalone CLI)
 # ---------------------------------------------------------------------------
 
-def test_hlo_audit_record_schema_roundtrip(summaries, tmp_path):
-    """An hlo_audit store entry validates end-to-end (the record_check
-    CI contract); a truncated one is named-field rejected."""
+def test_hlo_audit_shim_forwards_and_points_at_front_door(monkeypatch,
+                                                          capsys):
+    """ISSUE-9 satellite: the shim forwards --update-baselines/--json
+    and the exit code through to hlo_main unchanged, and prints the
+    one-line deprecation pointer to `python -m tools.lint --hlo`."""
+    from tools import hlo_audit as shim
+    seen = []
+
+    def fake_hlo_main(update=False, json_out=False, **kw):
+        seen.append((update, json_out))
+        return 7
+
+    monkeypatch.setattr(shim, "hlo_main", fake_hlo_main)
+    assert shim.main(["--update-baselines"]) == 7
+    assert shim.main(["--json"]) == 7
+    assert seen == [(True, False), (False, True)]
+    assert "tools.lint --hlo" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# the hlo_audit record kind (drift + cost history in runs/records.jsonl)
+# ---------------------------------------------------------------------------
+
+def test_hlo_audit_record_schema_roundtrip(summaries, costs, tmp_path):
+    """An hlo_audit store entry with the EXTENDED cost numerics
+    validates end-to-end (the record_check CI contract); one missing a
+    cost field is named-field rejected — zeros cannot silently stand in
+    for measurements."""
     from singa_tpu.obs import record as obs_record
     from singa_tpu.obs import schema
 
-    payload = hlo.audit_payload(summaries, [])
+    payload = hlo.audit_payload(summaries, [], costs)
     assert payload["programs"] == len(summaries)
+    assert payload["flops"] > 0 and payload["hbm_bytes"] > 0
+    assert payload["peak_bytes"] > 0 and payload["wire_bytes"] > 0
     store = obs_record.RunRecord(str(tmp_path / "records.jsonl"))
     entry = obs_record.new_entry("hlo_audit", "cpu", True, "cpu",
                                  payload=payload)
     store.append(entry)
     assert store.validate() == []
+    # a payload built WITHOUT the cost pass omits the cost fields and
+    # is rejected — it cannot masquerade as a full audit record
+    bare = dict(entry)
+    bare["payload"] = hlo.audit_payload(summaries, [])
+    with pytest.raises(schema.SchemaError,
+                       match="flops|hbm_bytes|peak_bytes|wire_bytes"):
+        schema.validate_entry(bare)
     bad = dict(entry)
     bad["payload"] = {"programs": 4}
     with pytest.raises(schema.SchemaError, match="drifted|fusions"):
         schema.validate_entry(bad)
+
+
+# ---------------------------------------------------------------------------
+# cost_features(): the autotuner's analytic feature extractor
+# ---------------------------------------------------------------------------
+
+def test_cost_features_stable_documented_dict(texts, costs):
+    """cost_features() (ROADMAP item 4's analytic inputs) returns
+    exactly FEATURE_KEYS per flagship program, numeric except the
+    roofline class, consistent with the gated summaries, and
+    deterministic for fixed texts."""
+    feats = cost.cost_features(texts)
+    assert set(feats) == set(hlo.FLAGSHIP_PROGRAMS)
+    for name, row in feats.items():
+        assert tuple(sorted(row)) == tuple(sorted(cost.FEATURE_KEYS))
+        for k in cost.FEATURE_KEYS:
+            if k == "roofline":
+                assert row[k] in ("memory-bound", "compute-bound")
+            else:
+                assert isinstance(row[k], (int, float))
+                assert not isinstance(row[k], bool)
+        assert row["flops"] == costs[name]["flops"]
+        assert row["peak_bytes"] == costs[name]["peak_bytes"]
+    assert feats == cost.cost_features(texts)
+
+
+# ---------------------------------------------------------------------------
+# the cost parser itself (pure text — no lowering)
+# ---------------------------------------------------------------------------
+
+class TestCostParser:
+    def test_shape_bytes(self):
+        assert cost.shape_bytes("f32[2,16]{1,0}") == 2 * 16 * 4
+        assert cost.shape_bytes("bf16[8]") == 16
+        assert cost.shape_bytes("s32[]") == 4
+        assert cost.shape_bytes(
+            "(s32[], f32[30,256]{1,0}, pred[4]{0})") == 4 + 30*256*4 + 4
+
+    def test_dot_flops_and_trip_weighting(self):
+        text = """HloModule m, is_scheduled=true
+
+%body (p: (s32[], f32[8,16])) -> (s32[], f32[8,16]) {
+  %p = (s32[], f32[8,16]{1,0}) parameter(0)
+  %g0 = s32[] get-tuple-element((s32[], f32[8,16]{1,0}) %p), index=0
+  %g1 = f32[8,16]{1,0} get-tuple-element((s32[], f32[8,16]{1,0}) %p), index=1
+  %w = f32[16,16]{1,0} constant({...})
+  %d = f32[8,16]{1,0} dot(f32[8,16]{1,0} %g1, f32[16,16]{1,0} %w), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %t = (s32[], f32[8,16]{1,0}) tuple(s32[] %g0, f32[8,16]{1,0} %d)
+}
+
+%cond (p: (s32[], f32[8,16])) -> pred[] {
+  %p = (s32[], f32[8,16]{1,0}) parameter(0)
+  %g0 = s32[] get-tuple-element((s32[], f32[8,16]{1,0}) %p), index=0
+  %c = s32[] constant(4)
+  ROOT %lt = pred[] compare(s32[] %g0, s32[] %c), direction=LT
+}
+
+ENTRY %main (a: f32[8,16]) -> (s32[], f32[8,16]) {
+  %a = f32[8,16]{1,0} parameter(0)
+  %z = s32[] constant(0)
+  %t0 = (s32[], f32[8,16]{1,0}) tuple(s32[] %z, f32[8,16]{1,0} %a)
+  ROOT %w = (s32[], f32[8,16]{1,0}) while((s32[], f32[8,16]{1,0}) %t0), condition=%cond, body=%body, backend_config={"known_trip_count":{"n":"4"}}
+}
+"""
+        s = cost.summarize_cost(text, "t")
+        # one (8,16)x(16,16) dot = 2*8*16*16 flops, x4 trips
+        assert s["flops"] == 4 * 2 * 8 * 16 * 16
+
+    def test_wire_factor_needs_real_group(self):
+        text = """HloModule m, is_scheduled=true
+
+ENTRY %main (a: f32[64]) -> f32[64] {
+  %a = f32[64]{0} parameter(0)
+  ROOT %ar = f32[64]{0} all-reduce(f32[64]{0} %a), channel_id=1, replica_groups={{0,1,2,3}}, to_apply=%add
+}
+"""
+        s = cost.summarize_cost(text, "t")
+        # ring all-reduce over P=4: 2*(4-1)/4 * 256 B
+        assert s["wire_bytes"] == int(round(1.5 * 256))
+
+    def test_unknown_dtype_counts_nothing(self):
+        assert cost.shape_bytes("mystery[4,4]") == 0
 
 
 # ---------------------------------------------------------------------------

@@ -368,15 +368,49 @@ class TestWallClock:
         """, "SGL005")
         assert codes_of(out) == ["SGL005"]
 
+    def test_fires_on_datetime_now_and_today(self):
+        """ISSUE 9 satellite: datetime.now()/today() hide the same
+        jumpy wall clock behind an object — same rule, same
+        required-reason suppression contract."""
+        out = lint("""
+            import datetime
+            from datetime import datetime as dt
+
+            def started():
+                return datetime.datetime.now()
+
+            def day():
+                return dt.today()
+        """, "SGL005")
+        assert codes_of(out) == ["SGL005", "SGL005"]
+        assert "datetime.now()" in out[0].message
+        assert "datetime.today()" in out[1].message
+
+    def test_datetime_suppression_requires_reason(self):
+        ok = lint(
+            "import datetime\n"
+            "t = datetime.datetime.now()  # singalint: disable=SGL005 "
+            "human-readable log timestamp, never subtracted\n", "SGL005")
+        assert ok == []
+        bare = lint_source(
+            "import datetime\n"
+            "t = datetime.datetime.now()  # singalint: disable=SGL005\n")
+        assert CODE_SUPPRESSION in codes_of(bare)
+
     def test_clean_on_monotonic_and_perf_counter(self):
         out = lint("""
             import time
+            import datetime
 
             def age(t0):
                 return time.monotonic() - t0
 
             def cost(t0):
                 return time.perf_counter() - t0
+
+            def parse(s):
+                # constructors/parsers are not clock reads
+                return datetime.datetime.fromisoformat(s)
         """, "SGL005")
         assert out == []
 
@@ -646,8 +680,9 @@ class TestOutputAndCli:
         from tools.lint import hlo as hlo_mod
         calls = []
 
-        def fake_hlo_main(update=False, json_out=False, **kw):
-            calls.append(json_out)
+        def fake_hlo_main(update=False, json_out=False, structure=True,
+                          cost_gate=True, **kw):
+            calls.append((json_out, structure, cost_gate))
             return 0
 
         monkeypatch.setattr(hlo_mod, "hlo_main", fake_hlo_main)
@@ -655,8 +690,18 @@ class TestOutputAndCli:
             cli, "run_paths",
             lambda paths, codes=None: [] if [p for p in paths] else [])
         assert lint_main([]) == 0
-        assert calls == [False]
+        # bare run: ONE hlo_main call covering structure AND cost —
+        # the shared-lowering contract at the CLI layer
+        assert calls == [(False, True, True)]
         assert "singalint: clean" in capsys.readouterr().out
+        # --select routes the gate halves through the same single call
+        calls.clear()
+        assert lint_main(["--select", "cost"]) == 0
+        assert calls == [(False, False, True)]
+        calls.clear()
+        assert lint_main(["--select", "hlo"]) == 0
+        assert calls == [(False, True, False)]
+        capsys.readouterr()
         # a failing gate fails the full audit even when static is clean
         monkeypatch.setattr(hlo_mod, "hlo_main",
                             lambda **kw: 1)
@@ -675,15 +720,18 @@ class TestOutputAndCli:
 
     def test_cli_list_rules(self, capsys):
         """The front door is discoverable from --list-rules alone:
-        every SGL rule, every audit mode, every HLO metric code."""
+        every SGL rule, every audit mode, every HLO/COST metric code."""
+        from tools.lint.cost import COST_CODES
         from tools.lint.hlo import HLO_CODES
         assert lint_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
         for code in RULES:
             assert code in out
-        for mode in ("records", "ckpt", "hlo"):
+        for mode in ("records", "ckpt", "hlo", "cost"):
             assert f"\n  {mode}" in out
         for code in HLO_CODES:
+            assert code in out
+        for code in COST_CODES:
             assert code in out
 
     def test_cli_json(self, tmp_path, capsys):

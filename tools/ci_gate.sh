@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# ci_gate.sh — the single pre-merge entry point (README "CI gate").
+#
+# Runs the repo's whole verification ladder in order, cheapest first,
+# with a DISTINCT exit code per stage so a red CI run names its stage
+# without log spelunking:
+#
+#   stage 1  full audit   `python -m tools.lint`            exit 10
+#            (static SGL rules + HLO structure gate + cost gate,
+#             one shared lowering — tools/lint/{rules,hlo,cost}.py)
+#   stage 2  records      `python -m tools.lint --records`  exit 11
+#            (telemetry/record store validation incl. the extended
+#             hlo_audit cost numerics)
+#   stage 3  tier-1 tests  the ROADMAP.md tier-1 command     exit 20
+#
+# Exit 0 = every stage green.  Intentional compiled-program changes are
+# re-baselined first via `python -m tools.lint --hlo --update-baselines`
+# (review the printed metric diff in the PR).
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== ci_gate stage 1/3: full audit (static + HLO structure + cost) =="
+JAX_PLATFORMS=cpu python -m tools.lint || exit 10
+
+echo "== ci_gate stage 2/3: record validation =="
+JAX_PLATFORMS=cpu python -m tools.lint --records || exit 11
+
+echo "== ci_gate stage 3/3: tier-1 test suite (ROADMAP.md budget) =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+[ "$rc" -eq 0 ] || exit 20
+
+echo "== ci_gate: all stages green =="

@@ -34,6 +34,10 @@ from tools.lint.hlo import (  # noqa: E402,F401
 
 
 def main(argv: list[str]) -> int:
+    # DEPRECATED entry point: prefer `python -m tools.lint --hlo` (the
+    # audit front door — same gates, same exit codes, plus --select)
+    print("hlo_audit: deprecated shim — use 'python -m tools.lint "
+          "--hlo' (same gates and exit codes)", file=sys.stderr)
     return hlo_main(update="--update-baselines" in argv,
                     json_out="--json" in argv)
 

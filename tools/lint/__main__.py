@@ -8,7 +8,9 @@ Static (explicit paths)::
     python -m tools.lint --list-rules
 
 Full audit (no paths, no mode flags): static rules over the repo's own
-trees (``singa_tpu``, ``tools``) AND the compiled-program HLO gate::
+trees (``singa_tpu``, ``tools``) AND the compiled-program gates — HLO
+structure (hloaudit) plus cost/memory (hlocost), off ONE shared
+lowering::
 
     python -m tools.lint
 
@@ -16,11 +18,12 @@ Dynamic audits (same checks the old standalone CLIs ran)::
 
     python -m tools.lint --records [ROOT]         # telemetry records
     python -m tools.lint --ckpt DIR [DIR ...]     # checkpoint fsck
-    python -m tools.lint --hlo                    # compiled-program gate
+    python -m tools.lint --hlo                    # structure + cost gates
     python -m tools.lint --hlo --update-baselines # reviewed re-baseline
 
 ``--select`` filters audit modes too (``--select hlo``,
-``--select records``, or mixed with SGL codes in the full audit).
+``--select cost``, ``--select records``, or mixed with SGL codes in
+the full audit).
 
 Exit codes: 0 clean, 1 findings/errors, 2 usage error.
 """
@@ -46,9 +49,13 @@ _AUDIT_MODES = {
                "docs, runs/records.jsonl) — also via --records [ROOT]",
     "ckpt": "checkpoint-directory fsck (commit markers, manifests) — "
             "via --ckpt DIR [DIR ...] only, it needs the directory",
-    "hlo": "compiled-program invariant gate: lower the flagship train/"
+    "hlo": "compiled-program structural gate: lower the flagship train/"
            "prefill/decode programs and diff fusions, collectives, "
-           "donation vs tools/lint/data/hlo/ — also via --hlo",
+           "donation vs tools/lint/data/hlo/ — also via --hlo (which "
+           "runs the cost gate too, off ONE shared lowering)",
+    "cost": "compiled-program cost gate (hlocost): flops, HBM traffic, "
+            "peak live memory, collective wire bytes vs "
+            "tools/lint/data/hlo/cost/ — shares the hlo mode's lowering",
 }
 
 #: the trees the bare full-audit invocation lints (repo-relative) —
@@ -57,6 +64,7 @@ _DEFAULT_TREES = ("singa_tpu", "tools")
 
 
 def _list_rules() -> str:
+    from .cost import COST_CODES
     from .hlo import HLO_CODES
     lines = ["singalint rules:"]
     for code, cls in RULES.items():
@@ -71,6 +79,10 @@ def _list_rules() -> str:
                  "metric; waive per-baseline via a 'suppress' entry "
                  "with a reason):")
     for code, (name, desc) in HLO_CODES.items():
+        lines.append(f"  {code}  {name:<21} {desc}")
+    lines.append("cost gate finding codes (relative tolerance per "
+                 "metric; same per-baseline waiver contract):")
+    for code, (name, desc) in COST_CODES.items():
         lines.append(f"  {code}  {name:<21} {desc}")
     return "\n".join(lines)
 
@@ -102,13 +114,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="fsck checkpoint directories instead of "
                              "linting")
     parser.add_argument("--hlo", action="store_true",
-                        help="run the compiled-program invariant gate "
-                             "against tools/lint/data/hlo/ baselines")
+                        help="run the compiled-program gates (structure "
+                             "AND cost, off one shared lowering) against "
+                             "tools/lint/data/hlo/ baselines")
     parser.add_argument("--update-baselines", action="store_true",
                         help="re-lower the flagship programs and "
-                             "rewrite the HLO baselines, printing a "
-                             "human-readable metric diff (implies "
-                             "--hlo)")
+                             "rewrite the HLO structure + cost "
+                             "baselines, printing a human-readable "
+                             "metric diff (implies --hlo)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -160,12 +173,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             parser.error(str(e))
 
     if not args.paths:
-        # the full audit: static rules over the repo trees + the HLO
-        # gate (or the --select'ed subset of both)
+        # the full audit: static rules over the repo trees + the
+        # compiled-program gates (or the --select'ed subset) — the
+        # structure and cost gates always share ONE lowering pass
         run_static = codes is None or bool(codes)
         run_hlo = not args.select or "hlo" in selected_modes
+        run_cost = not args.select or "cost" in selected_modes
         run_records = "records" in selected_modes
         rc = 0
+        findings = []
         if run_static:
             trees = [os.path.join(audit._REPO_ROOT, t)
                      for t in _DEFAULT_TREES]
@@ -173,15 +189,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                 findings = run_paths(trees, codes)
             except ValueError as e:
                 parser.error(str(e))
-            print(render_json(findings) if args.json
-                  else render_human(findings))
+            # with --json AND a gate half, the static findings merge
+            # into the gate's single document — stdout must stay ONE
+            # parseable JSON object
+            if not (args.json and (run_hlo or run_cost)):
+                print(render_json(findings) if args.json
+                      else render_human(findings))
             rc = max(rc, 1 if findings else 0)
         if run_records:
             rc = max(rc, audit.records_main(audit._REPO_ROOT))
-        if run_hlo:
+        if run_hlo or run_cost:
             from .hlo import hlo_main
             try:
-                rc = max(rc, hlo_main(json_out=args.json))
+                rc = max(rc, hlo_main(
+                    json_out=args.json, structure=run_hlo,
+                    cost_gate=run_cost,
+                    static_findings=findings if args.json else None))
             except RuntimeError as e:
                 parser.error(str(e))
         return rc

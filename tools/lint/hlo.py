@@ -270,27 +270,34 @@ def _histogram_drift(base: Dict[str, int],
     return out
 
 
-def _suppressions_of(baseline: Dict, path: str) -> Tuple[set, List[Finding]]:
+def _baseline_suppressions(baseline: Dict, path: str, codes: Dict,
+                           hygiene_code: str) -> Tuple[set, List[Finding]]:
     """Waived metric codes of one baseline, plus hygiene findings for
-    waivers without a reason / naming unknown codes (HLO000 — which,
-    like SGL000, cannot itself be waived)."""
+    waivers without a reason / naming unknown codes (the hygiene code —
+    HLO000 or COST000 — which, like SGL000, cannot itself be waived).
+    ONE implementation of the baseline-waiver contract, shared by the
+    structural gate and the cost gate (tools/lint/cost.py)."""
     sup = baseline.get("suppress", {})
     waived: set = set()
     bad: List[Finding] = []
     for code, reason in sorted(sup.items() if isinstance(sup, dict) else ()):
-        if code not in HLO_CODES or code == "HLO000":
-            bad.append(Finding(path, 1, 0, "HLO000",
+        if code not in codes or code == hygiene_code:
+            bad.append(Finding(path, 1, 0, hygiene_code,
                                f"baseline waives unknown metric code "
                                f"{code!r} (known: "
-                               f"{', '.join(sorted(HLO_CODES))})"))
+                               f"{', '.join(sorted(codes))})"))
         elif not (isinstance(reason, str) and reason.strip()):
-            bad.append(Finding(path, 1, 0, "HLO000",
+            bad.append(Finding(path, 1, 0, hygiene_code,
                                f"baseline waiver of {code} carries no "
                                f"reason — an unexplained waiver is the "
                                f"silent drift this gate exists to stop"))
         else:
             waived.add(code)
     return waived, bad
+
+
+def _suppressions_of(baseline: Dict, path: str) -> Tuple[set, List[Finding]]:
+    return _baseline_suppressions(baseline, path, HLO_CODES, "HLO000")
 
 
 def diff_summaries(program: str, baseline: Dict, current: Dict,
@@ -375,12 +382,14 @@ def _baseline_path(program: str, baseline_dir: str) -> str:
     return os.path.join(baseline_dir, f"{program}.json")
 
 
-def load_baselines(baseline_dir: Optional[str] = None
-                   ) -> Tuple[Dict[str, Dict], List[Finding]]:
-    """All committed baselines, plus HLO001 findings for unreadable
-    files.  A missing DIRECTORY is not a finding here — the gate
-    reports per-program misses so the message can name the program."""
-    baseline_dir = baseline_dir or BASELINE_DIR
+def load_baselines_dir(baseline_dir: str, code: str,
+                       what: str = "baseline"
+                       ) -> Tuple[Dict[str, Dict], List[Finding]]:
+    """All committed baselines of one family (structure or cost), plus
+    program-set findings for unreadable files.  A missing DIRECTORY is
+    not a finding here — the gate reports per-program misses so the
+    message can name the program.  ONE implementation shared by both
+    gate families so a fix to this path cannot miss one of them."""
     out: Dict[str, Dict] = {}
     bad: List[Finding] = []
     if not os.path.isdir(baseline_dir):
@@ -393,62 +402,61 @@ def load_baselines(baseline_dir: Optional[str] = None
             with open(path, encoding="utf-8") as f:
                 out[name[:-len(".json")]] = json.load(f)
         except (OSError, json.JSONDecodeError) as e:
-            bad.append(Finding(path, 1, 0, "HLO001",
-                               f"unreadable baseline: {e}"))
+            bad.append(Finding(path, 1, 0, code,
+                               f"unreadable {what}: {e}"))
     return out, bad
 
 
-def gate_findings(summaries: Dict[str, Dict],
-                  baseline_dir: Optional[str] = None) -> List[Finding]:
-    """Diff lowered summaries against the committed baselines; the
-    gate's whole verdict as findings ([] = clean)."""
-    baseline_dir = baseline_dir or BASELINE_DIR
-    baselines, findings = load_baselines(baseline_dir)
+def gate_findings_dir(summaries: Dict[str, Dict], baseline_dir: str,
+                      code: str, what: str, diff_fn,
+                      review_hint: str) -> List[Finding]:
+    """The shared program-set gate core: diff each lowered program
+    against its committed baseline via ``diff_fn``, and make misses
+    loud in BOTH directions (no baseline / stale baseline) under the
+    family's program-set ``code``."""
+    baselines, findings = load_baselines_dir(baseline_dir, code, what)
     for program, summary in summaries.items():
         path = _baseline_path(program, baseline_dir)
         base = baselines.get(program)
         if base is None:
             findings.append(Finding(
-                path, 1, 0, "HLO001",
-                f"[{program}] no committed baseline — run 'python -m "
+                path, 1, 0, code,
+                f"[{program}] no committed {what} — run 'python -m "
                 f"tools.lint --hlo --update-baselines' and review the "
-                f"summary it writes"))
+                f"{review_hint} it writes"))
             continue
-        findings.extend(diff_summaries(program, base, summary, path))
+        findings.extend(diff_fn(program, base, summary, path))
     for program in sorted(set(baselines) - set(summaries)):
         findings.append(Finding(
-            _baseline_path(program, baseline_dir), 1, 0, "HLO001",
-            f"[{program}] baseline exists but the program was not "
+            _baseline_path(program, baseline_dir), 1, 0, code,
+            f"[{program}] {what} exists but the program was not "
             f"lowered — renamed/removed program, or a partial audit; "
-            f"delete the stale baseline or fix the lowering"))
+            f"delete the stale {what} or fix the lowering"))
     return sorted(findings, key=lambda f: (f.path, f.code))
 
 
-def update_baselines(summaries: Dict[str, Dict],
-                     baseline_dir: Optional[str] = None) -> str:
-    """Write the summaries as the new baselines (preserving each
-    program's ``suppress`` block) and return the human-readable metric
+def update_baselines_dir(summaries: Dict[str, Dict], baseline_dir: str,
+                         code: str, what: str, diff_fn, describe,
+                         unchanged_label: str) -> str:
+    """The shared ``--update-baselines`` core: write the summaries as
+    the new baselines (preserving each program's ``suppress`` block,
+    pruning stale programs loudly) and return the human-readable metric
     diff — the reviewed artifact of an intentional change."""
-    baseline_dir = baseline_dir or BASELINE_DIR
     os.makedirs(baseline_dir, exist_ok=True)
-    old, _bad = load_baselines(baseline_dir)
+    old, _bad = load_baselines_dir(baseline_dir, code, what)
     lines: List[str] = []
     for program, summary in summaries.items():
         path = _baseline_path(program, baseline_dir)
         base = old.get(program)
         if base is None:
-            lines.append(f"{program}: NEW baseline "
-                         f"({summary['fusions']['total']} fusions, "
-                         f"{summary['collectives']['total']} collectives, "
-                         f"{summary['while_loops']} while loops, "
-                         f"{summary['donated_outputs']} donated outputs)")
+            lines.append(f"{program}: NEW {what} ({describe(summary)})")
         else:
-            drifted = diff_summaries(program, base, summary, path)
+            drifted = diff_fn(program, base, summary, path)
             if drifted:
                 lines.append(f"{program}:")
                 lines.extend(f"  {f.code} {f.message}" for f in drifted)
             else:
-                lines.append(f"{program}: unchanged")
+                lines.append(f"{program}: {unchanged_label}")
             sup = base.get("suppress")
             if sup:
                 summary = dict(summary, suppress=sup)
@@ -457,17 +465,51 @@ def update_baselines(summaries: Dict[str, Dict],
             f.write("\n")
     for program in sorted(set(old) - set(summaries)):
         os.remove(_baseline_path(program, baseline_dir))
-        lines.append(f"{program}: baseline REMOVED (program no longer "
+        lines.append(f"{program}: {what} REMOVED (program no longer "
                      f"lowered)")
     return "\n".join(lines)
 
 
+def load_baselines(baseline_dir: Optional[str] = None
+                   ) -> Tuple[Dict[str, Dict], List[Finding]]:
+    """The structural family's committed baselines (HLO001 findings for
+    unreadable files)."""
+    return load_baselines_dir(baseline_dir or BASELINE_DIR, "HLO001")
+
+
+def gate_findings(summaries: Dict[str, Dict],
+                  baseline_dir: Optional[str] = None) -> List[Finding]:
+    """Diff lowered summaries against the committed baselines; the
+    gate's whole verdict as findings ([] = clean)."""
+    return gate_findings_dir(summaries, baseline_dir or BASELINE_DIR,
+                             "HLO001", "baseline", diff_summaries,
+                             "summary")
+
+
+def update_baselines(summaries: Dict[str, Dict],
+                     baseline_dir: Optional[str] = None) -> str:
+    """Write the summaries as the new structural baselines; see
+    :func:`update_baselines_dir`."""
+    return update_baselines_dir(
+        summaries, baseline_dir or BASELINE_DIR, "HLO001", "baseline",
+        diff_summaries,
+        lambda s: (f"{s['fusions']['total']} fusions, "
+                   f"{s['collectives']['total']} collectives, "
+                   f"{s['while_loops']} while loops, "
+                   f"{s['donated_outputs']} donated outputs"),
+        "unchanged")
+
+
 def audit_payload(summaries: Dict[str, Dict],
-                  findings: Iterable[Finding]) -> Dict:
+                  findings: Iterable[Finding],
+                  cost_summaries: Optional[Dict[str, Dict]] = None) -> Dict:
     """The ``hlo_audit`` record payload (obs.schema): the drift-history
     quantities that accumulate in runs/records.jsonl next to the perf
-    trajectory."""
-    return {
+    trajectory.  With ``cost_summaries`` (tools/lint/cost.py — the
+    normal full-audit case), the payload carries the extended cost
+    numerics too: total flops / HBM / wire bytes, the max per-program
+    peak, and the per-program feature rows the autotuner consumes."""
+    payload = {
         "programs": len(summaries),
         "drifted": len(list(findings)),
         "fusions": sum(s["fusions"]["total"] for s in summaries.values()),
@@ -475,6 +517,22 @@ def audit_payload(summaries: Dict[str, Dict],
                            for s in summaries.values()),
         "while_loops": sum(s["while_loops"] for s in summaries.values()),
     }
+    if cost_summaries is not None:
+        # omitted entirely when the cost pass did not run: a record
+        # with literal-zero flops would read as a measurement, and the
+        # schema's required-field check then rejects the append loudly
+        cs = cost_summaries
+        payload["flops"] = sum(s["flops"] for s in cs.values())
+        payload["hbm_bytes"] = sum(s["hbm_bytes"] for s in cs.values())
+        payload["wire_bytes"] = sum(s["wire_bytes"] for s in cs.values())
+        payload["peak_bytes"] = max(
+            (s["peak_bytes"] for s in cs.values()), default=0)
+        payload["cost_per_program"] = {
+            name: {"flops": s["flops"], "hbm_bytes": s["hbm_bytes"],
+                   "peak_bytes": s["peak_bytes"],
+                   "wire_bytes": s["wire_bytes"]}
+            for name, s in sorted(cs.items())}
+    return payload
 
 
 # ---------------------------------------------------------------------------
@@ -501,13 +559,16 @@ def _ensure_cpu_backend() -> None:
     jax.config.update("jax_default_matmul_precision", "highest")
 
 
-def lower_train_step(dp: bool = False, fused_loss: bool = True) -> str:
+def lower_train_step(dp: bool = False, fused_loss: bool = True,
+                     ce_chunk: Optional[int] = None) -> str:
     """Optimized-HLO text of the flagship (tiny-config) compiled train
     step: Llama + fused CE-chunk loss + SGD, through the real graph
     executor — so the audited module IS the module training runs.  With
     ``dp``, the same step under a 2-way 'data' mesh with DistOpt (the
     in-graph gradient all-reduce).  ``fused_loss=False`` builds the
-    deliberately-defused variant the regression tests feed the gate."""
+    deliberately-defused variant the regression tests feed the gate;
+    ``ce_chunk`` overrides ``fused_loss_chunk`` (the cost-gate tests
+    lower a many-chunk variant to prove flops/HBM drift is caught)."""
     _ensure_cpu_backend()
     import numpy as np
     from singa_tpu import models, opt, parallel, tensor
@@ -524,6 +585,8 @@ def lower_train_step(dp: bool = False, fused_loss: bool = True) -> str:
     cfg = models.LlamaConfig.tiny()
     cfg.num_layers = 1
     cfg.fused_loss = fused_loss
+    if ce_chunk is not None:
+        cfg.fused_loss_chunk = ce_chunk
     saved_mesh = parallel.current_mesh()
     try:
         if dp:
@@ -586,10 +649,15 @@ def lower_flagship_texts(programs: Optional[Iterable[str]] = None
     return {name: texts[name] for name in wanted}
 
 
-def flagship_summaries(programs: Optional[Iterable[str]] = None
+def flagship_summaries(programs: Optional[Iterable[str]] = None,
+                       texts: Optional[Dict[str, str]] = None
                        ) -> Dict[str, Dict]:
-    return {name: summarize_hlo(text, name)
-            for name, text in lower_flagship_texts(programs).items()}
+    """Structural summary per flagship program.  Pass already-lowered
+    ``texts`` to reuse a lowering (the cost gate shares ONE lowering
+    pass with this gate — lower once, audit twice)."""
+    if texts is None:
+        texts = lower_flagship_texts(programs)
+    return {name: summarize_hlo(text, name) for name, text in texts.items()}
 
 
 # ---------------------------------------------------------------------------
@@ -598,49 +666,51 @@ def flagship_summaries(programs: Optional[Iterable[str]] = None
 
 def hlo_main(update: bool = False, json_out: bool = False,
              baseline_dir: Optional[str] = None,
-             record_store: Optional[str] = None) -> int:
-    """Lower, summarize, and gate (or re-baseline).  Exit codes follow
-    the lint front door: 0 clean, 1 findings.  With ``record_store``,
-    append an ``hlo_audit`` entry so drift history lands in the durable
-    run-record store (bench.py passes runs/records.jsonl)."""
+             structure: bool = True, cost_gate: bool = True,
+             cost_baseline_dir: Optional[str] = None,
+             static_findings: Optional[List[Finding]] = None) -> int:
+    """Lower ONCE, then audit twice: the structural gate (fusions,
+    collectives, donation — HLO00x) and the cost gate (flops, HBM
+    traffic, peak memory, wire bytes — COST00x, tools/lint/cost.py)
+    both summarize the SAME lowered texts.  ``structure``/``cost_gate``
+    select the halves (``--select hlo`` / ``--select cost``); with
+    ``update``, both baseline families are rewritten with a
+    human-readable metric diff.  Exit codes follow the lint front door:
+    0 clean, 1 findings.  ``static_findings`` merges the bare full
+    audit's static results into the single ``json_out`` document (the
+    --json contract: stdout is ONE parseable object); drift history
+    reaches runs/records.jsonl via bench.py, which runs this CLI with
+    --json in a pinned-CPU subprocess and appends the ``hlo`` payload."""
     from .framework import render_human, render_json
+    from . import cost
 
-    summaries = flagship_summaries()
+    texts = lower_flagship_texts()
+    summaries = flagship_summaries(texts=texts) if structure else {}
+    cost_summaries = cost.cost_summaries(texts) if cost_gate else None
     if update:
-        diff = update_baselines(summaries, baseline_dir)
-        print(diff)
+        parts = []
+        if structure:
+            parts.append(update_baselines(summaries, baseline_dir))
+        if cost_gate:
+            parts.append(cost.update_cost_baselines(
+                cost_summaries, cost_baseline_dir))
+        print("\n".join(parts))
         print(f"hlo_audit: baselines updated under "
-              f"{baseline_dir or BASELINE_DIR} — review the diff above")
+              f"{baseline_dir or BASELINE_DIR}"
+              + (f" and {cost_baseline_dir or cost.COST_BASELINE_DIR}"
+                 if cost_gate else "")
+              + " — review the diff above")
         return 0
-    findings = gate_findings(summaries, baseline_dir)
+    findings = gate_findings(summaries, baseline_dir) if structure else []
+    if cost_gate:
+        findings = findings + cost.cost_gate_findings(
+            cost_summaries, cost_baseline_dir)
     if json_out:
-        doc = json.loads(render_json(findings))
-        doc["hlo"] = audit_payload(summaries, findings)
+        doc = json.loads(render_json(list(static_findings or []) +
+                                     findings))
+        doc["hlo"] = audit_payload(summaries, findings, cost_summaries)
         print(json.dumps(doc, indent=2, sort_keys=True))
     else:
         # same rendering as the static rules; only the banner differs
         print(render_human(findings).replace("singalint:", "hlo_audit:"))
-    if record_store:
-        _append_record(record_store, summaries, findings)
     return 1 if findings else 0
-
-
-def _append_record(store: str, summaries: Dict[str, Dict],
-                   findings: List[Finding]) -> None:
-    """Best-effort ``hlo_audit`` entry append — the record is drift
-    evidence, not a dependency."""
-    import sys
-    import warnings
-    try:
-        import jax
-        from singa_tpu.obs import record as obs_record
-        platform = jax.default_backend()
-        entry = obs_record.new_entry(
-            "hlo_audit", platform, platform != "tpu", platform,
-            run_id=obs_record.new_run_id("hloaudit"),
-            payload=audit_payload(summaries, findings))
-        obs_record.RunRecord(store).append(entry)
-        print(f"hlo_audit: entry appended to {store}", file=sys.stderr)
-    except Exception as e:  # noqa: BLE001
-        warnings.warn(f"could not append hlo_audit record: "
-                      f"{type(e).__name__}: {e}", stacklevel=2)

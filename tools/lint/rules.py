@@ -661,25 +661,37 @@ class ThreadSeamRule(Rule):
 class WallClockRule(Rule):
     code = "SGL005"
     name = "wall-clock"
-    description = ("time.time() is banned (monotonic-only rule): "
-                   "wall-clock jumps (NTP step, suspend/resume) corrupt "
-                   "durations and deadlines — use time.monotonic()/"
-                   "perf_counter(), or suppress with a reason for "
-                   "genuine timestamps")
+    description = ("time.time() / datetime.now() / datetime.today() are "
+                   "banned (monotonic-only rule): wall-clock jumps (NTP "
+                   "step, suspend/resume) corrupt durations and "
+                   "deadlines — use time.monotonic()/perf_counter(), or "
+                   "suppress with a reason for genuine timestamps")
+
+    #: wall-clock reads, post-``resolve()``: ``time.time`` plus the
+    #: datetime spellings that hide the same jumpy clock behind an
+    #: object (subtracting two ``datetime.now()`` results is the same
+    #: NTP/suspend hazard as subtracting two ``time.time()`` results)
+    _WALL_CLOCKS = {
+        "time.time": "time.time()",
+        "datetime.datetime.now": "datetime.now()",
+        "datetime.datetime.today": "datetime.today()",
+    }
 
     def check(self, tree: ast.Module, src: str,
               path: str) -> Iterable[Finding]:
         imports = import_map(tree)
         for node in module_calls(tree):
-            if resolve(node.func, imports) == "time.time":
+            spelled = self._WALL_CLOCKS.get(
+                resolve(node.func, imports) or "")
+            if spelled:
                 yield self.finding(
                     path, node,
-                    "time.time() reads the wall clock, which can jump "
-                    "(NTP, suspend/resume): use time.monotonic() for "
-                    "deadlines/durations or time.perf_counter() for "
-                    "timing; timestamps that must correlate across "
-                    "hosts are the one legitimate use — suppress with "
-                    "that reason")
+                    f"{spelled} reads the wall clock, which can jump "
+                    f"(NTP, suspend/resume): use time.monotonic() for "
+                    f"deadlines/durations or time.perf_counter() for "
+                    f"timing; timestamps that must correlate across "
+                    f"hosts are the one legitimate use — suppress with "
+                    f"that reason")
 
 
 # ---------------------------------------------------------------------------
