@@ -61,6 +61,11 @@ def main():
                    help="adafactor = factored second moment (r+c floats "
                         "per matrix instead of r*c) with relative step "
                         "size — the big-model TPU recipe")
+    p.add_argument("--int8-ring", action="store_true",
+                   help="int8-ring quantized gradient sync with error "
+                        "feedback (DistOpt compression='int8_ring'; "
+                        "pays off on slow inter-host links — see "
+                        "docs/parallelism.md)")
     p.add_argument("--zero1", action="store_true",
                    help="ZeRO-1 weight-update sharding: optimizer "
                         "moments sharded over the data axis (1/N HBM)")
@@ -152,8 +157,9 @@ def main():
                 "adafactor": lambda: opt.Adafactor(lr=args.lr),
                 "sgd": lambda: opt.SGD(lr=lr, momentum=0.9),
                 }[args.opt]()
-    m.set_optimizer(opt.DistOpt(base_opt,
-                                shard_weight_update=args.zero1))
+    m.set_optimizer(opt.DistOpt(
+        base_opt, shard_weight_update=args.zero1,
+        compression="int8_ring" if args.int8_ring else None))
     vocab = min(cfg.vocab_size, 32000)
     ids_np = np.random.RandomState(0).randint(
         0, vocab, (args.batch, args.seq)).astype(np.int32)

@@ -504,7 +504,8 @@ class _StepExecutor:
             P = mesh_mod.P
             if isinstance(self.opt, DistOpt) and (
                     self.opt.compress_dtype is not None
-                    or self.opt.topk_ratio):
+                    or self.opt.topk_ratio
+                    or self.opt.compression is not None):
                 import warnings
                 warnings.warn(
                     "DistOpt compressed/sparsified allreduce applies only on "
@@ -550,8 +551,19 @@ class _StepExecutor:
                   for a in example_arrays])
             out_specs_leaves = jax.tree.map(
                 lambda s: P() if len(s.shape) == 0 else P(axis), shapes[0])
-            out_specs = (out_specs_leaves, P(), P(), P())
-            in_specs = (P(), P(), P(), P(), P()) + tuple(
+            # optimizer state is replicated EXCEPT the error-feedback
+            # residual of compression="int8_ring": per-rank state with a
+            # leading world axis, sharded over 'data' so each rank owns
+            # exactly its own slice (replicating it would be wrong, not
+            # wasteful — the copies diverge by construction, and a
+            # checkpoint would capture rank 0's residual for everyone)
+            self._ef_sharded = (isinstance(self.opt, DistOpt)
+                                and self.opt.compression is not None)
+            slot_specs = ({n: {"base": P(), "ef": P(axis)}
+                           for n in self.slots} if self._ef_sharded
+                          else P())
+            out_specs = (out_specs_leaves, P(), P(), slot_specs)
+            in_specs = (P(), P(), slot_specs, P(), P()) + tuple(
                 P(axis) for _ in example_arrays)
             wrapped = jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
                                     out_specs=out_specs, check_vma=False)
@@ -582,7 +594,17 @@ class _StepExecutor:
             shard = mesh_mod.NamedSharding(self.mesh, mesh_mod.P(self.opt.data_axis))
             params = {n: place(a, rep) for n, a in params.items()}
             buffers = {n: place(a, rep) for n, a in buffers.items()}
-            self.slots = jax.tree.map(lambda a: place(a, rep), self.slots)
+            if getattr(self, "_ef_sharded", False):
+                # error-feedback residuals shard over 'data' (per-rank
+                # state); everything else in the slot replicates
+                self.slots = {
+                    n: {k: (place(v, shard) if k == "ef"
+                            else jax.tree.map(lambda a: place(a, rep), v))
+                        for k, v in s.items()}
+                    for n, s in self.slots.items()}
+            else:
+                self.slots = jax.tree.map(lambda a: place(a, rep),
+                                          self.slots)
             step = place(step, rep)
             rng = place(rng, rep)
             batch_arrays = tuple(place(a, shard) for a in batch_arrays)

@@ -39,7 +39,7 @@ __all__ = ["SCHEMA_VERSION", "SchemaError", "require", "validate_entry",
            "validate_multichip_doc", "validate_serve_payload",
            "validate_serve_load_payload", "validate_train_run_payload",
            "validate_incident_payload", "validate_hlo_audit_payload",
-           "entry_key"]
+           "validate_wire_byte_fields", "entry_key"]
 
 #: bump when entry fields change incompatibly; validators dispatch on it
 SCHEMA_VERSION = 1
@@ -66,6 +66,15 @@ _SERVE_LOAD_FIELDS = ("requests", "completed", "shed", "rejected",
 #: every run: how far it got, how long it took, how many checkpoints
 #: it landed, and where it resumed from (-1 = fresh start)
 _TRAIN_RUN_FIELDS = ("steps", "wall_s", "ckpt_count", "resumed_from")
+
+#: the gradient-sync wire-byte pair (DistOpt compression="int8_ring" /
+#: bench.py --quantized): per-participant bytes the wire actually
+#: carried vs what f32 collectives would have cost.  OPTIONAL on
+#: train_run and bench payloads — but a record carrying either must
+#: carry BOTH as numerics (a lone "compressed" number with no f32
+#: reference cannot support a reduction claim), linted exactly like the
+#: required fields
+_WIRE_BYTE_FIELDS = ("wire_bytes_compressed", "wire_bytes_f32_equiv")
 
 #: required numeric payload fields of an hlo_audit entry — one run of
 #: the compiled-program invariant gates (tools/lint/hlo.py structure +
@@ -200,6 +209,8 @@ def validate_entry(entry: Any, ctx: str = "entry") -> None:
             validate_incident_payload(payload, f"{ctx}: incident payload")
         elif kind == "hlo_audit":
             validate_hlo_audit_payload(payload, f"{ctx}: hlo_audit payload")
+        elif kind == "bench":
+            validate_wire_byte_fields(payload, f"{ctx}: bench payload")
 
 
 def _require_numeric_fields(payload: Any, fields: Tuple[str, ...],
@@ -230,12 +241,26 @@ def validate_serve_load_payload(payload: Any,
     _require_numeric_fields(payload, _SERVE_LOAD_FIELDS, ctx)
 
 
+def validate_wire_byte_fields(payload: Any, ctx: str = "payload") -> None:
+    """The optional gradient-sync wire-byte pair: a payload carrying
+    EITHER of ``_WIRE_BYTE_FIELDS`` must carry both, numeric — a
+    compressed byte count without its f32-equivalent reference (or vice
+    versa) cannot support the reduction claim the pair exists to make."""
+    if not isinstance(payload, dict):
+        return
+    if any(f in payload for f in _WIRE_BYTE_FIELDS):
+        _require_numeric_fields(payload, _WIRE_BYTE_FIELDS, ctx)
+
+
 def validate_train_run_payload(payload: Any,
                                ctx: str = "train_run payload") -> None:
     """The orchestrator's run outcome: every field in
     ``_TRAIN_RUN_FIELDS`` present and numeric, so a run that aborted
-    mid-write can never masquerade as a complete record."""
+    mid-write can never masquerade as a complete record; the optional
+    wire-byte pair (``wire_bytes_compressed`` / ``wire_bytes_f32_equiv``,
+    quantized-sync runs) is linted whenever either appears."""
     _require_numeric_fields(payload, _TRAIN_RUN_FIELDS, ctx)
+    validate_wire_byte_fields(payload, ctx)
 
 
 def validate_hlo_audit_payload(payload: Any,

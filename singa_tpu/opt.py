@@ -531,6 +531,12 @@ class GradAccum(Optimizer):
 # collective over the 'data' mesh axis (BASELINE.json:5)
 # ---------------------------------------------------------------------------
 
+#: DistOpt gradient-compression modes with first-class optimizer state
+#: (error-feedback residuals); `compress_dtype` keeps covering the
+#: stateless casts/quantizers
+_COMPRESSION_MODES = ("int8_ring",)
+
+
 class DistOpt(Optimizer):
     """Wraps a base optimizer with gradient synchronization.
 
@@ -541,12 +547,29 @@ class DistOpt(Optimizer):
       * fp16/bf16-compressed allreduce  (`backward_and_update_half`)
       * top-K sparsified allreduce      (`backward_and_update_partial`,
         fixed-K all-gather formulation — XLA-friendly; SURVEY.md §7.3.4)
-    """
+
+    ``compression="int8_ring"`` is the production byte-reduction mode
+    (EQuARX-style blockwise-int8 ring RS+AG, ~4x fewer wire bytes) with
+    **error-feedback accumulation**: a per-parameter, PER-RANK f32
+    residual rides the optimizer slots as ``{"base": <inner slot>,
+    "ef": (world, *param.shape) residual}``, is added to the gradient
+    before quantization and refilled with the quantization error after
+    decode.  Because it is ordinary optimizer state, the graph executor
+    donates it and shards it over the data axis (each rank physically
+    owns its slice — the cross-replica 1/N layout), and checkpoints
+    carry EVERY rank's residual — kill-and-resume stays bitwise
+    including the residuals.  The decode is bitwise deterministic
+    (communicator contract: fixed block order, fixed per-hop requantize
+    grids, consensus scales).  See docs/parallelism.md "Quantized
+    gradient sync"."""
 
     def __init__(self, opt: Optimizer, nccl_id=None, local_rank: int = 0,
                  world_size: Optional[int] = None, data_axis: str = "data",
                  compress_dtype=None, topk_ratio: float = 0.0,
-                 shard_weight_update: bool = False):
+                 shard_weight_update: bool = False,
+                 compression: Optional[str] = None,
+                 error_feedback: bool = True,
+                 compression_block: int = 256):
         super().__init__(opt.sched)
         self.opt = opt
         self.data_axis = data_axis
@@ -554,6 +577,18 @@ class DistOpt(Optimizer):
         self.topk_ratio = topk_ratio
         self.local_rank = local_rank
         self._world_size = world_size
+        if compression is not None and compression not in _COMPRESSION_MODES:
+            raise ValueError(
+                f"unknown compression mode {compression!r} "
+                f"(known: {_COMPRESSION_MODES})")
+        if compression is not None and (compress_dtype is not None
+                                        or topk_ratio):
+            raise ValueError(
+                "compression= is exclusive with compress_dtype=/"
+                "topk_ratio= — pick one gradient-sync variant")
+        self.compression = compression
+        self.error_feedback = bool(error_feedback)
+        self.compression_block = int(compression_block)
         # ZeRO-1 / cross-replica weight-update sharding (beyond the
         # reference Communicator; PAPERS.md "Automatic Cross-Replica
         # Sharding of Weight Update in Data-Parallel Training"): the
@@ -572,15 +607,45 @@ class DistOpt(Optimizer):
             return m.shape[self.data_axis]
         return 1
 
-    # functional core delegates to the wrapped optimizer
+    # functional core delegates to the wrapped optimizer; under
+    # compression="int8_ring" it wraps every slot as
+    # {"base": <inner slot>, "ef": f32 residual} so the error-feedback
+    # state is ordinary donated/sharded/checkpointed optimizer state.
+    #
+    # The residual is PER-RANK state (each rank accumulates the
+    # quantization error of ITS OWN wire contribution), so its global
+    # shape is (world, *param.shape) and the graph executor shards it
+    # over the data axis — each rank physically owns exactly its slice
+    # (the ZeRO-style 1/N layout, arXiv:2004.13336, applied to the
+    # residual).  Declaring it replicated instead would be a
+    # correctness bug, not just waste: the per-device copies diverge by
+    # construction, a checkpoint would capture rank 0's copy for
+    # everyone, and kill-and-resume would silently change the
+    # trajectory (caught by the bitwise resume test).
     def init(self, params):
-        return self.opt.init(params)
+        base = self.opt.init(params)
+        if self.compression is None:
+            return base
+        w = max(1, self.world_size)
+        return {n: {"base": base.get(n),
+                    "ef": jnp.zeros((w,) + tuple(p.shape), jnp.float32)}
+                for n, p in params.items()}
 
     def _init_slot(self, p):
-        return self.opt._init_slot(p)
+        inner = self.opt._init_slot(p)
+        if self.compression is None:
+            return inner
+        w = max(1, self.world_size)
+        return {"base": inner,
+                "ef": jnp.zeros((w,) + tuple(p.shape), jnp.float32)}
 
     def apply(self, step, name, p, g, slot):
-        return self.opt.apply(step, name, p, g, slot)
+        if self.compression is None:
+            return self.opt.apply(step, name, p, g, slot)
+        # `g` arrives already synced (reduce_gradients wrote the fresh
+        # residual into the slot); the inner update runs on the base half
+        new_p, new_base = self.opt.apply(step, name, p, g, slot["base"])
+        return new_p, {"base": new_base, "ef": slot["ef"]}
 
     def reduce_gradients(self, grads: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
         """Mean-allreduce gradients over the data axis (in-graph).
@@ -588,16 +653,56 @@ class DistOpt(Optimizer):
         Called by the graph executor *inside* shard_map; if no mesh axis is
         bound (single-process eager), this is the identity.
 
+        Under ``compression="int8_ring"`` each gradient rides the
+        error-feedback int8 ring: the slot's f32 residual is added
+        before quantization and refilled with the decode's quantization
+        error (written back into ``self._eager_state`` — inside the
+        compiled step that IS the slots pytree the executor returns, so
+        the residual is donated state like any moment).  With
+        ``error_feedback=False`` the residual stays zero (the parity
+        test documents why that loses).
+
         Telemetry: an ``opt.grad_sync`` span (trace-time when called
-        under the compiled step) plus the communicator's per-op payload
-        counters (obs.events)."""
+        under the compiled step), the communicator's per-op payload
+        counters, and the ``comm.wire_bytes.compressed`` /
+        ``.f32_equiv`` counter pair (obs.events)."""
         from .obs import events as obs_events
         from .parallel import communicator as comm
         with obs_events.span("opt.grad_sync", axis=self.data_axis,
-                             tensors=len(grads)):
+                             tensors=len(grads),
+                             compression=self.compression or "none"):
+            if self.compression == "int8_ring":
+                return self._reduce_int8_ring(grads)
             return comm.allreduce_grads(grads, axis=self.data_axis,
                                         compress_dtype=self.compress_dtype,
                                         topk_ratio=self.topk_ratio)
+
+    def _reduce_int8_ring(self, grads: Dict[str, jnp.ndarray]
+                          ) -> Dict[str, jnp.ndarray]:
+        from .parallel import communicator as comm
+        est = getattr(self, "_eager_state", None)
+        if est is None:
+            est = self._eager_state = {}
+        # under the compiled step's shard_map the ef slot arrives as this
+        # rank's (1, *shape) slice of the (world, *shape) global; [0]
+        # peels the rank axis, [None] restores it on the write-back
+        bound = comm.axis_bound(self.data_axis)
+        out = {}
+        for name, g in grads.items():
+            if g is None:
+                out[name] = None
+                continue
+            slot = est.get(name)
+            has_ef = (isinstance(slot, dict) and "ef" in slot
+                      and self.error_feedback)
+            res = (slot["ef"][0] if has_ef
+                   else jnp.zeros((), jnp.float32))  # scalar 0 broadcasts
+            synced, new_res = comm.ef_quantized_allreduce(
+                g, res, axis=self.data_axis, block=self.compression_block)
+            if has_ef and bound:
+                est[name] = dict(slot, ef=new_res[None])
+            out[name] = synced
+        return out
 
     # -- reference API surface ------------------------------------------------
     def __call__(self, loss: Tensor) -> None:
@@ -607,23 +712,58 @@ class DistOpt(Optimizer):
 
     def backward_and_update(self, loss: Tensor) -> None:
         pg = autograd.backward(loss)
+        if self.compression is not None:
+            # the error-feedback slots live in DistOpt's OWN store (the
+            # executor's slots pytree under the trace): make sure every
+            # param has one BEFORE the sync, so the residual written by
+            # reduce_gradients lands in persistent state
+            if getattr(self, "_eager_state", None) is None:
+                self._eager_state = {}
+            est = self._eager_state
+            for p, _ in pg:
+                n = p.name or str(id(p))
+                if est.get(n) is None:
+                    est[n] = self._init_slot(p.data)
         grads = {(p.name or str(id(p))): g.data for p, g in pg}
         grads = self.reduce_gradients(grads)
         for p, _ in pg:
             g = grads[(p.name or str(id(p)))]
-            self.opt.update(p, Tensor(data=g, device=p.device, requires_grad=False))
+            gt = Tensor(data=g, device=p.device, requires_grad=False)
+            if self.compression is not None:
+                # route through DistOpt's own apply (unwraps {"base","ef"});
+                # the inner optimizer's eager store never sees wrapped slots
+                Optimizer.update(self, p, gt)
+            else:
+                self.opt.update(p, gt)
         self.opt.step()
         self.step_counter = self.opt.step_counter
 
     def backward_and_update_half(self, loss: Tensor) -> None:
+        """One bf16-compressed sync (reference surface).  The previous
+        compress_dtype is RESTORED afterwards — this call must not
+        silently leave every later backward_and_update compressed."""
+        saved = self.compress_dtype
         self.compress_dtype = jnp.bfloat16
-        self.backward_and_update(loss)
+        try:
+            self.backward_and_update(loss)
+        finally:
+            self.compress_dtype = saved
 
     def backward_and_partial_update(self, loss: Tensor, topk_ratio: float = 0.01) -> None:
+        """One top-K sparsified sync (reference surface); the previous
+        topk_ratio is restored afterwards (same contract as
+        :meth:`backward_and_update_half`)."""
+        saved = self.topk_ratio
         self.topk_ratio = topk_ratio
-        self.backward_and_update(loss)
+        try:
+            self.backward_and_update(loss)
+        finally:
+            self.topk_ratio = saved
 
     def update(self, param: Tensor, grad: Tensor) -> None:
+        if self.compression is not None:
+            Optimizer.update(self, param, grad)
+            return
         self.opt.update(param, grad)
 
     def step(self) -> None:
@@ -635,10 +775,20 @@ class DistOpt(Optimizer):
         self.opt.set_states(s)
 
     def state_signature(self) -> str:
-        # DistOpt adds no slot structure of its own
+        if self.compression is not None:
+            # the {"base","ef"} wrapping IS extra slot structure: a
+            # restore across compression on/off must be rejected, not
+            # have a residual reinterpreted as a moment (or vice versa)
+            return f"EF({self.compression})>{self.opt.state_signature()}"
+        # without compression DistOpt adds no slot structure of its own
         return self.opt.state_signature()
 
     def slot_arrays(self) -> Dict[str, List]:
+        if self.compression is not None:
+            # wrapped slots are canonical in DistOpt's own store (the
+            # executor mirrors compiled-step slots there) — leaves land
+            # as [<base leaves...>, ef] (sorted-key flatten order)
+            return Optimizer.slot_arrays(self)
         # eager updates fill the inner opt's store; the graph executor
         # mirrors into both — prefer whichever is populated
         if getattr(self.opt, "_eager_state", None):
@@ -646,5 +796,27 @@ class DistOpt(Optimizer):
         return super().slot_arrays()
 
     def load_slot_arrays(self, slots: Dict[str, List]) -> None:
+        if self.compression is not None:
+            # inverse of the wrapped flatten: the LAST leaf is the f32
+            # error-feedback residual ("base" < "ef" in sorted-key
+            # order); the rest rebuild the inner optimizer's slot
+            # through ITS load_slot_arrays (structured slots — e.g. a
+            # wrapped GradAccum — resume too), exactly like GradAccum
+            efs, rests = {}, {}
+            for name, leaves in slots.items():
+                arrs = [jnp.asarray(l) for l in leaves]
+                if not arrs:
+                    raise ValueError(
+                        f"compressed DistOpt slot for {name!r} is empty "
+                        f"in checkpoint (missing error-feedback residual)")
+                efs[name] = arrs[-1].astype(jnp.float32)
+                rests[name] = arrs[:-1]
+            saved_inner = getattr(self.opt, "_eager_state", None)
+            self.opt.load_slot_arrays(rests)
+            inner = self.opt._eager_state
+            self.opt._eager_state = saved_inner
+            self._eager_state = {n: {"base": inner.get(n), "ef": efs[n]}
+                                 for n in efs}
+            return
         self.opt.load_slot_arrays(slots)
         self._eager_state = self.opt._eager_state

@@ -22,7 +22,9 @@ import jax.numpy as jnp
 
 __all__ = ["axis_bound", "allreduce", "allreduce_grads", "allgather",
            "reduce_scatter", "ppermute", "broadcast", "axis_index",
-           "axis_size", "barrier", "quantized_allreduce"]
+           "axis_size", "barrier", "quantized_allreduce",
+           "ef_quantized_allreduce", "int8_ring_wire_bytes",
+           "f32_ring_wire_bytes"]
 
 
 def axis_bound(axis: str) -> bool:
@@ -61,6 +63,70 @@ def _payload_counter(collective: str, x, axis: str, **attrs) -> None:
     except Exception:  # exotic pytree leaves must never break a trace
         return
     events.counter(f"comm.{collective}.bytes", nbytes, axis=axis, **attrs)
+
+
+# ---------------------------------------------------------------------------
+# wire-byte accounting (the obs ``comm.wire_bytes.*`` counters)
+# ---------------------------------------------------------------------------
+
+def _ring_chunk(n: int, world: int, block: int) -> int:
+    """Per-rank chunk length of the int8 ring over `n` elements: the
+    padded layout both `_ring_int8_allreduce` and the byte model use —
+    ONE definition so the counters can never drift from the kernel."""
+    C = -(-n // world)
+    C += (-C) % block
+    return C
+
+
+def f32_ring_wire_bytes(n: int, world: int) -> int:
+    """Per-participant ring-allreduce wire bytes of an f32 payload of
+    `n` elements: ``2(W-1)/W x 4n`` — the f32-equivalent every
+    compressed variant is compared against (same model as the cost
+    gate's COST005)."""
+    if world <= 1:
+        return 0
+    return int(round(2.0 * (world - 1) / world * n * 4))
+
+
+def int8_ring_wire_bytes(n: int, world: int, block: int = 256) -> int:
+    """Per-participant wire bytes of one int8 ring RS+AG over `n`
+    elements — the deterministic trace-time model behind the
+    ``comm.wire_bytes.compressed`` counter and ``bench.py --quantized``:
+    (W-1) reduce-scatter permute hops of C int8 bytes, a ring
+    all-gather moving another (W-1)·C int8 bytes, plus the per-block
+    absmax consensus (one f32 pmax of W·C/block scales, ring factor
+    2(W-1)/W).  C is the padded per-rank chunk (`_ring_chunk`)."""
+    if world <= 1:
+        return 0
+    C = _ring_chunk(n, world, block)
+    payload = 2 * (world - 1) * C                     # int8: 1 B/elem
+    consensus = int(round(2.0 * (world - 1) / world
+                          * world * (C // block) * 4))
+    return payload + consensus
+
+
+def _emit_wire_counters(n_elems: int, axis: str, mode: str,
+                        block: int = 256) -> None:
+    """Emit the ``comm.wire_bytes.compressed`` / ``.f32_equiv``
+    counter pair for one gradient-sync call (trace time — shapes and
+    the axis size are static, so this is free at execution).  Every
+    sync reports BOTH numbers so a record always shows what the wire
+    actually carried next to what f32 would have cost."""
+    from ..obs import events
+    if not events.enabled():
+        return
+    W = jax.lax.axis_size(axis)
+    f32_eq = f32_ring_wire_bytes(n_elems, W)
+    if mode == "int8_ring":
+        compressed = int8_ring_wire_bytes(n_elems, W, block)
+    elif mode == "bf16":
+        compressed = int(round(2.0 * (W - 1) / W * n_elems * 2))
+    else:
+        compressed = f32_eq
+    events.counter("comm.wire_bytes.compressed", compressed,
+                   axis=axis, mode=mode)
+    events.counter("comm.wire_bytes.f32_equiv", f32_eq,
+                   axis=axis, mode=mode)
 
 
 def axis_index(axis: str):
@@ -161,6 +227,17 @@ def allreduce_grads(grads: Dict[str, jnp.ndarray], axis: str = "data",
             compress=None if compress_dtype is None
             else str(compress_dtype),
             topk_ratio=topk_ratio or 0.0)
+    n_elems = sum(int(g.size) for g in grads.values() if g is not None)
+    mode = "f32"
+    if compress_dtype == "int8_ring":
+        mode = "int8_ring"
+    elif compress_dtype is not None and not _is_int8(compress_dtype):
+        try:
+            if jnp.dtype(compress_dtype).itemsize == 2:
+                mode = "bf16"
+        except TypeError:
+            pass
+    _emit_wire_counters(n_elems, axis, mode)
     out = {}
     for name, g in grads.items():
         if g is None:
@@ -241,7 +318,7 @@ def quantized_allreduce(x, axis: str = "data", block: int = 256,
     return out.reshape(orig_shape).astype(orig_dtype)
 
 
-def _ring_int8_allreduce(x, axis: str, block: int):
+def _ring_int8_allreduce(x, axis: str, block: int, with_error: bool = False):
     """Ring reduce-scatter + all-gather with int8 wire payloads.
 
     Each of the W-1 reduce-scatter hops requantizes the running partial
@@ -249,15 +326,28 @@ def _ring_int8_allreduce(x, axis: str, block: int):
     and ppermutes the int8 codes one rank forward; the final chunk sums
     are requantized onto grid s*W and all-gathered as int8. All scales
     are consensus values (pmax), so no scale traffic accompanies the
-    payload hops."""
+    payload hops.
+
+    Determinism contract: the decode is BITWISE deterministic — the
+    block layout is a fixed reshape (rank-major chunks, `block`-element
+    blocks in array order), every hop's requantize grid is the fixed
+    widening s*(t+1) of the consensus scale (pmax — identical on every
+    rank), and the ring schedule is the static unrolled forward
+    permutation.  Same inputs on the same topology therefore always
+    produce the same synced result, on every rank (the all-gathered
+    codes ARE the result; no rank-local arithmetic follows them).
+
+    ``with_error=True`` additionally returns the caller's LOCAL
+    quantization error on the hop-0 grid — ``x - dequantize(quantize(x,
+    s))`` — the residual error-feedback accumulates (what this rank's
+    contribution lost to the wire this round)."""
     W = jax.lax.axis_size(axis)
     r = jax.lax.axis_index(axis)
     orig_shape, orig_dtype = x.shape, x.dtype
     flat = x.astype(jnp.float32).reshape(-1)
     n = flat.size
     # per-chunk length: multiple of `block`, chunks cover the padded array
-    C = -(-n // W)
-    C += (-C) % block
+    C = _ring_chunk(n, W, block)
     pad = W * C - n
     if pad:
         flat = jnp.pad(flat, (0, pad))
@@ -293,7 +383,45 @@ def _ring_int8_allreduce(x, axis: str, block: int):
     out = vals.reshape(-1)
     if pad:
         out = out[:-pad]
-    return out.reshape(orig_shape).astype(orig_dtype)
+    out = out.reshape(orig_shape).astype(orig_dtype)
+    if not with_error:
+        return out
+    # local quantization error on the hop-0 consensus grid: what THIS
+    # rank's contribution lost when it first hit the wire.  Computed
+    # from the same scales `s` (no extra consensus traffic) in the same
+    # fixed block order, so it is as deterministic as the decode.
+    grid = jnp.repeat(s.reshape(-1), block)                        # (W*C,)
+    q_local = jnp.clip(jnp.round(flat / grid), -127, 127)
+    err = flat - q_local * grid
+    if pad:
+        err = err[:-pad]
+    return out, err.reshape(orig_shape)
+
+
+def ef_quantized_allreduce(x, residual, axis: str = "data",
+                           block: int = 256):
+    """Int8-ring mean-allreduce with error feedback — the production
+    gradient-sync kernel behind ``DistOpt(compression="int8_ring")``.
+
+    Returns ``(mean, new_residual)``: the f32 residual (this rank's
+    accumulated quantization error) is added to ``x`` BEFORE
+    quantization, and refilled after decode with what the compensated
+    payload lost on the hop-0 grid — so error the int8 wire cannot
+    carry this step is re-applied on a later step instead of being
+    dropped (EF-SGD; without it, gradient components persistently
+    smaller than half the quantization grid are truncated to zero on
+    every step and their parameters never move).  Outside a mapped axis
+    this is the identity: ``(x, residual)`` unchanged.
+
+    Deterministic per the `_ring_int8_allreduce` contract; the residual
+    update shares the decode's consensus scales and block order."""
+    if not axis_bound(axis):
+        return x, residual
+    _staged("quantized_allreduce", x, axis, wire="int8", ef=True)
+    _emit_wire_counters(int(x.size), axis, "int8_ring", block)
+    comp = x.astype(jnp.float32) + residual
+    out, err = _ring_int8_allreduce(comp, axis, block, with_error=True)
+    return out.astype(x.dtype), err.astype(jnp.float32)
 
 
 def _topk_allreduce(g, axis: str, ratio: float):

@@ -151,7 +151,14 @@ def plan_train_step(model, optimizer, batch_sds,
     params = {n: t.data for n, t in model.get_params().items()}
     rules = spmd.collect_shard_rules(model)
     shardings = spmd.param_shardings(params, rules, mesh)
-    slots_abs = jax.eval_shape(optimizer.init, params)
+    # init under the TARGET mesh: slot shapes may depend on it (the
+    # int8_ring error-feedback residual carries a (world, ...) rank axis)
+    _saved_mesh = mesh_mod.current_mesh()
+    mesh_mod.set_mesh(mesh)
+    try:
+        slots_abs = jax.eval_shape(optimizer.init, params)
+    finally:
+        mesh_mod.set_mesh(_saved_mesh)
     slot_sh = spmd.tree_shardings(slots_abs, shardings, mesh,
                                   {n: p.shape for n, p in params.items()},
                                   zero1_axis=spmd.zero1_axis_for(optimizer,

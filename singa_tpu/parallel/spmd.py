@@ -41,13 +41,16 @@ def zero1_axis_for(optimizer, mesh: Optional[Mesh]) -> Optional[str]:
     if axis is None or mesh is None or mesh.shape.get(axis, 0) <= 1:
         return None
     if getattr(optimizer, "compress_dtype", None) is not None \
-            or getattr(optimizer, "topk_ratio", 0.0):
+            or getattr(optimizer, "topk_ratio", 0.0) \
+            or getattr(optimizer, "compression", None) is not None:
         import warnings
         warnings.warn(
             "shard_weight_update is ignored when compressed/sparsified "
             "allreduce is configured: those variants run on the "
             "shard_map data-parallel path, which does not shard the "
-            "weight update", stacklevel=3)
+            "weight update (the error-feedback residual slots remain "
+            "ZeRO-shardable state — tree_shardings partitions them "
+            "whenever the GSPMD path is taken)", stacklevel=3)
         return None
     return axis
 

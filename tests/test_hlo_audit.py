@@ -5,7 +5,7 @@ lean.
 The invariants under test are the gates' contract:
   * the committed baselines under tools/lint/data/hlo/ (structure) and
     tools/lint/data/hlo/cost/ (cost) are CLEAN against a fresh lowering
-    of all four flagship programs — so any future change that moves a
+    of all five flagship programs — so any future change that moves a
     fusion, collective, donation, flop count, HBM byte, peak-memory
     byte or wire byte fails CI with a named finding until it is
     reviewed via ``--update-baselines``;
@@ -28,7 +28,7 @@ The invariants under test are the gates' contract:
     wire_bytes) roundtrips through the obs schema, and
     ``cost_features()`` returns the stable documented dict per program.
 
-Budget discipline: ONE module fixture lowers all four programs
+Budget discipline: ONE module fixture lowers all five programs
 (~15 s); every other test summarizes texts or diffs summaries in
 memory.  The defused and many-chunk train-step variants are the only
 extra compiles (tiny 1-block config — the cheap lowering).  Per-metric
@@ -51,7 +51,8 @@ REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 @pytest.fixture(scope="module")
 def texts():
-    """All four flagship programs lowered ONCE — the file's whole
+    """All five flagship programs (incl. train_step_dp2_int8, the
+    error-feedback int8-ring DP step) lowered ONCE — the file's whole
     compile budget (plus the two seeded train-step variants); tests
     share and never mutate it."""
     return hlo.lower_flagship_texts()
@@ -124,6 +125,14 @@ def test_summaries_encode_the_flagship_invariants(summaries):
     assert summaries["train_step_dp2"]["collectives"]["total"] > 0
     assert "all-reduce" in \
         summaries["train_step_dp2"]["collectives"]["by_op"]
+    # the int8-ring DP step's sync IS a ring: collective-permute hops +
+    # the int8 all-gather (plus the absmax-consensus all-reduces), and
+    # the error-feedback residuals ride the donated opt state
+    int8 = summaries["train_step_dp2_int8"]
+    assert "collective-permute" in int8["collectives"]["by_op"]
+    assert "all-gather" in int8["collectives"]["by_op"]
+    assert int8["donated_outputs"] > \
+        summaries["train_step_dp2"]["donated_outputs"]
     assert summaries["prefill_chunk"]["donated_outputs"] > 0
     assert summaries["decode"]["donated_outputs"] > 0
 
@@ -150,6 +159,16 @@ def test_cost_summaries_encode_the_flagship_invariants(costs):
         2 * costs["train_step_dp2"]["flops"]
     assert costs["train_step"]["wire_bytes"] == 0
     assert costs["train_step_dp2"]["wire_bytes"] > 0
+    # ISSUE-10 acceptance, enforced in tier-1: the int8-ring DP step
+    # moves >= 3x fewer collective wire bytes per participant than the
+    # f32 DP step (committed baselines: 72,288 B vs 279,304 B, 3.86x) —
+    # same matmul flops (quantize is elementwise; the flops model
+    # counts dots), the win is pure wire
+    assert costs["train_step_dp2_int8"]["wire_bytes"] * 3 <= \
+        costs["train_step_dp2"]["wire_bytes"]
+    assert costs["train_step_dp2_int8"]["wire_bytes"] > 0
+    assert costs["train_step_dp2_int8"]["flops"] == \
+        costs["train_step_dp2"]["flops"]
     # donation is weighed, not just counted: train step (params/opt
     # state) and both serve programs (KV arena) carry donated bytes
     assert costs["train_step"]["donated_bytes"] > 0
@@ -164,7 +183,8 @@ def test_cost_summaries_encode_the_flagship_invariants(costs):
 def test_hlo_and_cost_gates_share_one_lowering(stub_lowering, capsys):
     """`--hlo` runs the structure gate AND the cost gate from ONE
     lowering pass per program — the compile cost that keeps the
-    combined audit lane within its tier-1 budget.  A second
+    combined audit lane within its tier-1 budget (the fifth program,
+    train_step_dp2_int8, rides the same single pass).  A second
     lower_flagship_texts() call here would double it."""
     assert lint_main(["--hlo"]) == 0
     assert stub_lowering == [None], (
@@ -309,6 +329,35 @@ def test_changed_mesh_size_shifts_wire_bytes(texts, costs, monkeypatch,
     assert [f["code"] for f in doc["findings"]] == ["COST005"]
 
 
+def test_silent_f32_fallback_fails_the_wire_gate(texts, costs,
+                                                 monkeypatch, capsys):
+    """ISSUE-10 acceptance seed: a regression that silently falls back
+    to f32 collectives in the int8-ring mode (modeled by the f32 DP
+    lowering standing in for train_step_dp2_int8) blows the committed
+    wire_bytes baseline ~4x past COST005's 1% tolerance — a NAMED
+    COST005 finding on train_step_dp2_int8 and exit 1 through the
+    front door.  The >=3x win is enforced, not just claimed."""
+    fallen = dict(costs)
+    fallen["train_step_dp2_int8"] = dict(
+        cost.summarize_cost(texts["train_step_dp2"], "train_step_dp2_int8"))
+    assert fallen["train_step_dp2_int8"]["wire_bytes"] >= \
+        3 * costs["train_step_dp2_int8"]["wire_bytes"]
+    findings = cost.cost_gate_findings(fallen)
+    hits = [f for f in findings if f.code == "COST005"
+            and "[train_step_dp2_int8]" in f.message]
+    assert hits, codes_of(findings)
+    assert "wire bytes" in hits[0].message
+    # front door: the f32-fallback TEXT fails the combined gate with
+    # exit 1 (the structural half names the vanished ring ops too)
+    fallen_texts = dict(texts,
+                        train_step_dp2_int8=texts["train_step_dp2"])
+    monkeypatch.setattr(hlo, "lower_flagship_texts",
+                        lambda programs=None: fallen_texts)
+    assert lint_main(["--hlo", "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert "COST005" in {f["code"] for f in doc["findings"]}
+
+
 # ---------------------------------------------------------------------------
 # --update-baselines roundtrip + waiver contract (in-memory, no compiles)
 # ---------------------------------------------------------------------------
@@ -335,9 +384,9 @@ def test_update_baselines_roundtrip(summaries, tmp_path):
     # stale/missing baselines are loud in both directions
     only = {"decode": mutated["decode"]}
     stale = hlo.gate_findings(only, d)
-    assert codes_of(stale) == ["HLO001"] * 3
+    assert codes_of(stale) == ["HLO001"] * (len(hlo.FLAGSHIP_PROGRAMS) - 1)
     missing = hlo.gate_findings(summaries, str(tmp_path / "empty"))
-    assert codes_of(missing) == ["HLO001"] * 4
+    assert codes_of(missing) == ["HLO001"] * len(hlo.FLAGSHIP_PROGRAMS)
     assert all("--update-baselines" in f.message for f in missing)
 
 
@@ -346,7 +395,7 @@ def test_cost_update_prunes_stale_and_reports_missing(costs, tmp_path):
     missing baselines, stale baselines and removals are all loud."""
     d = str(tmp_path / "cost")
     missing = cost.cost_gate_findings(costs, d)
-    assert codes_of(missing) == ["COST001"] * 4
+    assert codes_of(missing) == ["COST001"] * len(hlo.FLAGSHIP_PROGRAMS)
     cost.update_cost_baselines(costs, d)
     assert cost.cost_gate_findings(costs, d) == []
     subset = {p: s for p, s in costs.items() if p != "decode"}

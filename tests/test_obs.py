@@ -158,6 +158,38 @@ class TestSchema:
             schema.validate_bench_doc(dict(base, parsed={
                 "metric": "m", "value": 1.0, "unit": "u"}))
 
+    def test_wire_byte_pair_is_linted_when_present(self):
+        """ISSUE-10 satellite: train_run/bench payloads carrying the
+        quantized-sync wire-byte numerics are linted like the required
+        fields — either key alone (or a non-numeric value) is rejected
+        with the missing/invalid field NAMED; absent pair stays valid."""
+        train = {"steps": 3, "wall_s": 1.0, "ckpt_count": 1,
+                 "resumed_from": -1}
+        schema.validate_train_run_payload(dict(train))      # pair absent: ok
+        ok = dict(train, wire_bytes_compressed=72288,
+                  wire_bytes_f32_equiv=279304)
+        schema.validate_train_run_payload(ok)
+        with pytest.raises(SchemaError, match="wire_bytes_f32_equiv"):
+            schema.validate_train_run_payload(
+                dict(train, wire_bytes_compressed=72288))
+        with pytest.raises(SchemaError, match="wire_bytes_compressed"):
+            schema.validate_train_run_payload(
+                dict(train, wire_bytes_f32_equiv=279304))
+        with pytest.raises(SchemaError, match="must be numeric"):
+            schema.validate_train_run_payload(
+                dict(ok, wire_bytes_compressed=True))
+        # the bench kind goes through the same check via validate_entry
+        import time as _time
+        entry = {"schema_version": schema.SCHEMA_VERSION, "run_id": "b1",
+                 "kind": "bench", "platform": "cpu", "smoke": True,
+                 "device": "cpu", "created_at": _time.time(),
+                 "payload": {"headline": {},
+                             "wire_bytes_compressed": 1}}
+        with pytest.raises(SchemaError, match="wire_bytes_f32_equiv"):
+            schema.validate_entry(entry)
+        entry["payload"]["wire_bytes_f32_equiv"] = 4
+        schema.validate_entry(entry)
+
 
 class TestEvents:
     def test_disabled_is_a_shared_noop(self):

@@ -6,11 +6,15 @@
 # without log spelunking:
 #
 #   stage 1  full audit   `python -m tools.lint`            exit 10
-#            (static SGL rules + HLO structure gate + cost gate,
-#             one shared lowering — tools/lint/{rules,hlo,cost}.py)
+#            (static SGL rules + HLO structure gate + cost gate over
+#             the FIVE flagship programs — train_step, train_step_dp2,
+#             train_step_dp2_int8 (the int8-ring wire-bytes win,
+#             COST005-gated vs the f32 DP baseline), prefill_chunk,
+#             decode — one shared lowering, tools/lint/{rules,hlo,cost}.py)
 #   stage 2  records      `python -m tools.lint --records`  exit 11
 #            (telemetry/record store validation incl. the extended
-#             hlo_audit cost numerics)
+#             hlo_audit cost numerics and the wire-byte pair on
+#             train_run/bench records)
 #   stage 3  tier-1 tests  the ROADMAP.md tier-1 command     exit 20
 #
 # Exit 0 = every stage green.  Intentional compiled-program changes are

@@ -66,9 +66,14 @@ BASELINE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #: body the gate protects); train_step_dp2 is the same step under a
 #: 2-way 'data' mesh with DistOpt, which is what puts real all-reduce
 #: ops into the module so collective count/placement are non-vacuous;
-#: prefill_chunk / decode are the serve engine's exactly-two programs.
-FLAGSHIP_PROGRAMS = ("train_step", "train_step_dp2", "prefill_chunk",
-                     "decode")
+#: train_step_dp2_int8 is that DP step with
+#: ``DistOpt(compression="int8_ring")`` — error-feedback int8 ring
+#: gradient sync, whose committed COST005 wire_bytes baseline proves
+#: (and permanently gates) the >=3x wire reduction vs train_step_dp2's
+#: f32 collectives; prefill_chunk / decode are the serve engine's
+#: exactly-two programs.
+FLAGSHIP_PROGRAMS = ("train_step", "train_step_dp2",
+                     "train_step_dp2_int8", "prefill_chunk", "decode")
 
 #: summary format version — bump on incompatible metric changes; a
 #: baseline with another version fails the gate (HLO001) instead of
@@ -560,15 +565,20 @@ def _ensure_cpu_backend() -> None:
 
 
 def lower_train_step(dp: bool = False, fused_loss: bool = True,
-                     ce_chunk: Optional[int] = None) -> str:
+                     ce_chunk: Optional[int] = None,
+                     compression: Optional[str] = None) -> str:
     """Optimized-HLO text of the flagship (tiny-config) compiled train
     step: Llama + fused CE-chunk loss + SGD, through the real graph
     executor — so the audited module IS the module training runs.  With
     ``dp``, the same step under a 2-way 'data' mesh with DistOpt (the
-    in-graph gradient all-reduce).  ``fused_loss=False`` builds the
-    deliberately-defused variant the regression tests feed the gate;
-    ``ce_chunk`` overrides ``fused_loss_chunk`` (the cost-gate tests
-    lower a many-chunk variant to prove flops/HBM drift is caught)."""
+    in-graph gradient all-reduce); ``compression="int8_ring"`` (implies
+    the DP variant's mesh) swaps the f32 all-reduces for the
+    error-feedback int8 ring — the train_step_dp2_int8 program whose
+    committed wire_bytes baseline enforces the byte win.
+    ``fused_loss=False`` builds the deliberately-defused variant the
+    regression tests feed the gate; ``ce_chunk`` overrides
+    ``fused_loss_chunk`` (the cost-gate tests lower a many-chunk
+    variant to prove flops/HBM drift is caught)."""
     _ensure_cpu_backend()
     import numpy as np
     from singa_tpu import models, opt, parallel, tensor
@@ -588,13 +598,15 @@ def lower_train_step(dp: bool = False, fused_loss: bool = True,
     if ce_chunk is not None:
         cfg.fused_loss_chunk = ce_chunk
     saved_mesh = parallel.current_mesh()
+    dp = dp or compression is not None
     try:
         if dp:
             parallel.set_mesh(parallel.make_mesh({"data": 2}))
         else:
             parallel.set_mesh(None)
         m = models.Llama(cfg)
-        m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.01, momentum=0.9))
+        m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.01, momentum=0.9),
+                                    compression=compression)
                         if dp else opt.SGD(lr=0.01, momentum=0.9))
         ids = tensor.from_numpy(np.zeros((2, 16), np.int32))
         m.compile([ids], is_train=True, use_graph=True)
@@ -641,6 +653,9 @@ def lower_flagship_texts(programs: Optional[Iterable[str]] = None
         texts["train_step"] = lower_train_step()
     if "train_step_dp2" in wanted:
         texts["train_step_dp2"] = lower_train_step(dp=True)
+    if "train_step_dp2_int8" in wanted:
+        texts["train_step_dp2_int8"] = lower_train_step(
+            compression="int8_ring")
     if "prefill_chunk" in wanted or "decode" in wanted:
         serve = _lower_serve_programs()
         for name in ("prefill_chunk", "decode"):
