@@ -83,11 +83,17 @@ def active(plan: FaultPlan):
 
 
 def _emit(site: str, kind: str, call: int) -> None:
-    from ..obs import events
+    from ..obs import events, flight
     # attr is fault_kind, not kind: event attrs merge into the sink
     # line, and a bare "kind" would clobber the event's own kind field
     events.counter("fault.injected", 1, site=site, fault_kind=kind,
                    call=call)
+    # every FIRED fault also lands in the live flight-recorder rings
+    # (ServeEngine / TrainRunner), so an incident dump's timeline shows
+    # the injected fault next to the retries/quarantine it caused; the
+    # no-fault path never reaches here (zero-overhead contract)
+    flight.broadcast("counter", "fault.injected", site=site,
+                     fault_kind=kind, call=call)
 
 
 def fire(site: str, **ctx: Any) -> None:

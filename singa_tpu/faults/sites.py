@@ -21,7 +21,8 @@ from __future__ import annotations
 
 from typing import Dict, Tuple
 
-__all__ = ["SITES", "supported_kinds", "is_known"]
+__all__ = ["SITES", "INCIDENT_SITES", "supported_kinds", "is_known",
+           "is_incident_site"]
 
 #: site name -> (description, kinds the site supports).
 #: ``error``/``hang`` are raised/slept by :func:`faults.fire` before the
@@ -78,8 +79,26 @@ SITES: Dict[str, Tuple[str, Tuple[str, ...]]] = {
 }
 
 
+#: subsystem seams that appear in incident records / flight-recorder
+#: dumps but are NOT injection sites (nothing fires there — they name
+#: where the SYSTEM acted, not where a fault was injected):
+#: ``serve.arena`` (arena rebuild/recovery), ``train.fatal`` (retry
+#: exhaustion / checkpoint-write failure), ``train.hung`` (heartbeat
+#: hang abort).  ``FlightRecorder.dump`` accepts SITES plus these;
+#: singalint SGL009 enforces the same union statically so a typo'd dump
+#: site cannot silently never dump.
+INCIDENT_SITES: Tuple[str, ...] = ("serve.arena", "train.fatal",
+                                   "train.hung")
+
+
 def is_known(site: str) -> bool:
     return site in SITES
+
+
+def is_incident_site(site: str) -> bool:
+    """Valid name for an incident record / flight dump: any injection
+    site, or one of the recovery/fatal seams in INCIDENT_SITES."""
+    return site in SITES or site in INCIDENT_SITES
 
 
 def supported_kinds(site: str) -> Tuple[str, ...]:

@@ -13,18 +13,30 @@ The observability subsystem (ISSUE 1):
   ``gauge`` with a JSONL sink and optional ``jax.profiler``
   annotation passthrough, wired into the compiled-step, collective,
   and grad-sync hot paths.
+* :mod:`~singa_tpu.obs.trace` — contextvar-carried request/step trace
+  contexts (ISSUE 11): every event emitted inside an active trace is
+  stamped with its id, spans nest, and worker threads inherit (or
+  explicitly drop) the spawner's context.
+* :mod:`~singa_tpu.obs.flight` — :class:`FlightRecorder`, the bounded
+  in-memory incident ring dumped to ``runs/incidents/`` (and referenced
+  from ``incident``/``train_run`` records via ``flight_ref``) when a
+  fault fires through to quarantine/recovery/fatal.
 
-See docs/observability.md for the schema and the smoke-vs-chip
-protection rule.
+``tools/obsq.py`` is the query layer over all three (timeline
+rendering, trace-derived SLO recomputation, record trajectories).  See
+docs/observability.md for the schema and the smoke-vs-chip protection
+rule.
 """
 
-from . import events, record, schema
+from . import events, flight, record, schema, trace
 from .events import (configure, counter, gauge, histogram,
                      histogram_summary, reset_histograms, span, trace_span)
+from .flight import FlightRecorder
 from .record import RunRecord, is_onchip_session_doc, new_entry, new_run_id
 from .schema import SCHEMA_VERSION, SchemaError, require
 
-__all__ = ["schema", "record", "events", "RunRecord", "SchemaError",
+__all__ = ["schema", "record", "events", "trace", "flight",
+           "FlightRecorder", "RunRecord", "SchemaError",
            "SCHEMA_VERSION", "require", "new_entry", "new_run_id",
            "is_onchip_session_doc", "configure", "counter", "gauge",
            "span", "trace_span", "histogram", "histogram_summary",

@@ -38,7 +38,10 @@ import json
 import os
 import threading
 import time
+import warnings
 from typing import Any, Dict, Optional
+
+from . import trace
 
 __all__ = ["JsonlSink", "configure", "enabled", "get_sink", "span",
            "trace_span", "counter", "gauge", "histogram",
@@ -46,13 +49,26 @@ __all__ = ["JsonlSink", "configure", "enabled", "get_sink", "span",
 
 
 class JsonlSink:
-    """Append events to a JSONL file (thread-safe, line-buffered)."""
+    """Append events to a JSONL file (thread-safe, line-buffered).
 
-    def __init__(self, path: str):
+    ``max_bytes`` (or ``SINGA_OBS_MAX_BYTES``; default off) bounds the
+    file: when the next line would cross the limit the current file is
+    atomically renamed to ``<path>.1`` (replacing the previous rollover)
+    and a fresh file is opened — a loadgen/chaos soak holds at most
+    ``2 * max_bytes`` of event data on disk instead of growing without
+    bound."""
+
+    def __init__(self, path: str, max_bytes: Optional[int] = None):
         self.path = path
+        if max_bytes is not None and int(max_bytes) < 0:
+            raise ValueError(
+                f"max_bytes must be >= 0 (0/None disables rotation), "
+                f"got {max_bytes}")
+        self.max_bytes = int(max_bytes) if max_bytes else None
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         self._f = open(path, "a")
+        self._size = self._f.tell()
         self._lock = threading.Lock()
 
     def emit(self, event: Dict[str, Any]) -> None:
@@ -61,8 +77,12 @@ class JsonlSink:
             if self._f.closed:
                 return
             try:
+                if (self.max_bytes is not None and self._size
+                        and self._size + len(line) + 1 > self.max_bytes):
+                    self._rotate()
                 self._f.write(line + "\n")
                 self._f.flush()
+                self._size += len(line) + 1
             except (OSError, ValueError):
                 # disk full / fd gone mid-run: telemetry degrades, the
                 # training loop it instruments must never die for it
@@ -70,6 +90,15 @@ class JsonlSink:
                     self._f.close()
                 except OSError:
                     pass
+
+    def _rotate(self) -> None:
+        """Size-based rollover (caller holds the lock): close, atomic
+        ``os.replace`` to ``<path>.1`` (clobbering the previous roll),
+        reopen fresh — every retained line lives in a complete file."""
+        self._f.close()
+        os.replace(self.path, self.path + ".1")
+        self._f = open(self.path, "a")
+        self._size = 0
 
     def close(self) -> None:
         with self._lock:
@@ -89,15 +118,18 @@ _annotate = False
 
 
 def configure(sink: Optional[JsonlSink] = None, path: Optional[str] = None,
-              annotate: Optional[bool] = None) -> None:
+              annotate: Optional[bool] = None,
+              max_bytes: Optional[int] = None) -> None:
     """Install/replace the event sink and/or the XProf annotation flag.
 
     ``configure()`` with no arguments disables the JSONL sink (closing
-    the old one) and leaves annotation untouched."""
+    the old one) and leaves annotation untouched.  ``max_bytes``
+    applies to a sink built from ``path`` (size-based rollover to
+    ``<path>.1``; ``SINGA_OBS_MAX_BYTES`` in the environment)."""
     global _sink, _annotate
     old = _sink
     if path is not None:
-        sink = JsonlSink(path)
+        sink = JsonlSink(path, max_bytes=max_bytes)
     _sink = sink
     if annotate is not None:
         _annotate = bool(annotate)
@@ -108,9 +140,25 @@ def configure(sink: Optional[JsonlSink] = None, path: Optional[str] = None,
 def _init_from_env() -> None:
     path = os.environ.get("SINGA_OBS")
     if path:
+        max_bytes: Optional[int] = None
+        raw = os.environ.get("SINGA_OBS_MAX_BYTES")
+        if raw:
+            try:
+                max_bytes = int(raw)
+            except ValueError:
+                warnings.warn(f"SINGA_OBS_MAX_BYTES={raw!r} is not an "
+                              f"integer; sink rotation disabled",
+                              stacklevel=2)
+            if max_bytes is not None and max_bytes < 0:
+                # a bad limit must degrade to "no rotation", never kill
+                # the sink itself (JsonlSink would raise ValueError)
+                warnings.warn(f"SINGA_OBS_MAX_BYTES={raw!r} is negative; "
+                              f"sink rotation disabled", stacklevel=2)
+                max_bytes = None
         try:
-            configure(path=path)
-        except OSError:  # unwritable path must never break training
+            configure(path=path, max_bytes=max_bytes)
+        except (OSError, ValueError):
+            # unwritable path / bad limit must never break training
             pass
     if os.environ.get("SINGA_OBS_XPROF") == "1":
         configure(sink=_sink, annotate=True)
@@ -129,6 +177,12 @@ def _emit(kind: str, name: str, attrs: Dict[str, Any]) -> None:
     if _sink is None:
         return
     ev = {"t": time.time(), "kind": kind, "name": name}  # singalint: disable=SGL005 event timestamps must correlate across hosts/files; durations use the monotonic clocks in span()
+    # request/step attribution (ISSUE 11): every event emitted inside
+    # an active obs.trace context carries its trace id — how obsq
+    # reconstructs one request's timeline out of an interleaved stream
+    tid = trace.current_trace_id()
+    if tid is not None and "trace" not in attrs:
+        ev["trace"] = tid
     ev.update(attrs)
     _sink.emit(ev)
 
@@ -175,7 +229,21 @@ class _Hist:
 
     def summary(self) -> Optional[Dict[str, Any]]:
         """{count, sum, mean, min, max, p50, p90, p99}, or None when
-        nothing was observed yet."""
+        nothing was observed yet.
+
+        Determinism/approximation contract (regression-tested in
+        tests/test_obs.py): count/sum/mean/min/max are exact over every
+        observation.  Percentiles are nearest-rank over the retained
+        ring — observation ``i`` (0-based) lives in slot
+        ``i % _HIST_CAP``, so once the ring has wrapped it holds
+        exactly the most recent ``_HIST_CAP`` observations and the same
+        insertion order always reproduces the same summary (no RNG, no
+        reservoir).  While ``count <= _HIST_CAP`` the percentiles are
+        exact; beyond that they are the exact nearest-rank quantiles of
+        the most recent window (rank resolution ``1/_HIST_CAP``), which
+        can differ from the all-time quantile only by however much the
+        stream drifted outside that window — for latency SLOs the
+        recent window is the quantity of interest anyway."""
         if not self.count:
             return None
         vals = sorted(self.samples)
@@ -254,13 +322,17 @@ _NULL = _NullCtx()
 
 
 class _Span:
-    __slots__ = ("name", "attrs", "_t0", "_ann")
+    __slots__ = ("name", "attrs", "_t0", "_ann", "_sid", "_parent",
+                 "_tok")
 
     def __init__(self, name: str, attrs: Dict[str, Any]):
         self.name = name
         self.attrs = attrs
         self._t0 = 0.0
         self._ann = None
+        self._sid = None
+        self._parent = None
+        self._tok = None
 
     def __enter__(self):
         if _annotate:
@@ -270,16 +342,30 @@ class _Span:
                 self._ann.__enter__()
             except Exception:  # profiler optional; never break the step
                 self._ann = None
+        # inside an active trace, spans nest: this span takes a span id,
+        # records the current parent, and becomes the parent for any
+        # span opened within its extent (contextvar push, popped on
+        # exit) — no id threading through call signatures
+        ctx = trace.current()
+        if ctx is not None:
+            self._parent = ctx[1]
+            self._sid = trace.new_span_id()
+            self._tok = trace._push_span(self._sid)
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb):
         dur = time.perf_counter() - self._t0
+        trace._pop_span(self._tok)
         if self._ann is not None:
             with contextlib.suppress(Exception):
                 self._ann.__exit__(exc_type, exc, tb)
         attrs = self.attrs
         attrs["dur_ms"] = round(dur * 1e3, 3)
+        if self._sid is not None:
+            attrs["span"] = self._sid
+            if self._parent is not None:
+                attrs["parent"] = self._parent
         if exc_type is not None:
             attrs["error"] = exc_type.__name__
         _emit("span", self.name, attrs)

@@ -39,7 +39,8 @@ __all__ = ["SCHEMA_VERSION", "SchemaError", "require", "validate_entry",
            "validate_multichip_doc", "validate_serve_payload",
            "validate_serve_load_payload", "validate_train_run_payload",
            "validate_incident_payload", "validate_hlo_audit_payload",
-           "validate_wire_byte_fields", "entry_key"]
+           "validate_wire_byte_fields", "validate_flight_ref",
+           "entry_key"]
 
 #: bump when entry fields change incompatibly; validators dispatch on it
 SCHEMA_VERSION = 1
@@ -252,15 +253,33 @@ def validate_wire_byte_fields(payload: Any, ctx: str = "payload") -> None:
         _require_numeric_fields(payload, _WIRE_BYTE_FIELDS, ctx)
 
 
+def validate_flight_ref(payload: Any, ctx: str = "payload") -> None:
+    """The optional flight-recorder dump reference (ISSUE 11): when an
+    incident/train_run payload carries ``flight_ref`` it must be a
+    non-empty string — the dump path relative to the record store's
+    directory.  A ref that exists but is empty/mistyped would point the
+    postmortem at nothing; ``python -m tools.lint --records``
+    additionally checks the referenced file exists and parses."""
+    if not isinstance(payload, dict) or "flight_ref" not in payload:
+        return
+    v = payload["flight_ref"]
+    _expect(isinstance(v, str) and bool(v),
+            f"{ctx}: 'flight_ref' must be a non-empty string (dump path "
+            f"relative to the record store), got {v!r}",
+            field="flight_ref")
+
+
 def validate_train_run_payload(payload: Any,
                                ctx: str = "train_run payload") -> None:
     """The orchestrator's run outcome: every field in
     ``_TRAIN_RUN_FIELDS`` present and numeric, so a run that aborted
     mid-write can never masquerade as a complete record; the optional
     wire-byte pair (``wire_bytes_compressed`` / ``wire_bytes_f32_equiv``,
-    quantized-sync runs) is linted whenever either appears."""
+    quantized-sync runs) and the optional ``flight_ref`` (fatal/hung
+    runs dump their flight ring) are linted whenever they appear."""
     _require_numeric_fields(payload, _TRAIN_RUN_FIELDS, ctx)
     validate_wire_byte_fields(payload, ctx)
+    validate_flight_ref(payload, ctx)
 
 
 def validate_hlo_audit_payload(payload: Any,
@@ -291,6 +310,7 @@ def validate_incident_payload(payload: Any,
             f"{ctx}: 'ref' must be a step/request id (string or number), "
             f"got {ref!r}", field="ref")
     _require_numeric_fields(payload, ("retries",), ctx)
+    validate_flight_ref(payload, ctx)
 
 
 def validate_session_doc(doc: Any, ctx: str = "session record") -> None:

@@ -75,6 +75,18 @@ With ``heartbeat_timeout_s`` set and ``recover_on_hang`` unset, a hung
 dispatch still surfaces as a clean abort instead of wedging the server.
 Quarantines and recoveries land as durable ``incident`` records
 (``record_store``), linted by ``tools/record_check.py``.
+
+Observability (ISSUE 11): every request gets a trace id
+(``handle.trace_id``) activated around its admission, prefill chunks,
+token deliveries and eviction, so the whole request reconstructs as one
+trace in the obs event stream (``tools/obsq.py trace``) — TTFT and
+tokens/s are derivable from it and asserted equal to the histogram
+metrics.  The engine also keeps a :class:`~singa_tpu.obs.flight.
+FlightRecorder` ring of its recent events (in-memory, sink or no sink);
+each quarantine/recovery dumps the ring to
+``<record dir>/incidents/<ts>-<site>.jsonl`` and the incident record's
+``flight_ref`` points at it.  With no ``record_store`` and no sink the
+engine performs zero file writes.
 """
 
 from __future__ import annotations
@@ -93,7 +105,9 @@ import numpy as np
 from .. import faults
 from ..models._generate import _bound, decode_step, resume_step
 from ..obs import events
+from ..obs import flight as obs_flight
 from ..obs import record as obs_record
+from ..obs import trace as obs_trace
 from ..ops import kv_cache as kv_ops
 from ..utils import failure
 from ..utils.failure import Heartbeat
@@ -159,7 +173,12 @@ class ServeEngine:
         self.share_prefix = bool(share_prefix)
         self.sched = Scheduler(
             max_queue=2 * num_slots if max_queue is None else max_queue)
-        self.metrics = ServeMetrics()
+        # the incident flight ring (ISSUE 11): always recording (bounded
+        # in-memory, zero file I/O), registered for fault-fire
+        # broadcasts; dumps happen only when record_store names a place
+        # for the incident evidence to live
+        self.flight = obs_flight.register(obs_flight.FlightRecorder())
+        self.metrics = ServeMetrics(flight=self.flight)
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self._on_failure = on_failure
         self.max_dispatch_retries = int(max_dispatch_retries)
@@ -350,18 +369,25 @@ class ServeEngine:
                 "in-flight requests complete")
         req = Request(prompt_ids, max_new_tokens, deadline_s, eos_id,
                       on_token)
+        # one trace per request (ISSUE 11): every event the engine emits
+        # about this request — admission, prefix hit, prefill chunks,
+        # first token, decode deliveries, preemption, quarantine,
+        # finish/shed/evict — carries this id, so the whole request is
+        # reconstructable as a single trace (handle.trace_id)
+        req.trace_id = f"{self.run_id}/r{req.rid}"
         p = req.prompt.size
         if p + req.max_new_tokens > self.pool.max_len:
             raise ValueError(
                 f"prompt ({p}) + max_new_tokens ({req.max_new_tokens}) "
                 f"= {p + req.max_new_tokens} exceeds max_len "
                 f"({self.pool.max_len})")
-        try:
-            self.sched.offer(req)
-        except QueueFull:
-            self.metrics.on_reject()
-            raise
-        self.metrics.on_submit()
+        with obs_trace.activate(req.trace_id):
+            try:
+                self.sched.offer(req)
+            except QueueFull:
+                self.metrics.on_reject()
+                raise
+            self.metrics.on_submit()
         return req.handle
 
     # -- the engine loop ---------------------------------------------------
@@ -388,7 +414,8 @@ class ServeEngine:
             #    and running requests past their deadline vacate first,
             #    so their slots/blocks are admittable this same tick
             for req in self.sched.expire_queued(now):
-                self.metrics.on_evict("deadline")
+                with obs_trace.activate(req.trace_id):
+                    self.metrics.on_evict("deadline")
             for slot in [s for s, r in self._running.items()
                          if r.expired(now)]:
                 req = self._running[slot]
@@ -399,7 +426,8 @@ class ServeEngine:
             #     that cannot plausibly deliver a first token before
             #     their deadline are shed before burning a prefill
             for req in self.sched.shed_overload(now, self._eta_first_token):
-                self.metrics.on_evict("shed")
+                with obs_trace.activate(req.trace_id):
+                    self.metrics.on_evict("shed")
 
             # 2. admission — prefill into free slots between decode
             #    steps.  A slot row is not enough: the head-of-queue
@@ -595,6 +623,13 @@ class ServeEngine:
         return self.pool.alloc_blocks(n)
 
     def _admit(self, req: Request) -> int:
+        # the whole admission — block claim, prefix hit, prefill chunks,
+        # first-token delivery, quarantine on failure — runs under the
+        # request's trace, so each of those events carries its id
+        with obs_trace.activate(req.trace_id):
+            return self._admit_traced(req)
+
+    def _admit_traced(self, req: Request) -> int:
         slot = self.pool.alloc_slot()
         assert slot is not None, "admission with no free slot"
         # replay_ids == prompt for a fresh request; for a request
@@ -674,6 +709,7 @@ class ServeEngine:
             # ``submitted``
             self.metrics.on_admit()
         done = req.deliver(tok)       # prefill yields the (next) token
+        self.metrics.on_deliver(req.rid, len(req.tokens))
         if first:
             self.metrics.on_first_token(req.ttft_s)
         if req.on_token is not None:
@@ -697,8 +733,10 @@ class ServeEngine:
         req.error = (f"{site} failed after {attempts} attempt(s): "
                      f"{type(err).__name__}: {err}")
         self.metrics.on_quarantine()
+        ref = self._flight_dump(site, f"quarantine req:{req.rid}")
         self._incident(site, type(err).__name__,
-                       f"req:{req.rid}", "quarantined", attempts)
+                       f"req:{req.rid}", "quarantined", attempts,
+                       flight_ref=ref)
         warnings.warn(f"serve: request {req.rid} quarantined: "
                       f"{req.error}", stacklevel=2)
 
@@ -731,7 +769,8 @@ class ServeEngine:
         req.state = QUEUED
         req.slot = None
         self.sched.requeue_front([req])
-        self.metrics.on_preempt()
+        with obs_trace.activate(req.trace_id):
+            self.metrics.on_preempt()
 
     def _decode_tick(self) -> int:
         t0 = time.perf_counter()
@@ -749,8 +788,13 @@ class ServeEngine:
         for slot in list(self._running):
             req = self._running[slot]
             tok = int(toks[slot])
-            done = req.deliver(tok)
-            self.metrics.on_token(dt)
+            # one batched decode dispatch delivers to many requests;
+            # the per-request section runs under each request's trace
+            # so its token events attribute correctly
+            with obs_trace.activate(req.trace_id):
+                done = req.deliver(tok)
+                self.metrics.on_token(dt)
+                self.metrics.on_deliver(req.rid, len(req.tokens))
             if req.on_token is not None:
                 req.on_token(tok, req.handle)
             delivered += 1
@@ -762,7 +806,8 @@ class ServeEngine:
         req = self._running.pop(slot)
         self.pool.release(slot)
         req.state = EVICTED if evicted else FINISHED
-        self.metrics.on_evict(req.finish_reason or "unknown")
+        with obs_trace.activate(req.trace_id):
+            self.metrics.on_evict(req.finish_reason or "unknown")
 
     # -- recovery ----------------------------------------------------------
     def recover(self, reason: str = "requested") -> None:
@@ -815,16 +860,26 @@ class ServeEngine:
                         f"+ generated = {req.replay_ids().size} tokens "
                         f"leaves no room to decode under max_len "
                         f"({self.pool.max_len})")
-                    self.metrics.on_evict("unrecoverable")
-                    self._incident("serve.arena", reason,
-                                   f"req:{req.rid}", "unrecoverable", 0)
+                    # the request's terminal event must carry its trace
+                    # like every other evict site — THIS request is the
+                    # one the incident postmortem is about
+                    with obs_trace.activate(req.trace_id):
+                        self.metrics.on_evict("unrecoverable")
+                        self._incident(
+                            "serve.arena", reason, f"req:{req.rid}",
+                            "unrecoverable", 0,
+                            flight_ref=self._flight_dump(
+                                "serve.arena",
+                                f"unrecoverable req:{req.rid}"))
                 else:
                     requeue.append(req)
             self.sched.requeue_front(requeue)
             self.metrics.on_recover(len(requeue))
             self._incident("serve.arena", reason,
                            f"inflight:{len(requeue)}", "recovered",
-                           self._recoveries)
+                           self._recoveries,
+                           flight_ref=self._flight_dump(
+                               "serve.arena", f"recovery: {reason}"))
 
     def _hb_failure(self, age: float, last_beat: int) -> None:
         """Heartbeat monitor-thread path (``recover_on_hang``): only
@@ -833,18 +888,31 @@ class ServeEngine:
         preempted from here anyway; an injected hang simply returns
         late).  A user ``on_failure`` still gets the observation."""
         events.counter("serve.hangs", 1, age_s=round(age, 3))
+        # monitor thread: deliberately trace-less (the hang is an
+        # engine-level observation, not any one request's)
+        self.flight.note("counter", "serve.hangs", age_s=round(age, 3))
         self._recover_flag.set()
         if self._on_failure is not None:
             self._on_failure(age, last_beat)
 
-    # -- durable incident records -----------------------------------------
+    # -- durable incident records + flight dumps --------------------------
+    def _flight_dump(self, site: str, reason: str) -> Optional[str]:
+        """Dump the flight ring next to the record store and return the
+        ``flight_ref`` (or None without a store) — the shared
+        :func:`obs.flight.dump_for_store` contract; this thin wrapper
+        exists so literal sites at call sites stay SGL009-checkable."""
+        return obs_flight.dump_for_store(self.flight, site,
+                                         self.record_store, reason)
+
     def _incident(self, site: str, fault: str, ref, outcome: str,
-                  retries: int) -> None:
+                  retries: int, flight_ref: Optional[str] = None) -> None:
         """Append one ``incident`` entry to the run-record store (when
         ``record_store`` is set).  Best-effort: the record is evidence,
         not a dependency — a full disk must not turn a survived fault
         into a crash."""
         events.counter("serve.incident", 1, site=site, outcome=outcome)
+        self.flight.note("counter", "serve.incident", site=site,
+                         outcome=outcome)
         if not self.record_store:
             return
         try:
@@ -853,6 +921,8 @@ class ServeEngine:
             payload = {"site": site, "fault": fault, "ref": ref,
                        "outcome": outcome, "retries": int(retries),
                        "engine_run": self.run_id}
+            if flight_ref:
+                payload["flight_ref"] = flight_ref
             entry = obs_record.new_entry(
                 "incident", platform, platform != "tpu",
                 getattr(dev, "device_kind", "") or platform,

@@ -39,6 +39,11 @@ name                        kind       meaning
 ``serve.step``              span       one engine step (host wall clock)
 ``serve.prefill``           span       one prefill dispatch (+ fetch)
 ``serve.decode``            span       one decode dispatch (+ fetch)
+``serve.token``             counter    one token delivered to a request
+                                       (prefill first token, decode
+                                       tick, recovery/preemption replay
+                                       — tokens/s is derivable from the
+                                       trace by counting these)
 ``serve.ttft_ms``           histogram  submit → first token
 ``serve.token_ms``          histogram  per generated token, decode path
 ==========================  =========  ==================================
@@ -50,13 +55,21 @@ process never reset or pollute each other's percentiles; the emitted
 ``serve.ttft_ms``/``serve.token_ms`` sink lines keep the documented
 names (the global ``events.histogram_summary`` view then spans every
 engine — by design for a whole-process dashboard).
+
+Trace attribution (ISSUE 11): the engine activates the request's
+``obs.trace`` context around each per-request section, so every line
+above that is about ONE request carries its trace id — and the same
+events are noted into the engine's :class:`~singa_tpu.obs.flight.
+FlightRecorder` ring (pass ``flight=``), which is what an incident
+dump's timeline is made of.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 from ..obs import events
+from ..obs import flight as obs_flight
 # per-engine aggregation state reuses the events-layer histogram
 # implementation (exact totals + bounded deterministic sample ring)
 from ..obs.events import _Hist
@@ -66,9 +79,11 @@ __all__ = ["ServeMetrics"]
 
 class ServeMetrics:
     """Thin per-engine facade: exact local totals (for snapshots/tests)
-    plus pass-through emission to the shared obs sink."""
+    plus pass-through emission to the shared obs sink and (when given)
+    the engine's flight-recorder ring."""
 
-    def __init__(self):
+    def __init__(self, flight: Optional[obs_flight.FlightRecorder] = None):
+        self.flight = flight
         self.submitted = 0
         self.admitted = 0
         self.rejected = 0
@@ -83,39 +98,53 @@ class ServeMetrics:
         self._ttft = _Hist()
         self._token = _Hist()
 
+    def _note(self, kind: str, name: str, **attrs) -> None:
+        """Mirror one emission into the engine's flight ring (in-memory
+        only; the active trace id is stamped by the recorder)."""
+        if self.flight is not None:
+            self.flight.note(kind, name, **attrs)
+
     # -- request lifecycle ------------------------------------------------
     def on_submit(self) -> None:
         self.submitted += 1
         events.counter("serve.submitted", 1)
+        self._note("counter", "serve.submitted")
 
     def on_reject(self) -> None:
         self.rejected += 1
         events.counter("serve.rejected", 1)
+        self._note("counter", "serve.rejected")
 
     def on_admit(self) -> None:
         self.admitted += 1
         events.counter("serve.admitted", 1)
+        self._note("counter", "serve.admitted")
 
     def on_evict(self, reason: str) -> None:
         self.evicted[reason] = self.evicted.get(reason, 0) + 1
         events.counter("serve.evicted", 1, reason=reason)
+        self._note("counter", "serve.evicted", reason=reason)
 
     # -- resilience (ISSUE 4) ---------------------------------------------
     def on_retry(self, site: str) -> None:
         self.retries[site] = self.retries.get(site, 0) + 1
         events.counter("serve.retries", 1, site=site)
+        self._note("counter", "serve.retries", site=site)
 
     def on_quarantine(self) -> None:
         self.quarantined += 1
         events.counter("serve.quarantined", 1)
+        self._note("counter", "serve.quarantined")
 
     def on_recover(self, inflight: int) -> None:
         self.recoveries += 1
         events.counter("serve.recoveries", 1, inflight=inflight)
+        self._note("counter", "serve.recoveries", inflight=inflight)
 
     def on_preempt(self) -> None:
         self.preempted += 1
         events.counter("serve.preempted", 1)
+        self._note("counter", "serve.preempted")
 
     # -- paged arena / prefix cache (ISSUE 6) ------------------------------
     def on_prefix_hit(self, tokens: int) -> None:
@@ -123,15 +152,24 @@ class ServeMetrics:
         self.prefix_hit_tokens += tokens
         events.counter("serve.prefix_hits", 1)
         events.counter("serve.prefix_hit_tokens", tokens)
+        self._note("counter", "serve.prefix_hits", tokens=tokens)
 
-    # -- latency ----------------------------------------------------------
+    # -- latency / delivery ------------------------------------------------
     def on_first_token(self, ttft_s: float) -> None:
         self._ttft.observe(ttft_s * 1e3)
         events.histogram("serve.ttft_ms", ttft_s * 1e3)
+        self._note("hist", "serve.ttft_ms", value=ttft_s * 1e3)
 
     def on_token(self, latency_s: float) -> None:
         self._token.observe(latency_s * 1e3)
         events.histogram("serve.token_ms", latency_s * 1e3)
+
+    def on_deliver(self, rid: int, n: int) -> None:
+        """One token handed to a request (any path: prefill first
+        token, decode tick, recovery/preemption replay) — the
+        trace-countable delivery event tokens/s derives from."""
+        events.counter("serve.token", 1, rid=rid, n=n)
+        self._note("counter", "serve.token", rid=rid, n=n)
 
     # -- per-step levels ---------------------------------------------------
     def on_step(self, queue_depth: int, active_slots: int,
@@ -140,6 +178,9 @@ class ServeMetrics:
         events.gauge("serve.queue_depth", queue_depth)
         events.gauge("serve.active_slots", active_slots)
         events.gauge("serve.blocks_in_use", blocks_in_use)
+        self._note("gauge", "serve.step", queue_depth=queue_depth,
+                   active_slots=active_slots,
+                   blocks_in_use=blocks_in_use)
 
     def snapshot(self) -> Dict[str, Any]:
         """Exact totals + THIS engine's latency summaries (None until

@@ -75,6 +75,9 @@ class Request:
         self.ttft_s: Optional[float] = None
         self._replay: Optional[np.ndarray] = None   # replay_ids memo
         self.prefix_keys: Optional[list] = None     # chain-key memo
+        # stamped by ServeEngine.submit (engine run_id + rid): the
+        # obs.trace id every event about this request carries
+        self.trace_id: Optional[str] = None
         self.handle = RequestHandle(self)
 
     def replay_ids(self) -> np.ndarray:
@@ -126,6 +129,13 @@ class RequestHandle:
     @property
     def rid(self) -> int:
         return self._req.rid
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        """The request's obs trace id (``<engine run_id>/r<rid>``) —
+        the key for ``obsq trace`` and for slicing a flight-recorder
+        dump to this request's timeline."""
+        return self._req.trace_id
 
     @property
     def status(self) -> str:

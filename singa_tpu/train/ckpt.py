@@ -45,6 +45,7 @@ import warnings
 from typing import Dict, List, Optional
 
 from ..obs import events
+from ..obs import trace as obs_trace
 from ..utils import checkpoint
 from .state import AUX_RUN_STATE, RunState
 
@@ -163,9 +164,20 @@ class AsyncCheckpointManager:
                 # a daemon for exactly the opposite reason)
                 self._executor = ThreadPoolExecutor(
                     max_workers=1, thread_name_prefix="singa-train-ckpt")
+            # the writer INHERITS the saving step's trace context
+            # (threads never inherit contextvars implicitly): the
+            # train.ckpt.write span belongs to the run/step whose
+            # snapshot it serializes, so the overlap is visible inside
+            # ONE trace instead of as an orphan span
+            ctx = obs_trace.capture()
             self._pending = self._executor.submit(
-                self._write, step, arrays, full_aux)
+                self._write_traced, ctx, step, arrays, full_aux)
         return self.path(step)
+
+    def _write_traced(self, ctx, step: int, arrays: Dict,
+                      aux: Dict) -> None:
+        with obs_trace.attach(ctx):
+            self._write(step, arrays, aux)
 
     def _write(self, step: int, arrays: Dict, aux: Dict) -> None:
         from .. import faults

@@ -40,7 +40,9 @@ from typing import Any, Callable, Iterable, Optional, Tuple
 import numpy as np
 
 from ..obs import events
+from ..obs import flight as obs_flight
 from ..obs import record as obs_record
+from ..obs import trace as obs_trace
 from ..utils import failure
 from .ckpt import AsyncCheckpointManager
 from .preempt import PreemptionHandler
@@ -133,6 +135,10 @@ class TrainRunner:
         # the lock makes write-exactly-once true, not just likely
         self._record_lock = threading.Lock()
         self._record_written = False
+        # the incident flight ring (ISSUE 11): bounded in-memory record
+        # of recent steps/retries, dumped on the fatal/hung paths when
+        # record_store names a place for the evidence
+        self.flight = obs_flight.register(obs_flight.FlightRecorder())
         self._resumed_from = -1
         self._prestep_data: Optional[dict] = None
         self._ckpt0 = ckpt.committed_count if ckpt is not None else 0
@@ -145,6 +151,15 @@ class TrainRunner:
 
     # -- lifecycle ---------------------------------------------------------
     def run(self) -> TrainResult:
+        # the whole run executes under one trace (the run_id): every
+        # span/counter it emits — resume, per-step spans with their
+        # retry attempts, checkpoint snapshot/write (the background
+        # writer inherits via trace.capture/attach in train.ckpt) —
+        # carries it, so `obsq trace <run_id>` renders the run timeline
+        with obs_trace.activate(self.run_id):
+            return self._run_traced()
+
+    def _run_traced(self) -> TrainResult:
         self._t0 = time.perf_counter()
         start_step = self._restore()
         self._resumed_from = start_step if start_step > 0 else -1
@@ -287,6 +302,10 @@ class TrainRunner:
                 # injected InjectedFault is a RuntimeError, so it takes
                 # the same backoff/liveness/fatal path a real transient
                 # dispatch failure would
+                # note BEFORE the injection site: a faulted attempt
+                # must still show up in the flight timeline
+                self.flight.note("span", "train.step", step=step,
+                                 attempt=attempt)
                 faults.fire("train.step", step=step, attempt=attempt)
                 with events.span("train.step", step=step, attempt=attempt):
                     return self.model.train_step(
@@ -314,6 +333,9 @@ class TrainRunner:
                 attempt += 1
                 events.counter("train.retries", 1, step=step,
                                backoff_s=delay)
+                self.flight.note("counter", "train.retries", step=step,
+                                 backoff_s=delay,
+                                 error=type(e).__name__)
                 warnings.warn(
                     f"train step {step} attempt {attempt} failed "
                     f"({type(e).__name__}: {e}); retrying in {delay:.2f}s",
@@ -382,6 +404,7 @@ class TrainRunner:
             # timeout; the watchdog must not kill the save it triggered
             self.heartbeat.stop()
         events.counter("train.aborts", 1, step=step)
+        self.flight.note("counter", "train.aborts", step=step, msg=msg)
         if self.ckpt is not None:
             try:
                 self._save(step, force=True, block=True,
@@ -390,28 +413,51 @@ class TrainRunner:
                 warnings.warn(f"emergency checkpoint failed: "
                               f"{type(e).__name__}: {e}", stacklevel=2)
         self._append_record("aborted", step,
-                            time.perf_counter() - self._t0)
+                            time.perf_counter() - self._t0,
+                            dump=lambda: self._flight_dump("train.fatal",
+                                                           msg))
         (self.on_fatal or failure.clean_abort)(msg)
 
     def _heartbeat_failure(self, age: float, last_step: int) -> None:
         """Monitor-thread path: the step thread is wedged, so no
-        checkpoint (the gather would wedge too) — record, then abort."""
+        checkpoint (the gather would wedge too) — record, then abort.
+        (Runs trace-less by design: threads never inherit the run's
+        trace context implicitly, and the hang observation is
+        run-scoped evidence the record itself carries.)"""
         msg = (f"no heartbeat for {age:.1f}s (last step {last_step}); "
                f"assuming hung collective or dead device")
         events.counter("train.aborts", 1, step=last_step)
+        self.flight.note("counter", "train.aborts", step=last_step,
+                         msg=msg)
         self._append_record("hung", max(0, last_step + 1),
-                            time.perf_counter() - self._t0)
+                            time.perf_counter() - self._t0,
+                            dump=lambda: self._flight_dump("train.hung",
+                                                           msg))
         (self.on_fatal or failure.clean_abort)(msg)
 
-    # -- durable run record ------------------------------------------------
-    def _append_record(self, outcome: str, steps: int,
-                       wall_s: float) -> None:
+    # -- durable run record + flight dumps ---------------------------------
+    def _flight_dump(self, site: str, reason: str) -> Optional[str]:
+        """Dump the flight ring next to the record store and return the
+        ``flight_ref`` (or None without a store) — the shared
+        :func:`obs.flight.dump_for_store` contract; this thin wrapper
+        exists so literal sites at call sites stay SGL009-checkable."""
+        return obs_flight.dump_for_store(self.flight, site,
+                                         self.record_store, reason)
+
+    def _append_record(self, outcome: str, steps: int, wall_s: float,
+                       dump: Optional[Callable[[], Optional[str]]] = None
+                       ) -> None:
         if not self.record_store:
             return
         with self._record_lock:
             if self._record_written:
                 return
             self._record_written = True
+        # the dump thunk runs only after winning the write-exactly-once
+        # race: a losing fatal path (step-thread abort vs heartbeat
+        # firing together) must not strand an orphan dump that no
+        # record's flight_ref points at
+        flight_ref = dump() if dump is not None else None
         try:
             import jax
             platform = jax.default_backend()
@@ -426,6 +472,8 @@ class TrainRunner:
                 "outcome": outcome,
                 "total_steps": int(self.total_steps),
             }
+            if flight_ref:
+                payload["flight_ref"] = flight_ref
             entry = obs_record.new_entry(
                 "train_run", platform, platform != "tpu", device_kind,
                 run_id=self.run_id, payload=payload)

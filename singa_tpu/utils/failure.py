@@ -52,7 +52,17 @@ class Heartbeat:
         hb.stop()
 
     `on_failure(age_s, last_step)` defaults to `clean_abort`; tests pass
-    a callback instead."""
+    a callback instead.
+
+    Trace contexts (ISSUE 11): the monitor thread deliberately DROPS
+    the spawner's ``obs.trace`` context — ``threading.Thread`` never
+    inherits contextvars, and this is the designed behavior here, not
+    an accident: hang detection observes the whole loop, so attributing
+    its events to whichever request/step happened to be active when
+    ``start()`` ran would fabricate a causal link the watchdog does not
+    have.  ``on_failure`` therefore fires trace-less (asserted in
+    tests/test_trace.py); a worker that SHOULD carry a trace uses
+    ``obs.trace.capture()``/``attach()`` (see train.ckpt's writer)."""
 
     def __init__(self, timeout: float = 300.0, check_every: float = 1.0,
                  on_failure: Optional[Callable[[float, int], None]] = None):

@@ -339,6 +339,29 @@ class TestTrainSite:
             with pytest.raises(TrainAborted):
                 r.run()
 
+    def test_losing_fatal_path_does_not_strand_a_dump(self, tmp_path):
+        """Write-exactly-once extends to flight dumps: when a second
+        fatal path loses the record race (step-thread abort vs
+        heartbeat firing together), it must not leave an orphan
+        incidents file that no record's flight_ref references."""
+        import time as _t
+
+        from singa_tpu.train import TrainRunner
+        store = tmp_path / "runs" / "records.jsonl"
+        r = TrainRunner(_TinyModel(), _loader(), total_steps=1,
+                        to_batch=tuple, record_store=str(store),
+                        on_fatal=lambda msg: None,
+                        _sleep=lambda s: None)
+        r._t0 = _t.perf_counter()
+        r.flight.note("counter", "x")
+        r._fatal(0, "first fatal")           # wins: record + dump
+        r._heartbeat_failure(1.0, 0)         # loses: neither
+        entries = obs_record.RunRecord(str(store)).entries()
+        assert len(entries) == 1
+        ref = entries[0]["payload"]["flight_ref"]
+        dumps = os.listdir(tmp_path / "runs" / "incidents")
+        assert dumps == [os.path.basename(ref)]
+
     def test_ckpt_write_fault_surfaces_like_enospc(self, tmp_path):
         from singa_tpu.train import AsyncCheckpointManager
         ck = AsyncCheckpointManager(str(tmp_path / "ck"))
@@ -710,6 +733,170 @@ class TestDeviceExecuteSite:
                 m.train_step(xb, yb)
             _, loss = m.train_step(xb, yb)   # call 2: clean dispatch,
             assert np.isnan(float(loss.data))  # NaN-corrupted outputs
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 11 acceptance: request traces, the flight recorder, obsq slo
+# (shared llama engine — no new compiles in tier-1)
+# ---------------------------------------------------------------------------
+
+class TestTraceFlightAcceptance:
+    def test_request_traces_derive_ttft_and_tokens(self, engine,
+                                                   baseline, tmp_path):
+        """Acceptance (a): every completed request reconstructs as a
+        single trace — its span-derived TTFT equals the histogram
+        observation bit-for-bit, its delivery count equals its token
+        list, and no other request's events leak into its trace.  With
+        no record_store the engine performs zero file writes beyond the
+        sink, while the flight ring is still recording (active even
+        when the JSONL sink is off)."""
+        path = str(tmp_path / "ev.jsonl")
+        events.configure(path=path)
+        try:
+            hs = [engine.submit(p, max_new_tokens=6)
+                  for p in _prompts([4, 6, 8])]
+            engine.run_until_idle()
+        finally:
+            events.configure()
+        assert [h.tokens for h in hs] == baseline
+        evs = [json.loads(l) for l in open(path)]
+        for h in hs:
+            mine = [e for e in evs if e.get("trace") == h.trace_id]
+            ttft = [e for e in mine if e["name"] == "serve.ttft_ms"]
+            assert len(ttft) == 1
+            assert ttft[0]["value"] == h.ttft_s * 1e3   # bitwise equal
+            toks = [e for e in mine if e["name"] == "serve.token"]
+            assert len(toks) == len(h.tokens) == 6
+            # no cross-request leakage: every delivery in this trace
+            # names this rid, and the prefill span is in-trace
+            assert {e["rid"] for e in toks} == {h.rid}
+            assert any(e["name"] == "serve.prefill"
+                       and e["kind"] == "span" for e in mine)
+        # flight ring active without any record_store; zero file writes
+        assert engine.flight.snapshot()
+        assert sorted(os.listdir(tmp_path)) == ["ev.jsonl"]
+
+    def test_quarantine_dump_holds_the_poisoned_timeline(self, engine,
+                                                         tmp_path):
+        """Acceptance (b): the quarantine's incident record carries a
+        flight_ref, and the dump it points at contains the poisoned
+        request's full timeline (submit → injected faults → retries →
+        quarantine)."""
+        store = str(tmp_path / "runs" / "records.jsonl")
+        engine.record_store = store
+        plan = FaultPlan([FaultSpec("serve.prefill", "error",
+                                    every=1, times=3)])
+        try:
+            with faults.active(plan):
+                with pytest.warns(UserWarning, match="quarantined"):
+                    poisoned = engine.submit(_prompts([5], seed=3)[0],
+                                             max_new_tokens=4)
+                    engine.run_until_idle()
+        finally:
+            engine.record_store = None
+        assert poisoned.failed
+        (inc,) = [e for e in obs_record.RunRecord(store).entries()
+                  if e["kind"] == "incident"]
+        ref = inc["payload"]["flight_ref"]
+        dump_path = os.path.join(os.path.dirname(store), ref)
+        assert os.path.exists(dump_path)
+        from tools import obsq
+        timeline = [e["name"] for e in obsq.load_events(dump_path)
+                    if e.get("trace") == poisoned.trace_id]
+        assert timeline.count("fault.injected") == 3
+        for name in ("serve.submitted", "serve.retries",
+                     "serve.quarantined"):
+            assert name in timeline, timeline
+        # and the records audit validates the ref end to end
+        from tools.lint import audit
+        assert audit.check_records_root(str(tmp_path)) == []
+
+    def test_recovery_dump_ref_lands_in_incident_record(self, engine,
+                                                        baseline,
+                                                        tmp_path):
+        store = str(tmp_path / "runs" / "records.jsonl")
+        engine.record_store = store
+        try:
+            hs = [engine.submit(p, max_new_tokens=6)
+                  for p in _prompts([4, 6, 8])]
+            engine.step()
+            engine.recover("test-flight")
+            engine.run_until_idle()
+        finally:
+            engine.record_store = None
+        assert [h.tokens for h in hs] == baseline
+        (inc,) = [e for e in obs_record.RunRecord(store).entries()
+                  if e["payload"].get("outcome") == "recovered"]
+        ref = inc["payload"]["flight_ref"]
+        from tools import obsq
+        dump = obsq.load_events(os.path.join(os.path.dirname(store),
+                                             ref))
+        assert any(e["name"] == "serve.recoveries" for e in dump)
+        assert_program_count(engine, (1, 1))
+
+    def test_loadgen_chaos_slo_reproducible_from_traces(self, engine,
+                                                        tmp_path):
+        """THE ISSUE-11 acceptance run: an open-loop loadgen burst under
+        an active FaultPlan yields (a) per-request trace-derived TTFT
+        equal to the histogram values, (b) a flight dump for the
+        quarantine whose ref is in the incident record, and (c) `obsq
+        slo` reproducing the emitted serve_load record's p50/p99 and
+        tokens/s from the raw traces."""
+        from singa_tpu.serve.metrics import ServeMetrics
+        from tools import loadgen, obsq
+
+        store = str(tmp_path / "runs" / "records.jsonl")
+        path = str(tmp_path / "ev.jsonl")
+        # fresh per-run aggregation so the recorded percentiles cover
+        # exactly the events this run emits (the module engine's
+        # histograms are cumulative across the chaos suite)
+        engine.metrics = ServeMetrics(flight=engine.flight)
+        engine.record_store = store
+        plan = FaultPlan([
+            FaultSpec("serve.block_alloc", "error", at=1),
+            FaultSpec("serve.decode", "error", every=7, times=2),
+        ], seed=5)
+        wl = loadgen.build_workload(16, rate_rps=200.0, seed=4,
+                                    prompt_lens=(4, 8), new_tokens=(3, 6),
+                                    tenants=2, shared_len=6)
+        events.configure(path=path)
+        try:
+            with faults.active(plan):
+                with pytest.warns(UserWarning, match="quarantined"):
+                    payload = loadgen.run_load(engine, wl)
+        finally:
+            events.configure()
+            engine.record_store = None
+        assert engine.pending == 0
+        assert plan.fire_count() >= 2
+        evs = obsq.load_events(path)
+        # (a) every request with a first token: trace TTFT == histogram
+        by_trace = {}
+        for e in evs:
+            if e.get("name") == "serve.ttft_ms" and "trace" in e:
+                by_trace[e["trace"]] = e["value"]
+        snap = engine.metrics.snapshot()
+        assert len(by_trace) == snap["ttft_ms"]["count"]
+        # (b) the quarantined request's dump is referenced and holds it
+        incidents = [e for e in obs_record.RunRecord(store).entries()
+                     if e["kind"] == "incident"]
+        quar = [e for e in incidents
+                if e["payload"]["outcome"] == "quarantined"]
+        assert quar and all("flight_ref" in e["payload"] for e in quar)
+        dump = obsq.load_events(os.path.join(
+            os.path.dirname(store), quar[0]["payload"]["flight_ref"]))
+        assert any(e["name"] == "serve.quarantined" for e in dump)
+        # (c) obsq slo reproduces the serve_load payload from traces
+        derived = obsq.derive_slo(evs)
+        assert derived["requests_with_first_token"] == \
+            snap["ttft_ms"]["count"]
+        mismatches = obsq.compare_slo(derived, payload,
+                                      tol_pct=1.0, tps_tol_pct=60.0)
+        assert mismatches == [], mismatches
+        # the record itself round-trips through the store + audit
+        loadgen.append_record(payload, store)
+        from tools.lint import audit
+        assert audit.check_records_root(str(tmp_path)) == []
 
 
 # ---------------------------------------------------------------------------

@@ -42,7 +42,8 @@ def lint(src, code):
 
 def test_catalogue_covers_the_invariants():
     assert set(RULES) >= {"SGL001", "SGL002", "SGL003", "SGL004",
-                          "SGL005", "SGL006", "SGL007", "SGL008"}
+                          "SGL005", "SGL006", "SGL007", "SGL008",
+                          "SGL009"}
     for code, cls in RULES.items():
         assert cls.code == code and cls.name and cls.description
 
@@ -485,6 +486,66 @@ class TestRegistryRules:
             faults.fire("ckpt.write")
         """, "SGL007")
         assert codes_of(out) == ["SGL007"]
+        assert "could not be loaded" in out[0].message
+
+
+# ---------------------------------------------------------------------------
+# SGL009 flight-site (registry-backed, ISSUE 11)
+# ---------------------------------------------------------------------------
+
+class TestFlightSite:
+    def test_typoed_dump_site_fires(self):
+        out = lint("""
+            class Engine:
+                def boom(self):
+                    self.flight.dump("serve.typo", "runs/incidents")
+        """, "SGL009")
+        assert codes_of(out) == ["SGL009"]
+        assert "serve.typo" in out[0].message
+
+    def test_helper_form_and_keyword_form_are_checked(self):
+        out = lint("""
+            class Runner:
+                def a(self):
+                    self._flight_dump("train.typo", "msg")
+                def b(self):
+                    self.flight.dump(site="also.typo", directory="d")
+        """, "SGL009")
+        assert codes_of(out) == ["SGL009", "SGL009"]
+
+    def test_registered_sites_are_clean(self):
+        # injection sites AND the incident-only seams both validate
+        out = lint("""
+            class Engine:
+                def ok(self):
+                    self.flight.dump("serve.prefill", "runs/incidents")
+                    self.flight.dump("serve.arena", "runs/incidents")
+                    self._flight_dump("train.fatal", "msg")
+        """, "SGL009")
+        assert out == []
+
+    def test_unrelated_dump_calls_are_ignored(self):
+        out = lint("""
+            import json
+
+            def save(obj, f):
+                json.dump(obj, f)          # nothing says 'flight'
+                pickle.dump("whatever", f)
+        """, "SGL009")
+        assert out == []
+
+    def test_unloadable_registry_is_a_finding_not_a_pass(self, tmp_path,
+                                                         monkeypatch):
+        from tools.lint import rules
+        monkeypatch.setattr(rules, "_REPO_ROOT", str(tmp_path))
+        monkeypatch.setattr(rules, "_SITES_CACHE", {})
+        monkeypatch.setattr(rules, "_INCIDENT_CACHE", {})
+        out = lint("""
+            class Engine:
+                def boom(self):
+                    self.flight.dump("serve.arena", "runs/incidents")
+        """, "SGL009")
+        assert codes_of(out) == ["SGL009"]
         assert "could not be loaded" in out[0].message
 
 

@@ -13,9 +13,13 @@
 #             decode — one shared lowering, tools/lint/{rules,hlo,cost}.py)
 #   stage 2  records      `python -m tools.lint --records`  exit 11
 #            (telemetry/record store validation incl. the extended
-#             hlo_audit cost numerics and the wire-byte pair on
-#             train_run/bench records)
-#   stage 3  tier-1 tests  the ROADMAP.md tier-1 command     exit 20
+#             hlo_audit cost numerics, the wire-byte pair on
+#             train_run/bench records, and flight_ref dump targets)
+#   stage 3  obsq smoke   `python -m tools.obsq slo --check` exit 12
+#            (the trace query layer reproduces a committed serve_load
+#             fixture's TTFT p50/p99 + tokens/s from raw trace events —
+#             guards the event schema obsq and the autotuner consume)
+#   stage 4  tier-1 tests  the ROADMAP.md tier-1 command     exit 20
 #
 # Exit 0 = every stage green.  Intentional compiled-program changes are
 # re-baselined first via `python -m tools.lint --hlo --update-baselines`
@@ -23,13 +27,18 @@
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
-echo "== ci_gate stage 1/3: full audit (static + HLO structure + cost) =="
+echo "== ci_gate stage 1/4: full audit (static + HLO structure + cost) =="
 JAX_PLATFORMS=cpu python -m tools.lint || exit 10
 
-echo "== ci_gate stage 2/3: record validation =="
+echo "== ci_gate stage 2/4: record validation =="
 JAX_PLATFORMS=cpu python -m tools.lint --records || exit 11
 
-echo "== ci_gate stage 3/3: tier-1 test suite (ROADMAP.md budget) =="
+echo "== ci_gate stage 3/4: obsq SLO smoke (trace-derived vs committed fixture) =="
+JAX_PLATFORMS=cpu python -m tools.obsq slo --check \
+    --records tests/data/obsq/records.jsonl \
+    --events tests/data/obsq/events.jsonl || exit 12
+
+echo "== ci_gate stage 4/4: tier-1 test suite (ROADMAP.md budget) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

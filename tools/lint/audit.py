@@ -74,6 +74,58 @@ def check_records_root(root: str) -> List[str]:
     store = os.path.join(root, obs_record.DEFAULT_STORE)
     if os.path.exists(store):
         errors.extend(obs_record.RunRecord(store).validate())
+        errors.extend(_check_flight_refs(store))
+    errors.extend(_check_incident_dumps(root))
+    return errors
+
+
+def _check_flight_refs(store: str) -> List[str]:
+    """Every ``flight_ref`` carried by a store entry must point at an
+    existing, parseable flight dump (path relative to the store's
+    directory) — a ref into nothing would strand the postmortem the
+    whole flight-recorder machinery exists to serve."""
+    _ensure_repo_on_path()
+    from singa_tpu.obs import record as obs_record
+    from singa_tpu.obs import schema
+    from tools import obsq
+
+    errors: List[str] = []
+    try:
+        entries = obs_record.RunRecord(store).entries()
+    except schema.SchemaError:
+        return []          # the store lint above already reported it
+    store_dir = os.path.dirname(os.path.abspath(store))
+    for e in entries:
+        ref = (e.get("payload") or {}).get("flight_ref")
+        if not isinstance(ref, str) or not ref:
+            continue
+        path = os.path.join(store_dir, ref)
+        if not os.path.exists(path):
+            errors.append(f"{store}: {e['run_id']}: flight_ref {ref!r} "
+                          f"points at a missing dump file")
+            continue
+        try:
+            obsq.load_events(path)
+        except ValueError as exc:
+            errors.append(f"{store}: {e['run_id']}: flight_ref {ref!r}: "
+                          f"{exc}")
+    return errors
+
+
+def _check_incident_dumps(root: str) -> List[str]:
+    """Every committed flight dump under ``runs/incidents/`` must parse
+    as an event-per-line file (partial/truncated dumps fail here, not
+    in a postmortem)."""
+    _ensure_repo_on_path()
+    from tools import obsq
+
+    errors: List[str] = []
+    for path in sorted(glob.glob(os.path.join(root, "runs", "incidents",
+                                              "*.jsonl"))):
+        try:
+            obsq.load_events(path)
+        except ValueError as exc:
+            errors.append(str(exc))
     return errors
 
 

@@ -10,6 +10,7 @@
 | SGL006 | obs-kind         | record kinds are members of obs.schema._KINDS  |
 | SGL007 | fault-site       | faults.fire/corrupt sites exist in the registry|
 | SGL008 | host-sync        | no device fetches in hot engine/runner loops   |
+| SGL009 | flight-site      | flight-recorder dump sites are registered names|
 
 Rules are module-local static analysis: each builds a one-level call
 graph inside the file it lints (jit roots -> direct helper calls,
@@ -733,6 +734,7 @@ def _registry_literals(rel_path: str, var: str,
 
 _KINDS_CACHE: Dict[str, Optional[Set[str]]] = {}
 _SITES_CACHE: Dict[str, Optional[Set[str]]] = {}
+_INCIDENT_CACHE: Dict[str, Optional[Set[str]]] = {}
 
 
 def _call_arg(call: ast.Call, idx: int, kwname: str) -> Optional[ast.AST]:
@@ -760,6 +762,21 @@ def fault_sites(root: Optional[str] = None) -> Optional[Set[str]]:
         _SITES_CACHE[key] = _registry_literals(
             os.path.join("singa_tpu", "faults", "sites.py"), "SITES", root)
     return _SITES_CACHE[key]
+
+
+def incident_sites(root: Optional[str] = None) -> Optional[Set[str]]:
+    """SITES ∪ INCIDENT_SITES — the names a flight-recorder dump (or an
+    incident record) may carry; None when either registry is
+    unloadable."""
+    key = root or _REPO_ROOT
+    if key not in _INCIDENT_CACHE:
+        extra = _registry_literals(
+            os.path.join("singa_tpu", "faults", "sites.py"),
+            "INCIDENT_SITES", root)
+        base = fault_sites(root)
+        _INCIDENT_CACHE[key] = (None if base is None or extra is None
+                                else base | extra)
+    return _INCIDENT_CACHE[key]
 
 
 @register
@@ -911,3 +928,64 @@ class HostSyncRule(Rule):
                         f"device->host transfer inside the hot loop — "
                         f"keep values device-resident, batch the fetch, "
                         f"or suppress with the measured justification")
+
+
+# ---------------------------------------------------------------------------
+# SGL009 flight-site — registry check over flight-recorder dump calls
+# ---------------------------------------------------------------------------
+
+@register
+class FlightSiteRule(Rule):
+    code = "SGL009"
+    name = "flight-site"
+    description = ("literal site names passed to FlightRecorder dump "
+                   "calls (obs.flight) must be registered fault sites "
+                   "or faults.sites.INCIDENT_SITES members — a typo'd "
+                   "dump site would silently never dump (the runtime "
+                   "check only fires on the incident path itself)")
+
+    @staticmethod
+    def _is_dump_call(node: ast.Call, full: str) -> bool:
+        """``obs.flight.dump(...)`` module-level calls, attribute calls
+        on anything named like a flight recorder (``self.flight.dump``,
+        ``self._flight.dump``), and flight-dump helper methods whose
+        own name says both (``self._flight_dump(site, ...)`` — the
+        form the engine/runner call with literal sites).  ``rec.dump``
+        is NOT matched: something in the call must say 'flight'."""
+        if full in ("obs.flight.dump", "flight.dump"):
+            return True
+        d = (dotted_name(node.func) or "").lower()
+        if d.endswith(".dump") and "flight" in d:
+            return True
+        last = d.rsplit(".", 1)[-1]
+        return "flight" in last and "dump" in last
+
+    def check(self, tree: ast.Module, src: str,
+              path: str) -> Iterable[Finding]:
+        sites = incident_sites()
+        imports = import_map(tree)
+        for node in module_calls(tree):
+            full = resolve(node.func, imports) or ""
+            if not self._is_dump_call(node, full):
+                continue
+            site = _call_arg(node, 0, "site")
+            if site is None:
+                continue
+            if sites is None:
+                yield self.finding(
+                    path, node,
+                    "cannot verify flight-dump site: faults/sites.py "
+                    "SITES/INCIDENT_SITES registries could not be "
+                    "loaded — the sites file is missing, renamed, or "
+                    "unparsable")
+                continue
+            if isinstance(site, ast.Constant) and \
+                    isinstance(site.value, str) and site.value not in sites:
+                yield self.finding(
+                    path, site,
+                    f"flight-dump site {site.value!r} is not a "
+                    f"registered fault site or INCIDENT_SITES member "
+                    f"({', '.join(sorted(sites))}) — an unregistered "
+                    f"site raises at the worst possible moment (the "
+                    f"incident) instead of dumping; register it or fix "
+                    f"the typo")
