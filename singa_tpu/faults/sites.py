@@ -68,6 +68,21 @@ SITES: Dict[str, Tuple[str, Tuple[str, ...]]] = {
         "escalates to an arena rebuild that reconstructs block tables "
         "and refcounts",
         ("error", "hang")),
+    "serve.handoff": (
+        "disaggregated-tier KV block handoff (the Router moving a "
+        "finished prefill's blocks from a prefill worker to a decode "
+        "worker); fires BEFORE extraction, so an injected error models "
+        "a worker dying mid-handoff with the source arena's host state "
+        "intact — the router re-routes: the request re-prefills from "
+        "prompt (+ tokens so far) on a prefill worker and its greedy "
+        "stream is unchanged",
+        ("error", "hang")),
+    "serve.router": (
+        "disaggregated-tier routing decision (per Router.submit, "
+        "before a prefill worker is chosen); an injected error "
+        "surfaces to the submitter like a routing outage — requests "
+        "already inside the tier are unaffected",
+        ("error", "hang")),
     "train.step": (
         "TrainRunner's retried step region (the shared injector the "
         "train retry/backoff path is exercised through)",

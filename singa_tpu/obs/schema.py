@@ -40,7 +40,7 @@ __all__ = ["SCHEMA_VERSION", "SchemaError", "require", "validate_entry",
            "validate_serve_load_payload", "validate_train_run_payload",
            "validate_incident_payload", "validate_hlo_audit_payload",
            "validate_wire_byte_fields", "validate_flight_ref",
-           "entry_key"]
+           "validate_serve_tier_fields", "entry_key"]
 
 #: bump when entry fields change incompatibly; validators dispatch on it
 SCHEMA_VERSION = 1
@@ -61,6 +61,17 @@ _SERVE_FIELDS = ("tokens_per_s", "speedup_vs_sequential", "ttft_p50_ms",
 #: on p99 TTFT and tokens/s under overload rather than on unit tests
 _SERVE_LOAD_FIELDS = ("requests", "completed", "shed", "rejected",
                       "tokens_per_s", "ttft_p50_ms", "ttft_p99_ms")
+
+#: the disaggregated-tier pool fields (tools/loadgen.py driving a
+#: serve.disagg Router): how the tier was shaped (worker counts per
+#: pool), how many KV handoffs crossed it, and the handoff p99 wait
+#: (prefill-finish -> decode-inject, decode-capacity queueing
+#: included).  OPTIONAL on serve_load payloads — a single-engine run
+#: has no pools — but a record carrying ANY of them must carry ALL,
+#: numeric (a ratio-sweep point whose worker counts went missing could
+#: not support the independent-scaling claim the sweep exists to make)
+_SERVE_TIER_FIELDS = ("prefill_workers", "decode_workers", "handoffs",
+                      "handoff_p99_ms")
 
 #: required numeric payload fields of a train_run entry — what the
 #: training orchestrator (singa_tpu.train.TrainRunner) commits for
@@ -238,8 +249,23 @@ def validate_serve_load_payload(payload: Any,
     """One loadgen traffic run's outcome: every field in
     ``_SERVE_LOAD_FIELDS`` present and numeric — an overload run whose
     shed/rejected counts went missing would let 'survived the chaos
-    run' masquerade as 'served every request'."""
+    run' masquerade as 'served every request'.  The optional
+    disaggregated-tier pool fields (``_SERVE_TIER_FIELDS``) are linted
+    whenever any of them appear."""
     _require_numeric_fields(payload, _SERVE_LOAD_FIELDS, ctx)
+    validate_serve_tier_fields(payload, ctx)
+
+
+def validate_serve_tier_fields(payload: Any, ctx: str = "payload") -> None:
+    """The optional disaggregated-tier pool quartet: a payload carrying
+    ANY of ``_SERVE_TIER_FIELDS`` must carry all four, numeric — a
+    worker-ratio point without its handoff evidence (or vice versa)
+    cannot support the independent-scaling claim (see
+    docs/serving.md, "Disaggregated tier")."""
+    if not isinstance(payload, dict):
+        return
+    if any(f in payload for f in _SERVE_TIER_FIELDS):
+        _require_numeric_fields(payload, _SERVE_TIER_FIELDS, ctx)
 
 
 def validate_wire_byte_fields(payload: Any, ctx: str = "payload") -> None:

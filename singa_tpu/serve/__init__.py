@@ -24,16 +24,25 @@ whole serving lifetime runs through exactly two compiled XLA programs.
 * :mod:`~singa_tpu.serve.metrics` — queue/slot gauges, admit/reject/
   evict counters, TTFT and per-token latency histograms through
   ``obs.events``.
+* :mod:`~singa_tpu.serve.disagg` — disaggregated serving (ISSUE 12):
+  separately scaled prefill/decode worker pools (engines sharing ONE
+  set of compiled programs) behind an SLO-aware :class:`Router` with
+  per-tenant quotas, KV block handoff between arenas, and worker-death
+  re-routing with bitwise-identical streams.
 
 See docs/serving.md for the architecture, the slot lifecycle and the
 backpressure semantics.
 """
 
-from .engine import EngineClosed, ServeEngine
+from .disagg import (QuotaExceeded, Router, SLOClass, Worker,
+                     build_pools)
+from .engine import EngineClosed, ServeEngine, SharedPrograms
 from .scheduler import (EVICTED, FAILED, FINISHED, QUEUED, RUNNING,
                         QueueFull, RequestHandle, Scheduler)
 from .slots import BlockPool
 
 __all__ = ["ServeEngine", "BlockPool", "Scheduler", "RequestHandle",
-           "QueueFull", "EngineClosed",
+           "QueueFull", "EngineClosed", "SharedPrograms",
+           "Router", "SLOClass", "QuotaExceeded", "Worker",
+           "build_pools",
            "QUEUED", "RUNNING", "FINISHED", "EVICTED", "FAILED"]

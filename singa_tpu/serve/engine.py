@@ -87,6 +87,16 @@ each quarantine/recovery dumps the ring to
 ``<record dir>/incidents/<ts>-<site>.jsonl`` and the incident record's
 ``flight_ref`` points at it.  With no ``record_store`` and no sink the
 engine performs zero file writes.
+
+Disaggregated serving (ISSUE 12): the engine is also the worker unit
+of :mod:`singa_tpu.serve.disagg` — a prefill pool ticks with
+``step(decode=False)`` and hands finished prefills to a decode pool
+through :meth:`extract_handoff`/:meth:`inject_handoff` (KV blocks move
+via the optional third compiled program, a fixed-shape
+``handoff_gather``; refcounts and prefix-cache keys transfer with the
+blocks).  Same-config workers share one set of executables via
+``programs=`` (:class:`SharedPrograms`), so a whole tier costs one
+engine's compiles.
 """
 
 from __future__ import annotations
@@ -96,7 +106,7 @@ import threading
 import time
 import warnings
 from contextlib import nullcontext
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -113,10 +123,11 @@ from ..utils import failure
 from ..utils.failure import Heartbeat
 from .metrics import ServeMetrics
 from .scheduler import (EVICTED, FAILED, FINISHED, QUEUED, RUNNING,
-                        QueueFull, Request, RequestHandle, Scheduler)
+                        QueueFull, Request, RequestHandle, Scheduler,
+                        eta_first_token)
 from .slots import BlockPool
 
-__all__ = ["ServeEngine", "QueueFull", "EngineClosed"]
+__all__ = ["ServeEngine", "QueueFull", "EngineClosed", "SharedPrograms"]
 
 #: distinguishes engines built in the same second+pid (run_id suffix)
 _ENGINE_SEQ = itertools.count()
@@ -124,6 +135,26 @@ _ENGINE_SEQ = itertools.count()
 
 class EngineClosed(RuntimeError):
     """submit()/step() refused: the engine is draining or closed."""
+
+
+class SharedPrograms(NamedTuple):
+    """The compiled-program bundle one engine can lend to another
+    (``ServeEngine(..., programs=template.programs())``) — how a
+    disaggregated worker pool keeps the whole tier on ONE set of
+    executables: every same-config worker dispatches through the same
+    jitted callables, so N prefill + M decode workers cost exactly the
+    template's compiles (the per-worker jit-cache assertions then count
+    the shared caches).  Sharing requires the SAME model object and
+    block size (the closures capture both); arena shapes
+    (num_slots/max_len/num_blocks) may differ, but each distinct shape
+    adds a cache entry to the shared programs, so homogeneous pools are
+    what keeps the per-worker (1, 1) invariant literal."""
+
+    model_ref: object
+    block_size: int
+    prefill: object
+    decode: object
+    handoff: object
 
 
 class ServeEngine:
@@ -163,6 +194,7 @@ class ServeEngine:
                  max_recoveries: int = 2,
                  record_store: Optional[str] = None,
                  run_id: Optional[str] = None,
+                 programs: Optional[SharedPrograms] = None,
                  _sleep: Callable[[float], None] = time.sleep):
         self.model = model
         max_pos = getattr(getattr(model, "cfg", None), "max_position", None)
@@ -201,6 +233,12 @@ class ServeEngine:
         self._recoveries = 0
         self._incident_seq = itertools.count()
         self._tick_ewma: Optional[float] = None   # measured step() wall s
+        # admission-cadence hint from an external driver (the
+        # disaggregated Router pushes its measured round time here):
+        # the shed eta uses the slower of this and the engine's own
+        # tick EWMA, so a worker stepped once per router round does not
+        # under-estimate queue wait by (round / own-tick)
+        self.tick_hint_s: Optional[float] = None
 
         # weights snapshotted once (same pattern as _gen_setup); decode
         # is weight-read bound, so an optional one-time bf16 cast halves
@@ -242,6 +280,23 @@ class ServeEngine:
         self._toks = jnp.zeros((num_slots,), jnp.int32)
 
         # ---- the exactly-two compiled programs --------------------------
+        # (plus the optional third: the fixed-shape handoff gather a
+        # disaggregated tier uses to move a finished prefill's blocks —
+        # compiled lazily, only on the first handoff)
+        if programs is not None:
+            if programs.model_ref is not model:
+                raise ValueError(
+                    "programs= sharing requires the SAME model object "
+                    "(the jitted closures capture its cached forward)")
+            if programs.block_size != self.pool.block_size:
+                raise ValueError(
+                    f"programs= sharing requires matching block_size "
+                    f"(template {programs.block_size}, this engine "
+                    f"{self.pool.block_size})")
+            self._prefill = programs.prefill
+            self._decode = programs.decode
+            self._handoff = programs.handoff
+            return
         bs = self.pool.block_size
         resume = resume_step(model)
 
@@ -307,25 +362,53 @@ class ServeEngine:
                                                    k_tok, v_tok))
             return new_toks, new_pos, new
 
+        def handoff_gather(tables, slot, caches):
+            # the disaggregated tier's KV handoff source: ONE slot's
+            # dense per-layer view gathered through its block-table row
+            # (ops.kv_cache.gather_block_kv — no tensor reshaping).
+            # The arena is NOT donated: a failed handoff must leave the
+            # source caches valid so the router can re-route.
+            row = jax.lax.dynamic_index_in_dim(tables, slot, axis=0,
+                                               keepdims=True)   # (1, MB)
+            return [kv_ops.gather_block_kv(ck, cv, row)
+                    for ck, cv in caches]
+
         self._prefill = jax.jit(prefill_chunk, donate_argnums=(8,))
         self._decode = jax.jit(decode_paged, donate_argnums=(6,))
+        self._handoff = jax.jit(handoff_gather)
 
     # -- introspection ----------------------------------------------------
     def compiled_counts(self):
         """(prefill, decode) jit-cache entry counts — the no-recompile
         invariant says both stay at 1 after warmup (tested via
-        tools.lint.hlo.assert_program_count, shared with the HLO gate)."""
+        tools.lint.hlo.assert_program_count, shared with the HLO gate).
+        When programs are shared across a worker pool these are the
+        SHARED caches, so the invariant covers the whole tier at once."""
         return (self._prefill._cache_size(), self._decode._cache_size())
 
+    def handoff_compiled_count(self) -> int:
+        """Jit-cache entry count of the optional third program (the
+        disaggregated handoff gather): 0 until the first handoff, 1
+        after — never more (same fixed shapes as decode's inputs)."""
+        return self._handoff._cache_size()
+
+    def programs(self) -> SharedPrograms:
+        """The engine's compiled-program bundle, lendable to another
+        same-model/same-block-size engine via ``programs=`` — see
+        :class:`SharedPrograms`."""
+        return SharedPrograms(self.model, self.pool.block_size,
+                              self._prefill, self._decode, self._handoff)
+
     def lower_programs(self):
-        """jax ``Lowered`` handles of the exactly-two programs, keyed
-        ``prefill_chunk`` / ``decode`` — the hook ``tools/lint/hlo.py``
-        compiles to optimized HLO and audits (fusions, donation of the
-        KV arena, op histogram).  Lowering is abstract: nothing
-        executes, nothing is donated, and the jit caches
-        (:meth:`compiled_counts`) are untouched.  The traced shapes are
-        exactly the runtime dispatch shapes, so the audited modules ARE
-        the serving modules."""
+        """jax ``Lowered`` handles of the exactly-two programs (keyed
+        ``prefill_chunk`` / ``decode``) plus the optional third
+        (``handoff_gather``, the disaggregated tier's KV handoff
+        source) — the hook ``tools/lint/hlo.py`` compiles to optimized
+        HLO and audits (fusions, donation of the KV arena, op
+        histogram).  Lowering is abstract: nothing executes, nothing is
+        donated, and the jit caches (:meth:`compiled_counts`) are
+        untouched.  The traced shapes are exactly the runtime dispatch
+        shapes, so the audited modules ARE the serving modules."""
         bs = self.pool.block_size
         zero = jnp.asarray(0, jnp.int32)
         prefill = self._prefill.lower(
@@ -335,18 +418,68 @@ class ServeEngine:
         decode = self._decode.lower(
             self._params, self._buffers, self._toks, self.pool.pos,
             self.pool.active, self.pool.tables, self.pool.caches)
-        return {"prefill_chunk": prefill, "decode": decode}
+        handoff = self._handoff.lower(self.pool.tables, zero,
+                                      self.pool.caches)
+        return {"prefill_chunk": prefill, "decode": decode,
+                "handoff_gather": handoff}
 
     @property
     def pending(self) -> int:
         """Requests still in flight (queued + running)."""
         return self.sched.depth + len(self._running)
 
+    # -- disaggregated-tier hooks (serve/disagg) ---------------------------
+    def running_items(self) -> List[Tuple[int, Request]]:
+        """(slot, request) pairs currently occupying slots, slot order —
+        the router's per-tick view of what a prefill worker has ready to
+        hand off (a snapshot: handing off mutates ``_running``)."""
+        return sorted(self._running.items())
+
+    def withdraw(self, slot: int) -> Request:
+        """Remove a RUNNING request from this engine without finishing
+        it: the slot and its blocks are released, the request keeps its
+        prompt + tokens-so-far and goes back to QUEUED — the router's
+        re-route primitive (greedy decode makes the replay elsewhere
+        reproduce the exact stream, same argument as preemption)."""
+        req = self._running.pop(slot)
+        self.pool.release(slot)
+        req.slot = None
+        req.state = QUEUED
+        return req
+
+    def can_accept_handoff(self, pkg) -> bool:
+        """Whether this engine could :meth:`inject_handoff` ``pkg``
+        right now (free slot + coverable blocks, prefix sharing
+        counted) — side-effect free; see serve/disagg/handoff.py."""
+        from .disagg import handoff as _handoff_mod
+        return _handoff_mod.can_accept(self, pkg)
+
+    def extract_handoff(self, slot: int):
+        """Pull a finished prefill out of this engine as a
+        :class:`~singa_tpu.serve.disagg.handoff.HandoffPackage`:
+        the slot's blocks are gathered through the fixed-shape
+        ``handoff_gather`` program (the optional third compiled
+        program), then slot and blocks are released here — the
+        request now lives in the package until injected elsewhere."""
+        from .disagg import handoff as _handoff_mod
+        return _handoff_mod.extract(self, slot)
+
+    def inject_handoff(self, pkg) -> bool:
+        """Admit a prefilled request arriving from another engine:
+        blocks whose prefix chain keys are already resident map
+        copy-free (refcounts and keys transfer with the blocks), the
+        rest are scattered into freshly allocated blocks, and the
+        request continues decoding here mid-stream.  False when
+        capacity is lacking (the router parks the handoff)."""
+        from .disagg import handoff as _handoff_mod
+        return _handoff_mod.inject(self, pkg)
+
     # -- submission --------------------------------------------------------
     def submit(self, prompt_ids, *, max_new_tokens: int,
                deadline_s: Optional[float] = None,
                eos_id: Optional[int] = None,
-               on_token=None) -> RequestHandle:
+               on_token=None,
+               trace_id: Optional[str] = None) -> RequestHandle:
         """Queue one generation request; returns its handle.
 
         Raises :class:`QueueFull` when admission control refuses the
@@ -373,8 +506,11 @@ class ServeEngine:
         # about this request — admission, prefix hit, prefill chunks,
         # first token, decode deliveries, preemption, quarantine,
         # finish/shed/evict — carries this id, so the whole request is
-        # reconstructable as a single trace (handle.trace_id)
-        req.trace_id = f"{self.run_id}/r{req.rid}"
+        # reconstructable as a single trace (handle.trace_id).  A
+        # caller-supplied ``trace_id`` (the disaggregated Router) keeps
+        # ONE id alive across every worker the request touches, which
+        # is what makes the cross-worker timeline a single trace.
+        req.trace_id = trace_id or f"{self.run_id}/r{req.rid}"
         p = req.prompt.size
         if p + req.max_new_tokens > self.pool.max_len:
             raise ValueError(
@@ -391,12 +527,20 @@ class ServeEngine:
         return req.handle
 
     # -- the engine loop ---------------------------------------------------
-    def step(self) -> int:
+    def step(self, *, decode: bool = True) -> int:
         """One continuous-batching tick: recovery (if requested by the
         hang watchdog) → deadline eviction → overload shedding →
         admission (prefill queued requests into free slots while free
         blocks cover them) → block-table growth → one decode over all
-        active slots.  Returns the number of tokens delivered."""
+        active slots.  Returns the number of tokens delivered.
+
+        ``decode=False`` stops after admission — the disaggregated
+        tier's PREFILL-WORKER tick: freshly prefilled requests stay in
+        their slots (blocks intact) for the router to hand off to a
+        decode worker instead of decoding here.  Deadline eviction
+        still applies to parked requests, so a handoff the decode pool
+        cannot absorb in time is shed by the same machinery as any
+        other overload."""
         if self._closed:
             raise EngineClosed("step() on a closed engine")
         with events.span("serve.step"):
@@ -445,7 +589,7 @@ class ServeEngine:
             #    arena; a decode (or a decode-time block allocation)
             #    that died past its retry budget escalates to an arena
             #    rebuild + re-prefill instead of crashing the engine
-            if self._running:
+            if self._running and decode:
                 try:
                     self._ensure_blocks()
                     if self._running:
@@ -464,21 +608,22 @@ class ServeEngine:
 
     def _eta_first_token(self, position: int) -> float:
         """Seconds until the queued request at ``position`` could
-        plausibly deliver its first token.  Shedding runs immediately
-        before admission in the same tick, so the first
-        ``pool.free_count`` queued requests prefill THIS tick — eta 0.0,
-        never shed (a truly-expired deadline is eviction's job, not
-        shedding's).  Requests behind that window wait about one
-        measured tick per admission wave of ``num_slots``.  0.0 before
-        any tick has been measured — shedding never fires without
-        timing evidence."""
-        if self._tick_ewma is None:
+        plausibly deliver its first token — delegates to the shared
+        :func:`scheduler.eta_first_token` model with this engine's
+        admission period: the slower of the measured tick EWMA and the
+        external ``tick_hint_s`` a multi-pool driver (the disaggregated
+        Router) pushes, so a worker that only gets one admission
+        opportunity per router round sheds against the ROUND cadence,
+        not its own optimistic step time.  0.0 before any timing
+        evidence exists — shedding never fires blind."""
+        tick = self._tick_ewma
+        if self.tick_hint_s:
+            tick = (self.tick_hint_s if tick is None
+                    else max(tick, self.tick_hint_s))
+        if tick is None:
             return 0.0
-        free = self.pool.free_count
-        if position < free:
-            return 0.0
-        return self._tick_ewma * (1 + (position - free)
-                                  // self.pool.num_slots)
+        return eta_first_token(position, free_slots=self.pool.free_count,
+                               wave_size=self.pool.num_slots, tick_s=tick)
 
     def run_until_idle(self, max_steps: Optional[int] = None) -> None:
         """Drive ``step()`` until no request is queued or running.  With

@@ -24,7 +24,36 @@ from typing import Callable, Deque, List, Optional
 import numpy as np
 
 __all__ = ["QueueFull", "Request", "RequestHandle", "Scheduler",
+           "eta_first_token",
            "QUEUED", "RUNNING", "FINISHED", "EVICTED", "FAILED"]
+
+
+def eta_first_token(position: int, *, free_slots: int, wave_size: int,
+                    tick_s: float) -> float:
+    """Seconds until the queued request at ``position`` could plausibly
+    deliver its first token — the ONE eta model behind
+    :meth:`Scheduler.shed_overload` (engines and the disaggregated
+    router's workers both delegate here).
+
+    Shedding runs immediately before admission in the same tick, so the
+    first ``free_slots`` queued requests prefill THIS tick — eta 0.0,
+    never shed (a truly-expired deadline is eviction's job, not
+    shedding's).  Requests behind that window wait about one admission
+    period per wave of ``wave_size`` slots.
+
+    ``tick_s`` is the ADMISSION PERIOD of the pool this queue drains
+    into, not necessarily one engine's own step time: a worker stepped
+    by the disaggregated Router gets one admission opportunity per
+    ROUTER round (which steps every worker), so the router pushes its
+    measured round time into each worker via
+    ``ServeEngine.tick_hint_s`` and the eta uses the slower of the two
+    clocks.  Before PR 12 the eta always used the engine's own
+    tick EWMA, which under-estimated queue wait by (router round /
+    engine tick) and let doomed requests through to burn prefills
+    instead of being shed."""
+    if position < free_slots:
+        return 0.0
+    return tick_s * (1 + (position - free_slots) // max(1, wave_size))
 
 QUEUED = "queued"
 RUNNING = "running"
@@ -217,10 +246,12 @@ class Scheduler:
         deadline will expire before they could plausibly produce a first
         token.  ``eta_first_token_s(position)`` is the engine's estimate
         of seconds until the request at queue ``position`` would deliver
-        its first token (derived from measured tick times); a request
-        with ``deadline < now + eta`` only wastes a prefill, so it is
-        shed NOW — at admission-decision time, not after burning a slot.
-        Deadline-less requests are never shed."""
+        its first token (derived from measured tick times — see
+        :func:`eta_first_token` for the model, including how a
+        multi-pool tier folds the router's admission cadence in); a
+        request with ``deadline < now + eta`` only wastes a prefill, so
+        it is shed NOW — at admission-decision time, not after burning
+        a slot.  Deadline-less requests are never shed."""
         shed: List[Request] = []
         keep: Deque[Request] = deque()
         pos = 0

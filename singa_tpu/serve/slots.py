@@ -159,6 +159,13 @@ class BlockPool:
     def mapped_count(self, slot: int) -> int:
         return len(self._mapped[slot])
 
+    def mapped_blocks(self, slot: int) -> List[int]:
+        """The slot's physical block ids in logical order (a copy) —
+        what a disaggregated KV handoff transfers: the source engine
+        gathers these blocks' contents and the destination pool maps
+        the same logical sequence onto its own physical blocks."""
+        return list(self._mapped[slot])
+
     def _evict_lru(self) -> int:
         block, _ = self._lru.popitem(last=False)
         # the invariant the prefix cache stands on: only a block no

@@ -1,0 +1,340 @@
+"""Disaggregated serving tier (ISSUE 12) — the host-side half.
+
+Everything here runs WITHOUT compiling a model program: router policy
+(routing order, quotas, validation), the cross-pool shed-eta fix, the
+``serve_load`` tier-field schema contract, loadgen's ratio parsing,
+and the independent-scaling direction assertion over the COMMITTED
+ratio-sweep records (frozen data — deterministic in tier-1).  The
+compiled-engine half (bitwise streams, handoff chaos, cross-worker
+traces) lives in tests/test_faults.py, sharing its ONE llama engine.
+
+The live ratio sweep re-runs the committed regime end to end and is
+marked ``slow`` (ROADMAP item 6 budget discipline).
+"""
+
+import os
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from singa_tpu.obs import record as obs_record
+from singa_tpu.obs import schema
+from singa_tpu.serve import Router, SLOClass, Worker
+from singa_tpu.serve.engine import ServeEngine
+from singa_tpu.serve.scheduler import (Request, Scheduler,
+                                       eta_first_token)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# shed eta across pools (the satellite fix: the admission period of a
+# router-driven worker is the ROUTER round, not its own tick)
+# ---------------------------------------------------------------------------
+
+class TestCrossPoolEta:
+    def test_eta_model_waves(self):
+        # inside the free window: this tick, never shed
+        assert eta_first_token(0, free_slots=2, wave_size=4,
+                               tick_s=1.0) == 0.0
+        assert eta_first_token(1, free_slots=2, wave_size=4,
+                               tick_s=1.0) == 0.0
+        # behind it: one admission period per wave of wave_size
+        assert eta_first_token(2, free_slots=2, wave_size=4,
+                               tick_s=1.0) == 1.0
+        assert eta_first_token(5, free_slots=2, wave_size=4,
+                               tick_s=1.0) == 1.0
+        assert eta_first_token(6, free_slots=2, wave_size=4,
+                               tick_s=1.0) == 2.0
+        # degenerate wave size cannot divide by zero
+        assert eta_first_token(3, free_slots=0, wave_size=0,
+                               tick_s=0.5) == 0.5 * 4
+
+    def _engine_eta(self, own_tick, hint, position, free=0, slots=2):
+        eng = SimpleNamespace(_tick_ewma=own_tick, tick_hint_s=hint,
+                              pool=SimpleNamespace(free_count=free,
+                                                   num_slots=slots))
+        return ServeEngine._eta_first_token(eng, position)
+
+    def test_router_cadence_hint_slows_the_eta(self):
+        """REGRESSION (pre-PR 12 bug): a worker stepped once per
+        router round used its OWN tick EWMA, under-estimating queue
+        wait by (round / own tick).  With the hint pushed by the
+        router, the eta uses the slower clock."""
+        own, rnd = 0.01, 0.5
+        optimistic = self._engine_eta(own, None, position=3)
+        corrected = self._engine_eta(own, rnd, position=3)
+        assert optimistic == pytest.approx(0.01 * 2)
+        assert corrected == pytest.approx(0.5 * 2)
+        # and the hint alone suffices before the worker measured a tick
+        assert self._engine_eta(None, rnd, position=3) == \
+            pytest.approx(0.5 * 2)
+        # no evidence at all -> never shed blind
+        assert self._engine_eta(None, None, position=3) == 0.0
+
+    def test_shed_overload_uses_the_pool_cadence(self):
+        """End to end through Scheduler.shed_overload: a queued
+        request whose deadline survives the worker's optimistic own
+        tick is shed once the router's round cadence is accounted
+        for."""
+        sched = Scheduler(max_queue=8)
+        reqs = [Request(np.ones(4, np.int32), 4, deadline_s=0.3,
+                        eos_id=None, on_token=None) for _ in range(4)]
+        for r in reqs:
+            sched.offer(r)
+        now = reqs[0].submitted_at
+        # own tick 10 ms: every position looks reachable in time
+        eta_own = lambda pos: ServeEngine._eta_first_token(
+            SimpleNamespace(_tick_ewma=0.01, tick_hint_s=None,
+                            pool=SimpleNamespace(free_count=1,
+                                                 num_slots=1)), pos)
+        assert sched.shed_overload(now, eta_own) == []
+        # router round 200 ms: positions >= 2 cannot make the 300 ms
+        # deadline (eta 400 ms) and are shed NOW
+        eta_tier = lambda pos: ServeEngine._eta_first_token(
+            SimpleNamespace(_tick_ewma=0.01, tick_hint_s=0.2,
+                            pool=SimpleNamespace(free_count=1,
+                                                 num_slots=1)), pos)
+        shed = sched.shed_overload(now, eta_tier)
+        assert [r.rid for r in shed] == [reqs[2].rid, reqs[3].rid]
+        assert all(r.finish_reason == "shed" for r in shed)
+        assert sched.depth == 2
+
+
+# ---------------------------------------------------------------------------
+# router policy (no engines compiled: workers get inert stand-ins)
+# ---------------------------------------------------------------------------
+
+def _stub_worker(name, role):
+    from singa_tpu.serve.metrics import ServeMetrics
+    eng = SimpleNamespace(pending=0, tick_hint_s=None,
+                          sched=Scheduler(max_queue=8),
+                          metrics=ServeMetrics(), flight=None)
+    return Worker(name, role, eng)
+
+
+class TestRouterPolicy:
+    def test_tier_shape_is_validated(self):
+        pw = [_stub_worker("p0", "prefill")]
+        dw = [_stub_worker("d0", "decode")]
+        with pytest.raises(ValueError, match="at least one"):
+            Router(pw, [])
+        with pytest.raises(ValueError, match="at least one"):
+            Router([], dw)
+        with pytest.raises(ValueError, match="unique"):
+            Router(pw, [_stub_worker("p0", "decode")])
+        with pytest.raises(ValueError, match="SLOClass"):
+            Router(pw, dw, slo_classes={"x": 5.0})
+
+    def test_worker_role_and_slo_validation(self):
+        with pytest.raises(ValueError, match="unknown worker role"):
+            Worker("w", "prefetch", engine=None)
+        with pytest.raises(ValueError, match="deadline_s"):
+            SLOClass("interactive", -1.0)
+        assert SLOClass("batch", None).deadline_s is None
+
+    def test_route_order_is_least_loaded_deterministic(self):
+        a, b, c = (_stub_worker(n, "prefill") for n in ("a", "b", "c"))
+        a.engine.pending = 2
+        b.engine.pending = 0
+        c.engine.pending = 0
+        order = Router._route_order([a, b, c])
+        assert [w.name for w in order] == ["b", "c", "a"]
+
+    def test_worker_death_preserves_fifo_order(self):
+        """REGRESSION: victims are requeue_front'ed newest-first so
+        the oldest request ends up at the HEAD of the survivor's
+        queue — a worker death must not invert FIFO priority."""
+        dead = _stub_worker("p0", "prefill")
+        surv = _stub_worker("p1", "prefill")
+        router = Router([dead, surv], [_stub_worker("d0", "decode")])
+        reqs = [Request(np.ones(4, np.int32), 4, deadline_s=None,
+                        eos_id=None, on_token=None) for _ in range(3)]
+        for r in reqs:
+            router._handles[r.rid] = (r.handle, None)
+            router._where[r.rid] = dead
+        with pytest.warns(UserWarning, match="died"):
+            router.kill_worker("p0")
+        assert not dead.alive
+        assert [r.rid for r in surv.engine.sched.queue] == \
+            [r.rid for r in reqs]
+
+    def test_run_load_counts_injected_router_faults(self):
+        """REGRESSION: an injected `serve.router` fault at the door is
+        a counted outcome (detail.router_faults), not a crash of the
+        loadgen harness — the chaos contract says only an engine crash
+        propagates."""
+        from singa_tpu import faults
+        from singa_tpu.faults import FaultPlan, FaultSpec
+        from tools import loadgen
+
+        tier = Router([_stub_worker("p0", "prefill")],
+                      [_stub_worker("d0", "decode")])
+        wl = loadgen.build_workload(3, rate_rps=1000.0, seed=0)
+        plan = FaultPlan([FaultSpec("serve.router", "error", every=1,
+                                    times=3)])
+        with faults.active(plan):
+            payload = loadgen.run_load(tier, wl)
+        assert plan.fire_count() == 3
+        assert payload["detail"]["router_faults"] == 3
+        assert payload["completed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# serve_load tier-field schema (satellite: both-or-neither, numeric)
+# ---------------------------------------------------------------------------
+
+class TestTierFieldSchema:
+    BASE = {"requests": 10, "completed": 9, "shed": 1, "rejected": 0,
+            "tokens_per_s": 100.0, "ttft_p50_ms": 5.0,
+            "ttft_p99_ms": 20.0}
+    TIER = {"prefill_workers": 3, "decode_workers": 1, "handoffs": 9,
+            "handoff_p99_ms": 12.5}
+
+    def test_single_engine_payload_needs_no_tier_fields(self):
+        schema.validate_serve_load_payload(dict(self.BASE))
+
+    def test_full_tier_quartet_is_valid(self):
+        schema.validate_serve_load_payload({**self.BASE, **self.TIER})
+
+    def test_partial_tier_fields_are_rejected(self):
+        for missing in self.TIER:
+            bad = {**self.BASE, **self.TIER}
+            del bad[missing]
+            with pytest.raises(schema.SchemaError, match=missing):
+                schema.validate_serve_load_payload(bad)
+
+    def test_non_numeric_tier_field_is_rejected(self):
+        bad = {**self.BASE, **self.TIER, "handoffs": "many"}
+        with pytest.raises(schema.SchemaError, match="handoffs"):
+            schema.validate_serve_load_payload(bad)
+
+    def test_bool_is_not_a_measurement(self):
+        bad = {**self.BASE, **self.TIER, "decode_workers": True}
+        with pytest.raises(schema.SchemaError, match="decode_workers"):
+            schema.validate_serve_load_payload(bad)
+
+
+# ---------------------------------------------------------------------------
+# loadgen ratio parsing
+# ---------------------------------------------------------------------------
+
+class TestRatioParsing:
+    def test_parses_points(self):
+        from tools.loadgen import parse_ratios
+        assert parse_ratios("3:1,2:2,1:3") == [(3, 1), (2, 2), (1, 3)]
+        assert parse_ratios(" 4:2 ") == [(4, 2)]
+
+    def test_rejects_malformed(self):
+        from tools.loadgen import parse_ratios
+        with pytest.raises(ValueError, match="N:M"):
+            parse_ratios("3-1")
+        with pytest.raises(ValueError, match=">= 1"):
+            parse_ratios("0:2")
+        with pytest.raises(ValueError, match="N:M"):
+            parse_ratios("")
+
+
+# ---------------------------------------------------------------------------
+# the independent-scaling proof over COMMITTED records
+# ---------------------------------------------------------------------------
+
+def _sweep_groups(store_path):
+    groups = {}
+    for e in obs_record.RunRecord(store_path).entries():
+        if e["kind"] != "serve_load":
+            continue
+        p = e.get("payload", {})
+        if "prefill_workers" in p and p.get("sweep_id"):
+            groups.setdefault(p["sweep_id"], []).append(p)
+    return {k: v for k, v in groups.items() if len(v) >= 2}
+
+
+def _assert_opposite_directions(points):
+    """Endpoint assertion over one sweep (ordered by decode share):
+    the two SLO metrics moved with OPPOSITE signs — shifting the
+    prefill:decode ratio is a genuine lever with phase-specific
+    effect, not a knob that moves everything together."""
+    pts = sorted(points,
+                 key=lambda p: p["decode_workers"] / p["prefill_workers"])
+    d_ttft = pts[-1]["ttft_p99_ms"] - pts[0]["ttft_p99_ms"]
+    d_tok = pts[-1]["tokens_per_s"] - pts[0]["tokens_per_s"]
+    assert d_ttft != 0 and d_tok != 0, pts
+    assert (d_ttft > 0) != (d_tok > 0), (
+        f"ttft_p99 moved {d_ttft:+.3f} ms and tokens_per_s "
+        f"{d_tok:+.1f} in the SAME direction across the ratio sweep")
+    return d_ttft, d_tok
+
+
+class TestIndependentScaling:
+    def test_committed_sweep_moves_slos_in_opposite_directions(self):
+        """ISSUE-12 acceptance: the committed runs/records.jsonl
+        ratio-sweep entries show that under the SAME Poisson load,
+        moving the prefill:decode worker ratio moves TTFT p99 and
+        tokens/s in opposite directions — for the committed
+        generation-heavy overload mix, every decode worker added (at a
+        prefill worker's expense) buys BOTH lower admission latency
+        (handoff backpressure stops parking finished prefills) and
+        higher delivered tokens/s, while prefill-heavy tiers spend
+        workers on the phase that is not the bottleneck.  Every
+        committed sweep group must satisfy the endpoint contract."""
+        groups = _sweep_groups(os.path.join(REPO, "runs",
+                                            "records.jsonl"))
+        assert groups, ("no committed ratio-sweep serve_load records "
+                        "(tools/loadgen.py --ratio-sweep)")
+        for sweep_id, pts in groups.items():
+            d_ttft, d_tok = _assert_opposite_directions(pts)
+            # the committed regime: decode share lowers TTFT p99
+            assert d_ttft < 0 < d_tok, (sweep_id, d_ttft, d_tok)
+
+    def test_committed_sweep_points_share_workload_and_lint(self):
+        groups = _sweep_groups(os.path.join(REPO, "runs",
+                                            "records.jsonl"))
+        for pts in groups.values():
+            # same offered load at every point, full tier quartet
+            assert len({p["requests"] for p in pts}) == 1
+            for p in pts:
+                schema.validate_serve_load_payload(p)
+                assert p["handoffs"] > 0
+                assert p["prefill_workers"] >= 1
+                assert p["decode_workers"] >= 1
+
+
+@pytest.mark.slow
+class TestLiveRatioSweep:
+    def test_live_sweep_reproduces_the_directions(self):
+        """The committed regime, re-run end to end (slow lane): a
+        3-point sweep over one shared compiled program set; TTFT p99
+        must collapse with decode share (the structural effect, ~13x
+        in the committed records — asserted at 3x for host noise) and
+        tokens/s must not move against it."""
+        from tools import loadgen
+        from singa_tpu.serve import ServeEngine
+
+        m = loadgen._build_model()
+        args = SimpleNamespace(num_slots=2, max_len=64, block_size=8,
+                               num_blocks=None, no_share=False,
+                               tenant_quota=None)
+        template = ServeEngine(m, 2, 64, block_size=8)
+        warm = loadgen._build_tier(m, 1, 1, args, None,
+                                   template=template)
+        warm.submit(loadgen.build_workload(
+            1, 1.0, 9, vocab=m.cfg.vocab_size)[0].prompt,
+            max_new_tokens=2)
+        warm.run_until_idle()
+        out = []
+        for n, md in ((3, 1), (1, 3)):
+            tier = loadgen._build_tier(m, n, md, args, None,
+                                       template=template)
+            wl = loadgen.build_workload(120, 120.0, 0,
+                                        new_tokens=(12, 16),
+                                        vocab=m.cfg.vocab_size)
+            out.append(loadgen.run_load(tier, wl, deadline_s=10.0))
+        heavy_prefill, heavy_decode = out
+        assert heavy_prefill["ttft_p99_ms"] > \
+            3 * heavy_decode["ttft_p99_ms"]
+        assert heavy_decode["tokens_per_s"] >= \
+            0.9 * heavy_prefill["tokens_per_s"]
+        for p in out:
+            schema.validate_serve_load_payload(p)

@@ -30,7 +30,8 @@ from singa_tpu.faults import FaultPlan, FaultSpec, InjectedFault
 from singa_tpu.obs import events
 from singa_tpu.obs import record as obs_record
 from singa_tpu.obs import schema
-from singa_tpu.serve import EngineClosed, ServeEngine
+from singa_tpu.serve import (EngineClosed, QuotaExceeded, Router,
+                             ServeEngine, SLOClass, build_pools)
 from singa_tpu.utils.data import DataLoader
 from tools.lint.hlo import assert_program_count
 
@@ -1004,3 +1005,214 @@ class TestHangRecoverySlow:
             eng.run_until_idle()
         assert fired and fired[0] >= 0.15
         assert h.done            # the sleep returned; decode completed
+
+
+# ---------------------------------------------------------------------------
+# disaggregated tier chaos (ISSUE 12) — same ONE compiled llama engine:
+# every worker below shares the module fixture's programs, so the whole
+# tier suite adds zero model-program compiles to tier-1 (the handoff
+# gather is the sanctioned third program, compiled once on first use)
+# ---------------------------------------------------------------------------
+
+class TestDisaggChaos:
+    def _tier(self, llama, engine, n, m, **kw):
+        pw, dw = build_pools(llama, n, m, template=engine,
+                             num_slots=3, max_len=24, block_size=8,
+                             backoff_base=0.001, backoff_max=0.01)
+        return Router(pw, dw, **kw), pw, dw
+
+    def test_tier_streams_bitwise_identical_zero_new_compiles(
+            self, llama, engine, baseline):
+        """THE disagg acceptance anchor: greedy streams through a 2:1
+        tier are token-identical to the single-engine run (which is
+        itself identical to generate()), every worker's jit caches stay
+        at the asserted program counts, and the template engine never
+        recompiled — the tier rode the ONE compiled program set."""
+        tier, pw, dw = self._tier(llama, engine, 2, 1)
+        hs = [tier.submit(p, max_new_tokens=6)
+              for p in _prompts([4, 6, 8])]
+        tier.run_until_idle()
+        assert [h.tokens for h in hs] == baseline
+        assert tier.pending == 0
+        assert_program_count(engine, (1, 1))
+        for w in pw + dw:
+            assert_program_count(w.engine, (1, 1))
+            assert w.engine.handoff_compiled_count() <= 1
+        assert tier.metrics.handoffs == 3
+        # every request's first token landed on a PREFILL worker and
+        # its remaining tokens on a DECODE worker
+        snap = tier.metrics.snapshot()
+        assert snap["admitted"] == 3
+        assert sum(len(h.tokens) for h in hs) == 18
+
+    def test_handoff_fault_reroutes_and_streams_stay_identical(
+            self, llama, engine, baseline, tmp_path):
+        """Acceptance: injected `serve.handoff` worker death mid-handoff
+        — the router re-routes, the request re-prefills from prompt,
+        and ALL streams (including the re-routed one) are bitwise
+        identical to the fault-free run; the reroute lands as a linted
+        incident record whose flight_ref dump parses."""
+        store = str(tmp_path / "runs" / "records.jsonl")
+        tier, pw, dw = self._tier(llama, engine, 1, 1,
+                                  record_store=store)
+        plan = FaultPlan([FaultSpec("serve.handoff", "error", at=2)])
+        with faults.active(plan):
+            hs = [tier.submit(p, max_new_tokens=6)
+                  for p in _prompts([4, 6, 8])]
+            with pytest.warns(UserWarning, match="re-routing"):
+                tier.run_until_idle()
+        assert [h.tokens for h in hs] == baseline
+        assert plan.fire_count() == 1
+        assert tier.metrics.reroutes == 1
+        for w in pw + dw:
+            assert_program_count(w.engine, (1, 1))
+        (inc,) = [e for e in obs_record.RunRecord(store).entries()
+                  if e["payload"].get("outcome") == "rerouted"]
+        assert inc["payload"]["site"] == "serve.handoff"
+        ref = inc["payload"]["flight_ref"]
+        from tools import obsq
+        dump = obsq.load_events(os.path.join(os.path.dirname(store),
+                                             ref))
+        assert dump                      # the source worker's timeline
+        from tools.lint import audit
+        assert audit.check_records_root(str(tmp_path)) == []
+
+    def test_killed_decode_worker_rerouted_bitwise(self, llama, engine,
+                                                   baseline, tmp_path):
+        """Acceptance: a decode worker killed MID-STREAM (its slots
+        hold live requests) — the router re-prefills them from prompt +
+        tokens-so-far on the prefill pool, final streams are bitwise
+        identical, and the death's incident dump carries the dead
+        worker's flight ring with a valid flight_ref."""
+        store = str(tmp_path / "runs" / "records.jsonl")
+        tier, pw, dw = self._tier(llama, engine, 1, 2,
+                                  record_store=store)
+        hs = [tier.submit(p, max_new_tokens=6)
+              for p in _prompts([4, 6, 8])]
+        # a few rounds: prefills hand off and decode begins
+        for _ in range(3):
+            tier.step()
+        victim = next(w for w in dw if w.engine.running_items())
+        with pytest.warns(UserWarning, match="died"):
+            tier.kill_worker(victim.name)
+        assert not victim.alive
+        tier.run_until_idle()
+        assert [h.tokens for h in hs] == baseline
+        assert tier.metrics.worker_deaths == 1
+        (inc,) = [e for e in obs_record.RunRecord(store).entries()
+                  if e["payload"].get("fault") == "worker_death"]
+        assert inc["payload"]["site"] == "serve.router"
+        assert inc["payload"]["ref"] == victim.name
+        from tools import obsq
+        dump = obsq.load_events(os.path.join(
+            os.path.dirname(store), inc["payload"]["flight_ref"]))
+        assert any(e.get("name") == "serve.handoff_in" for e in dump)
+        from tools.lint import audit
+        assert audit.check_records_root(str(tmp_path)) == []
+
+    def test_killed_prefill_worker_requeues_to_survivor(
+            self, llama, engine, baseline):
+        """A dead PREFILL worker's queued + running requests re-route
+        to the surviving prefill worker; streams unchanged."""
+        tier, pw, dw = self._tier(llama, engine, 2, 1)
+        hs = [tier.submit(p, max_new_tokens=6)
+              for p in _prompts([4, 6, 8])]
+        # kill the prefill worker holding the most queue before any
+        # tick — everything it held must replay elsewhere
+        dead = max(pw, key=lambda w: w.load)
+        assert dead.load > 0
+        with pytest.warns(UserWarning, match="died"):
+            tier.kill_worker(dead.name)
+        tier.run_until_idle()
+        assert [h.tokens for h in hs] == baseline
+        survivor = next(w for w in pw if w.alive)
+        assert survivor.engine.metrics.admitted >= dead.load
+
+    def test_cross_worker_trace_renders_one_timeline(self, llama,
+                                                     engine, baseline,
+                                                     tmp_path):
+        """Acceptance: submit → route → prefill@worker → handoff →
+        decode deliveries → finish reconstructs from ONE trace id via
+        tools/obsq trace — the id the ROUTER assigned, carried across
+        both workers."""
+        from tools import obsq
+        path = str(tmp_path / "ev.jsonl")
+        tier, pw, dw = self._tier(llama, engine, 1, 1)
+        events.configure(path=path)
+        try:
+            h = tier.submit(_prompts([4])[0], max_new_tokens=6)
+            tier.run_until_idle()
+        finally:
+            events.configure()
+        assert h.trace_id.startswith(tier.run_id)
+        evs = obsq.load_events(path)
+        mine = [e for e in evs if e.get("trace") == h.trace_id]
+        names = [e["name"] for e in mine]
+        for required in ("serve.submitted", "serve.route",
+                         "serve.prefill", "serve.handoff",
+                         "serve.token", "serve.evicted"):
+            assert required in names, (required, names)
+        route = next(e for e in mine if e["name"] == "serve.route")
+        handoff = next(e for e in mine if e["name"] == "serve.handoff")
+        assert route["worker"] == pw[0].name
+        assert handoff["src"] == pw[0].name
+        assert handoff["dst"] == dw[0].name
+        # tokens after the handoff came from the decode worker; the
+        # rendered timeline is one trace, human-readable
+        rendered = obsq.render_trace(evs, h.trace_id)
+        assert "serve.handoff" in rendered and "tokens=6" in rendered
+
+    def test_tenant_quota_and_slo_classes(self, llama, engine):
+        """Per-tenant quotas reject at the tier door (QuotaExceeded is
+        a QueueFull — loadgen counts it as overload), SLO classes bind
+        deadlines, and unknown classes fail loudly."""
+        tier, pw, dw = self._tier(
+            llama, engine, 1, 1,
+            slo_classes={"interactive": SLOClass("interactive", 5.0),
+                         "batch": SLOClass("batch", None)},
+            tenant_quota=1)
+        h1 = tier.submit(_prompts([4])[0], max_new_tokens=2,
+                         tenant="acme", slo="interactive")
+        assert h1._req.deadline is not None
+        with pytest.raises(QuotaExceeded):
+            tier.submit(_prompts([4])[0], max_new_tokens=2,
+                        tenant="acme")
+        h2 = tier.submit(_prompts([4])[0], max_new_tokens=2,
+                         tenant="other", slo="batch")
+        assert h2._req.deadline is None
+        with pytest.raises(ValueError, match="unknown SLO class"):
+            tier.submit(_prompts([4])[0], max_new_tokens=2, slo="gold")
+        tier.run_until_idle()
+        assert h1.done and h2.done
+        # quota freed on completion
+        h3 = tier.submit(_prompts([4])[0], max_new_tokens=2,
+                         tenant="acme")
+        tier.run_until_idle()
+        assert h3.done
+        assert tier.metrics.quota_rejected == 1
+        snap = tier.metrics.snapshot()
+        assert snap["rejected"] == 1
+
+    def test_handoff_transfers_prefix_cache_keys(self, llama, engine):
+        """Refcounts and prefix-cache keys travel WITH the blocks: two
+        requests sharing a full prompt block hand off to the same
+        decode worker, and the second handoff maps the shared block
+        copy-free (the decode pool's prefix cache matched the chain
+        key the first handoff registered)."""
+        tier, pw, dw = self._tier(llama, engine, 1, 1)
+        shared = _prompts([8], seed=11)[0]      # exactly one full block
+        p1 = np.concatenate([shared, _prompts([3], seed=12)[0]])
+        p2 = np.concatenate([shared, _prompts([5], seed=13)[0]])
+        h1 = tier.submit(p1, max_new_tokens=3)
+        h2 = tier.submit(p2, max_new_tokens=3)
+        tier.run_until_idle()
+        ref1 = llama.generate(p1[None], max_new_tokens=3)[0, p1.size:]
+        ref2 = llama.generate(p2[None], max_new_tokens=3)[0, p2.size:]
+        np.testing.assert_array_equal(np.asarray(h1.tokens), ref1)
+        np.testing.assert_array_equal(np.asarray(h2.tokens), ref2)
+        # the decode worker saw the shared block twice but holds ONE
+        # keyed copy of it (chain keys transferred and matched)
+        dump = [e for e in dw[0].engine.flight.snapshot()
+                if e.get("name") == "serve.handoff_in"]
+        assert len(dump) == 2
+        assert sum(e["shared"] for e in dump) >= 1

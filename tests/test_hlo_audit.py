@@ -135,6 +135,9 @@ def test_summaries_encode_the_flagship_invariants(summaries):
         summaries["train_step_dp2"]["donated_outputs"]
     assert summaries["prefill_chunk"]["donated_outputs"] > 0
     assert summaries["decode"]["donated_outputs"] > 0
+    # the disagg handoff gather reads the arena without consuming it
+    assert summaries["handoff_gather"]["donated_outputs"] == 0
+    assert summaries["handoff_gather"]["collectives"]["total"] == 0
 
 
 def test_cost_summaries_encode_the_flagship_invariants(costs):
@@ -147,7 +150,13 @@ def test_cost_summaries_encode_the_flagship_invariants(costs):
     for name, s in costs.items():
         assert s["schema"] == cost.COST_SCHEMA
         assert s["program"] == name
-        assert s["flops"] > 0
+        # handoff_gather is the one legitimately flop-free program: a
+        # pure KV block gather (the disagg handoff source) moves bytes,
+        # not math — its whole cost story is HBM traffic
+        if name == "handoff_gather":
+            assert s["flops"] == 0
+        else:
+            assert s["flops"] > 0
         assert s["hbm_bytes"] > 0
         assert s["peak_bytes"] > 0
         assert s["intensity"] == pytest.approx(
@@ -155,6 +164,11 @@ def test_cost_summaries_encode_the_flagship_invariants(costs):
         assert s["roofline"] in ("memory-bound", "compute-bound")
         total_fusions = sum(s["fusion_classes"].values())
         assert total_fusions > 0
+    assert costs["handoff_gather"]["roofline"] == "memory-bound"
+    assert costs["handoff_gather"]["wire_bytes"] == 0
+    # the handoff gather must NOT donate: a failed handoff has to
+    # leave the source arena valid for the router to re-route
+    assert costs["handoff_gather"]["donated_bytes"] == 0
     assert costs["train_step"]["flops"] == \
         2 * costs["train_step_dp2"]["flops"]
     assert costs["train_step"]["wire_bytes"] == 0

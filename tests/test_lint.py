@@ -455,6 +455,26 @@ class TestRegistryRules:
         """, "SGL007")
         assert out == []
 
+    def test_disagg_sites_are_registered(self):
+        """ISSUE 12: the tier's handoff + routing seams are real
+        registry entries — plans/dumps naming them lint clean."""
+        out = lint("""
+            from singa_tpu import faults
+
+            faults.fire("serve.handoff", rid=1, src="p0", dst="d0")
+            faults.fire("serve.router", tenant="acme", slo="batch")
+        """, "SGL007")
+        assert out == []
+
+    def test_typoed_disagg_site_fires(self):
+        out = lint("""
+            from singa_tpu import faults
+
+            faults.fire("serve.handof", rid=1)
+        """, "SGL007")
+        assert codes_of(out) == ["SGL007"]
+        assert "serve.handof" in out[0].message
+
     def test_keyword_form_is_checked_too(self):
         out = lint("""
             from singa_tpu import faults

@@ -71,9 +71,13 @@ BASELINE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #: gradient sync, whose committed COST005 wire_bytes baseline proves
 #: (and permanently gates) the >=3x wire reduction vs train_step_dp2's
 #: f32 collectives; prefill_chunk / decode are the serve engine's
-#: exactly-two programs.
+#: exactly-two programs; handoff_gather is the engine's optional THIRD
+#: program — the disaggregated tier's KV handoff source (one slot's
+#: dense per-layer view through its block-table row; no donation by
+#: design, so a failed handoff leaves the source arena valid).
 FLAGSHIP_PROGRAMS = ("train_step", "train_step_dp2",
-                     "train_step_dp2_int8", "prefill_chunk", "decode")
+                     "train_step_dp2_int8", "prefill_chunk", "decode",
+                     "handoff_gather")
 
 #: summary format version — bump on incompatible metric changes; a
 #: baseline with another version fails the gate (HLO001) instead of
@@ -618,7 +622,8 @@ def lower_train_step(dp: bool = False, fused_loss: bool = True,
 
 def _lower_serve_programs() -> Dict[str, str]:
     """Optimized-HLO texts of the serve engine's exactly-two programs
-    (tiny Llama, 2 slots) via ``ServeEngine.lower_programs()``."""
+    plus the optional handoff gather (tiny Llama, 2 slots) via
+    ``ServeEngine.lower_programs()``."""
     _ensure_cpu_backend()
     import numpy as np
     from singa_tpu import models, tensor
@@ -656,9 +661,10 @@ def lower_flagship_texts(programs: Optional[Iterable[str]] = None
     if "train_step_dp2_int8" in wanted:
         texts["train_step_dp2_int8"] = lower_train_step(
             compression="int8_ring")
-    if "prefill_chunk" in wanted or "decode" in wanted:
+    serve_names = ("prefill_chunk", "decode", "handoff_gather")
+    if any(name in wanted for name in serve_names):
         serve = _lower_serve_programs()
-        for name in ("prefill_chunk", "decode"):
+        for name in serve_names:
             if name in wanted:
                 texts[name] = serve[name]
     return {name: texts[name] for name in wanted}

@@ -11,6 +11,13 @@ shed when the offered rate exceeds capacity.
     python -m tools.loadgen --rate 20 --requests 80 --deadline 10
     SINGA_FAULTS="serve.decode=error:every=40" python -m tools.loadgen ...
 
+    # disaggregated tier (ISSUE 12): N prefill + M decode workers
+    # behind the SLO-aware Router, and the independent-scaling sweep —
+    # one serve_load record per N:M point, same Poisson workload
+    python -m tools.loadgen --prefill-workers 3 --decode-workers 1
+    python -m tools.loadgen --ratio-sweep 3:1,2:2,1:3 --rate 40
+    python -m tools.loadgen --disagg-smoke     # CI: tier == engine
+
 The run drives ``ServeEngine.step()`` directly (arrivals are submitted
 the tick their timestamp passes; ``QueueFull`` rejections count as
 overload outcomes, not errors) and reports SLO percentiles from the
@@ -82,28 +89,50 @@ def build_workload(n_requests: int, rate_rps: float, seed: int, *,
 def run_load(engine, workload: List[_Arrival], *,
              deadline_s: Optional[float] = None,
              eos_id: Optional[int] = None,
-             max_wall_s: float = 300.0) -> dict:
+             max_wall_s: float = 300.0,
+             pass_tenant: bool = False) -> dict:
     """Drive ``engine`` through ``workload`` open-loop and return the
     ``serve_load`` payload (plus a ``detail`` sub-dict that is NOT part
     of the schema contract).  Never raises on overload outcomes —
     ``QueueFull`` is a counted result; only an engine CRASH (the thing
-    chaos runs assert cannot happen) propagates."""
+    chaos runs assert cannot happen) propagates.
+
+    ``engine`` may equally be a :class:`singa_tpu.serve.Router` (a
+    disaggregated tier — same submit/step/pending/metrics surface);
+    the payload then additionally carries the per-pool tier fields
+    (``engine.tier_stats()``, linted as schema
+    ``_SERVE_TIER_FIELDS``).  ``pass_tenant`` forwards each arrival's
+    tenant id to ``submit(tenant=...)`` so per-tenant quotas are
+    exercised (Router only — a plain engine has no tenant door).
+
+    An injected ``serve.router`` fault at the door is a counted
+    outcome like ``QueueFull`` (``detail.router_faults``) — the chaos
+    contract is that only an engine CRASH aborts the harness, and the
+    routing site's documented behavior is 'surfaces to the submitter
+    like a routing outage'."""
+    from singa_tpu.faults import InjectedFault
     from singa_tpu.serve import QueueFull
 
     handles = []
+    router_faults = 0
     n = len(workload)
     i = 0
     t0 = time.monotonic()
     while True:
         now = time.monotonic() - t0
         while i < n and workload[i].at_s <= now:
+            kw = {"tenant": f"t{workload[i].tenant}"} \
+                if pass_tenant and workload[i].tenant >= 0 else {}
             try:
                 handles.append(engine.submit(
                     workload[i].prompt,
                     max_new_tokens=workload[i].max_new,
-                    deadline_s=deadline_s, eos_id=eos_id))
+                    deadline_s=deadline_s, eos_id=eos_id, **kw))
             except QueueFull:
                 handles.append(None)       # counted via metrics.rejected
+            except InjectedFault:
+                handles.append(None)       # a chaos-plan routing outage
+                router_faults += 1
             i += 1
         if engine.pending:
             engine.step()
@@ -143,7 +172,19 @@ def run_load(engine, workload: List[_Arrival], *,
         "retries": dict(snap["retries"]),
         "token_p50_ms": round((snap["token_ms"] or {}).get("p50", 0.0),
                               3),
+        "router_faults": router_faults,
     }
+    tier = getattr(engine, "tier_stats", None)
+    if tier is not None:
+        # a disaggregated Router: the per-pool quartet joins the
+        # headline (schema both-or-neither contract) and the tier-only
+        # diagnostics stay in detail
+        payload.update(tier())
+        payload["detail"]["reroutes"] = int(snap.get("reroutes", 0))
+        payload["detail"]["worker_deaths"] = int(
+            snap.get("worker_deaths", 0))
+        payload["detail"]["handoff_p50_ms"] = round(
+            (snap.get("handoff_ms") or {}).get("p50", 0.0), 3)
     return payload
 
 
@@ -171,10 +212,101 @@ def append_record(payload: dict, store: Optional[str] = None) -> str:
     return store
 
 
+def _build_model():
+    from singa_tpu import models, tensor
+    tensor.set_seed(0)
+    m = models.Llama(models.LlamaConfig.tiny())
+    m.eval()
+    m.compile([tensor.from_numpy(np.zeros((1, 4), np.int32))],
+              is_train=False, use_graph=False)
+    return m
+
+
+def _build_tier(model, n_prefill: int, n_decode: int, args, store,
+                template=None):
+    """A Router over N + M same-config workers (sharing ``template``'s
+    compiled programs when given, so a ratio sweep compiles once)."""
+    from singa_tpu.serve import Router, build_pools
+
+    pw, dw = build_pools(model, n_prefill, n_decode, template=template,
+                         num_slots=args.num_slots, max_len=args.max_len,
+                         block_size=args.block_size,
+                         num_blocks=args.num_blocks,
+                         share_prefix=not args.no_share,
+                         backoff_base=0.005, backoff_max=0.05,
+                         max_recoveries=100, record_store=store)
+    return Router(pw, dw, tenant_quota=args.tenant_quota,
+                  record_store=store)
+
+
+def parse_ratios(spec: str) -> List[tuple]:
+    """``"3:1,2:2,1:3"`` -> [(3, 1), (2, 2), (1, 3)] — the N:M
+    prefill:decode points a ratio sweep runs (each must have >= 1
+    worker per pool)."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        try:
+            n, m = part.split(":")
+            n, m = int(n), int(m)
+        except ValueError:
+            raise ValueError(
+                f"--ratio-sweep: expected N:M points like '3:1,1:3', "
+                f"got {part!r}")
+        if n < 1 or m < 1:
+            raise ValueError(f"--ratio-sweep: each pool needs >= 1 "
+                             f"worker, got {part!r}")
+        out.append((n, m))
+    if not out:
+        raise ValueError("--ratio-sweep: no points")
+    return out
+
+
+def disagg_smoke() -> int:
+    """The CI gate's disagg stage: a tiny 1:1 tier serves 8 requests
+    with greedy streams asserted IDENTICAL to a single-engine
+    ServeEngine run (and the first one to ``generate()``) — the
+    handoff path's end-to-end correctness as one cheap command
+    (``python -m tools.loadgen --disagg-smoke``)."""
+    from singa_tpu.serve import Router, ServeEngine, build_pools
+
+    m = _build_model()
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(0, m.cfg.vocab_size, (int(n),)).astype(np.int32)
+               for n in (4, 6, 9, 12, 5, 7, 10, 8)]
+    eng = ServeEngine(m, num_slots=4, max_len=32, block_size=8)
+    ref = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.run_until_idle()
+    ref_toks = [h.tokens for h in ref]
+    gen = m.generate(prompts[0][None], max_new_tokens=6)[0,
+                                                         prompts[0].size:]
+    if list(map(int, gen)) != ref_toks[0]:
+        print("disagg-smoke: FAIL — single engine drifted from "
+              "generate()", file=sys.stderr)
+        return 1
+    pw, dw = build_pools(m, 1, 1, template=eng, num_slots=4, max_len=32,
+                         block_size=8)
+    tier = Router(pw, dw)
+    got = [tier.submit(p, max_new_tokens=6) for p in prompts]
+    tier.run_until_idle()
+    got_toks = [h.tokens for h in got]
+    if got_toks != ref_toks:
+        for i, (a, b) in enumerate(zip(ref_toks, got_toks)):
+            if a != b:
+                print(f"disagg-smoke: FAIL — request {i} diverged: "
+                      f"engine={a} tier={b}", file=sys.stderr)
+        return 1
+    handoffs = tier.metrics.handoffs
+    print(f"disagg-smoke: OK — {len(prompts)} streams identical "
+          f"through a 1:1 tier ({handoffs} handoffs)")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="open-loop Poisson traffic through the paged "
-                    "serving engine (SLO readout + serve_load record)")
+                    "serving engine or a disaggregated prefill/decode "
+                    "tier (SLO readout + serve_load record)")
     ap.add_argument("--requests", type=int, default=60)
     ap.add_argument("--rate", type=float, default=20.0,
                     help="offered arrivals/s (push past capacity to "
@@ -188,6 +320,10 @@ def main(argv=None) -> int:
     ap.add_argument("--deadline", type=float, default=30.0,
                     help="per-request SLO deadline (s); drives "
                          "shedding under overload")
+    ap.add_argument("--new-tokens", default="4,8,16",
+                    help="comma-separated generation-budget mix drawn "
+                         "per request (generation-heavy mixes sharpen "
+                         "the decode-side of a ratio sweep)")
     ap.add_argument("--num-slots", type=int, default=8)
     ap.add_argument("--max-len", type=int, default=64)
     ap.add_argument("--block-size", type=int, default=8)
@@ -198,9 +334,28 @@ def main(argv=None) -> int:
                     help="run-record store path (default: "
                          "runs/records.jsonl)")
     ap.add_argument("--no-record", action="store_true")
+    ap.add_argument("--prefill-workers", type=int, default=0,
+                    help="disaggregated tier: prefill pool size "
+                         "(with --decode-workers; 0 = single engine)")
+    ap.add_argument("--decode-workers", type=int, default=0,
+                    help="disaggregated tier: decode pool size")
+    ap.add_argument("--tenant-quota", type=int, default=None,
+                    help="per-tenant in-flight quota at the tier door "
+                         "(Router only)")
+    ap.add_argument("--ratio-sweep", default=None, metavar="N:M,...",
+                    help="run the SAME workload through each "
+                         "prefill:decode ratio (e.g. '3:1,2:2,1:3'), "
+                         "emitting one serve_load record per point — "
+                         "the independent-scaling measurement")
+    ap.add_argument("--disagg-smoke", action="store_true",
+                    help="CI smoke: 1:1 tier streams asserted "
+                         "identical to a single engine (8 requests); "
+                         "exits non-zero on divergence")
     args = ap.parse_args(argv)
 
-    from singa_tpu import models, tensor
+    if args.disagg_smoke:
+        return disagg_smoke()
+
     from singa_tpu.obs import record as obs_record
     from singa_tpu.serve import ServeEngine
 
@@ -211,26 +366,82 @@ def main(argv=None) -> int:
     store = (None if args.no_record else
              args.store or os.path.join(_REPO, obs_record.DEFAULT_STORE))
 
-    tensor.set_seed(0)
-    m = models.Llama(models.LlamaConfig.tiny())
-    m.eval()
-    m.compile([tensor.from_numpy(np.zeros((1, 4), np.int32))],
-              is_train=False, use_graph=False)
-    eng = ServeEngine(m, args.num_slots, args.max_len,
-                      block_size=args.block_size,
-                      num_blocks=args.num_blocks,
-                      share_prefix=not args.no_share,
-                      backoff_base=0.005, backoff_max=0.05,
-                      # a chaos soak may recover many times; the
-                      # engine-default budget of 2 is tuned for unit
-                      # scenarios, not sustained injection
-                      max_recoveries=100,
-                      record_store=store)
+    m = _build_model()
+    new_tokens = tuple(int(t) for t in args.new_tokens.split(",")
+                       if t.strip())
+
+    if args.ratio_sweep:
+        points = parse_ratios(args.ratio_sweep)
+        # every point's tier shares ONE template engine's compiled
+        # programs, so the sweep pays one compile no matter how many
+        # ratios it visits — and a shared sweep_id groups the points
+        # for the direction assertion in tests/test_disagg.py
+        template = ServeEngine(m, args.num_slots, args.max_len,
+                               block_size=args.block_size,
+                               num_blocks=args.num_blocks,
+                               share_prefix=not args.no_share)
+        # warm every program (incl. the lazily-compiled handoff
+        # gather) through a throwaway 1:1 tier, so the first sweep
+        # point does not pay a mid-run compile the others skip
+        warm = _build_tier(m, 1, 1, args, None, template=template)
+        warm.submit(build_workload(1, 1.0, args.seed + 1,
+                                   vocab=m.cfg.vocab_size)[0].prompt,
+                    max_new_tokens=2)
+        warm.run_until_idle()
+        sweep_id = obs_record.new_run_id("sweep")
+        rows = []
+        for i, (n, mdec) in enumerate(points):
+            tier = _build_tier(m, n, mdec, args, store,
+                               template=template)
+            wl = build_workload(args.requests, args.rate, args.seed,
+                                new_tokens=new_tokens,
+                                tenants=args.tenants,
+                                shared_len=args.shared_prefix,
+                                vocab=m.cfg.vocab_size)
+            payload = run_load(tier, wl, deadline_s=args.deadline,
+                               pass_tenant=args.tenant_quota is not None)
+            payload["sweep_id"] = sweep_id
+            payload["sweep_seq"] = i
+            rows.append((n, mdec, payload))
+            print(f"# ratio {n}:{mdec}  ttft_p99={payload['ttft_p99_ms']}"
+                  f" ms  tokens/s={payload['tokens_per_s']}  "
+                  f"handoffs={payload['handoffs']}", file=sys.stderr)
+            print(json.dumps(payload, indent=2))
+            if store is not None:
+                append_record(payload, store)
+        if store is not None:
+            print(f"# {len(rows)} serve_load entries (sweep {sweep_id}) "
+                  f"appended to {store}", file=sys.stderr)
+        return 0
+
+    if args.prefill_workers or args.decode_workers:
+        if args.prefill_workers < 1 or args.decode_workers < 1:
+            ap.error("a tier needs --prefill-workers >= 1 AND "
+                     "--decode-workers >= 1")
+        eng = _build_tier(m, args.prefill_workers, args.decode_workers,
+                          args, store)
+    else:
+        if args.tenant_quota is not None:
+            ap.error("--tenant-quota needs a tier "
+                     "(--prefill-workers/--decode-workers) — a plain "
+                     "engine has no tenant door")
+        eng = ServeEngine(m, args.num_slots, args.max_len,
+                          block_size=args.block_size,
+                          num_blocks=args.num_blocks,
+                          share_prefix=not args.no_share,
+                          backoff_base=0.005, backoff_max=0.05,
+                          # a chaos soak may recover many times; the
+                          # engine-default budget of 2 is tuned for unit
+                          # scenarios, not sustained injection
+                          max_recoveries=100,
+                          record_store=store)
     wl = build_workload(args.requests, args.rate, args.seed,
+                        new_tokens=new_tokens,
                         tenants=args.tenants,
                         shared_len=args.shared_prefix,
                         vocab=m.cfg.vocab_size)
-    payload = run_load(eng, wl, deadline_s=args.deadline)
+    payload = run_load(eng, wl, deadline_s=args.deadline,
+                       pass_tenant=args.tenant_quota is not None)
     print(json.dumps(payload, indent=2))
     if store is not None:
         append_record(payload, store)
