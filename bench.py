@@ -497,6 +497,33 @@ def bench_serve(dev, on_tpu: bool) -> None:
     n_tok = sum(len(h.tokens) for h in handles)
     ttft = eng.metrics.snapshot()["ttft_ms"] or {}
 
+    # ---- speculative decoding (ISSUE 13): spec-vs-plain on the SAME
+    # stream.  Self-speculation ablation (draft == target): the accept
+    # rate is 1.0 by construction, so the measurement isolates what
+    # verify-k dispatch packing buys at THIS concurrency — at full
+    # occupancy the draft costs as much as the target and the ratio
+    # hovers near (k+1)/(2k+1); the committed loadgen spec-compare pair
+    # measures the low-concurrency regime where speculation wins
+    # end-to-end.  Streams are asserted token-identical either way.
+    spec_k = 3
+    # one extra block of arena headroom: submit() requires prompt +
+    # budget + spec_k under max_len (the last verify window's writes)
+    seng = ServeEngine(m, num_slots, max_len + block_size,
+                       block_size=block_size, draft_model=m,
+                       spec_k=spec_k)
+    seng.submit(prompts[0], max_new_tokens=n_new)
+    seng.run_until_idle()
+    seng.metrics = ServeMetrics()
+    t0 = time.perf_counter()
+    spec_handles = [seng.submit(p, max_new_tokens=n_new)
+                    for p in prompts]
+    seng.run_until_idle()
+    t_spec = time.perf_counter() - t0
+    mismatched += sum(
+        not np.array_equal(ref, np.asarray(h.tokens))
+        for ref, h in zip(refs, spec_handles))
+    sm = seng.metrics.snapshot()
+
     # ---- paged-arena wins (ISSUE 6) -----------------------------------
     # (a) equal-memory concurrency: the same physical block budget a
     #     fixed (num_slots, max_len) arena burns, but 4x the table
@@ -558,9 +585,18 @@ def bench_serve(dev, on_tpu: bool) -> None:
         "ttft_shared_prefix_p50_ms": round(shared_stats[True][0], 3),
         "ttft_private_prefix_p50_ms": round(shared_stats[False][0], 3),
         "prefix_hit_tokens": int(shared_stats[True][1]),
+        # speculative decoding (ISSUE 13): the schema-linted pair
+        # (both-or-neither) plus the spec side's wall-clock result at
+        # this bench's full-occupancy regime
+        "accept_rate": round(sm["accept_rate"] or 0.0, 4),
+        "tokens_per_dispatch": round(sm["tokens_per_dispatch"] or 0.0,
+                                     3),
+        "spec_tokens_per_s": round(n_tok / t_spec, 1),
+        "spec_speedup_vs_plain_engine": round(t_eng / t_spec, 3),
     }
     detail = dict(payload)
     detail.update({
+        "spec_k": spec_k,
         "device": getattr(dev, "device_kind", "") or dev.platform,
         "num_slots": num_slots, "max_len": max_len,
         "block_size": block_size, "pool_blocks": pool_blocks,

@@ -68,6 +68,16 @@ SITES: Dict[str, Tuple[str, Tuple[str, ...]]] = {
         "escalates to an arena rebuild that reconstructs block tables "
         "and refcounts",
         ("error", "hang")),
+    "serve.verify": (
+        "speculative verify-round dispatch (draft propose-k + target "
+        "verify-k over the paged arena, serve/spec.py); fires BEFORE "
+        "the jitted call, so the donated arenas survive — an injected "
+        "error past the retry budget makes THAT tick fall back to "
+        "plain decode instead of wedging the slot or rebuilding the "
+        "arena: the accepted stream is unchanged (plain decode is the "
+        "same target argmax), only the draft cache takes a gap that "
+        "can lower later accept rates",
+        ("error", "hang")),
     "serve.handoff": (
         "disaggregated-tier KV block handoff (the Router moving a "
         "finished prefill's blocks from a prefill worker to a decode "

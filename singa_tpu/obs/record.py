@@ -26,6 +26,7 @@ the round-5 on-chip evidence:
 from __future__ import annotations
 
 import contextlib
+import itertools
 import json
 import os
 import time
@@ -40,9 +41,20 @@ __all__ = ["RunRecord", "new_entry", "new_run_id", "is_onchip_session_doc",
 DEFAULT_STORE = os.path.join("runs", "records.jsonl")
 
 
+#: per-process uniquifier: wallclock has SECOND resolution, so two ids
+#: minted by the same process in the same second (a loadgen sweep whose
+#: points finish in under a second, the --spec-compare pair) would
+#: collide — and the store treats an equal (run_id, platform, smoke)
+#: key as self-supersede, silently replacing the earlier entry
+_RUN_SEQ = itertools.count()
+
+
 def new_run_id(prefix: str = "run") -> str:
-    """Collision-resistant-enough id: wallclock + pid."""
-    return f"{prefix}-{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}"
+    """Collision-resistant id: wallclock + pid + per-process sequence
+    (the sequence is what makes two same-second ids from one process
+    distinct — see ``_RUN_SEQ``)."""
+    return (f"{prefix}-{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}"
+            f"-{next(_RUN_SEQ)}")
 
 
 def new_entry(kind: str, platform: str, smoke: bool, device: str,

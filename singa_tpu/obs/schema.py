@@ -40,7 +40,8 @@ __all__ = ["SCHEMA_VERSION", "SchemaError", "require", "validate_entry",
            "validate_serve_load_payload", "validate_train_run_payload",
            "validate_incident_payload", "validate_hlo_audit_payload",
            "validate_wire_byte_fields", "validate_flight_ref",
-           "validate_serve_tier_fields", "entry_key"]
+           "validate_serve_tier_fields", "validate_spec_fields",
+           "entry_key"]
 
 #: bump when entry fields change incompatibly; validators dispatch on it
 SCHEMA_VERSION = 1
@@ -72,6 +73,16 @@ _SERVE_LOAD_FIELDS = ("requests", "completed", "shed", "rejected",
 #: not support the independent-scaling claim the sweep exists to make)
 _SERVE_TIER_FIELDS = ("prefill_workers", "decode_workers", "handoffs",
                       "handoff_p99_ms")
+
+#: the speculative-decoding pair (ServeEngine(draft_model=, spec_k=) /
+#: tools/loadgen.py --spec-k / bench.py --serve): the draft accept rate
+#: and the delivered tokens per per-slot program dispatch (1.0 for a
+#: plain engine by definition).  OPTIONAL on serve_load AND
+#: serve_throughput payloads — but a record carrying EITHER must carry
+#: BOTH, numeric (an accept rate with no dispatch-density evidence, or
+#: vice versa, cannot support the tokens-per-dispatch claim
+#: speculation exists to make)
+_SPEC_FIELDS = ("accept_rate", "tokens_per_dispatch")
 
 #: required numeric payload fields of a train_run entry — what the
 #: training orchestrator (singa_tpu.train.TrainRunner) commits for
@@ -240,8 +251,10 @@ def validate_serve_payload(payload: Any, ctx: str = "serve payload") -> None:
     """The serving bench's headline quantities: every field in
     ``_SERVE_FIELDS`` present and numeric (a serving record with a
     missing TTFT percentile is the r5 silent-truncation failure mode
-    wearing a new hat)."""
+    wearing a new hat).  The optional speculative-decoding pair
+    (``_SPEC_FIELDS``) is linted whenever either appears."""
     _require_numeric_fields(payload, _SERVE_FIELDS, ctx)
+    validate_spec_fields(payload, ctx)
 
 
 def validate_serve_load_payload(payload: Any,
@@ -250,10 +263,24 @@ def validate_serve_load_payload(payload: Any,
     ``_SERVE_LOAD_FIELDS`` present and numeric — an overload run whose
     shed/rejected counts went missing would let 'survived the chaos
     run' masquerade as 'served every request'.  The optional
-    disaggregated-tier pool fields (``_SERVE_TIER_FIELDS``) are linted
+    disaggregated-tier pool fields (``_SERVE_TIER_FIELDS``) and the
+    optional speculative-decoding pair (``_SPEC_FIELDS``) are linted
     whenever any of them appear."""
     _require_numeric_fields(payload, _SERVE_LOAD_FIELDS, ctx)
     validate_serve_tier_fields(payload, ctx)
+    validate_spec_fields(payload, ctx)
+
+
+def validate_spec_fields(payload: Any, ctx: str = "payload") -> None:
+    """The optional speculative-decoding pair: a payload carrying
+    EITHER of ``_SPEC_FIELDS`` must carry both, numeric — an accept
+    rate without its tokens-per-dispatch consequence (or vice versa)
+    cannot support the dispatch-density claim speculation exists to
+    make (see docs/serving.md, "Speculative decoding")."""
+    if not isinstance(payload, dict):
+        return
+    if any(f in payload for f in _SPEC_FIELDS):
+        _require_numeric_fields(payload, _SPEC_FIELDS, ctx)
 
 
 def validate_serve_tier_fields(payload: Any, ctx: str = "payload") -> None:

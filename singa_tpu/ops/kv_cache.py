@@ -20,7 +20,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["init_cache", "update_cache", "cached_sdpa",
-           "gather_block_kv", "scatter_block_kv", "scatter_token_kv"]
+           "gather_block_kv", "scatter_block_kv", "scatter_token_kv",
+           "scatter_tokens_kv"]
 
 
 def init_cache(num_layers: int, batch: int, max_len: int, num_kv_heads: int,
@@ -93,6 +94,23 @@ def scatter_token_kv(ck, cv, block, offset, k_tok, v_tok):
     because the null block is never inside any row's validity window."""
     return (ck.at[block, offset].set(k_tok.astype(ck.dtype)),
             cv.at[block, offset].set(v_tok.astype(cv.dtype)))
+
+
+def scatter_tokens_kv(ck, cv, blocks, offsets, k_toks, v_toks):
+    """Write a per-row WINDOW of positions into the paged arena.
+
+    ``blocks``/``offsets``: (B, T) int32 — row b's window token t lands
+    at ``[blocks[b, t], offsets[b, t]]``.  ``k_toks``/``v_toks``:
+    (B, T, K, D).  The speculative verify-k counterpart of
+    :func:`scatter_token_kv`: one verify dispatch writes k+1 positions
+    per slot (the pending token plus the k proposals), and rejected
+    positions are rolled back by TRUNCATING the slot's ``pos``/attention
+    ``limit`` — the stale entries past the new limit are unreachable,
+    exactly like any stale block content.  Rows sharing a target
+    (inactive slots redirected to the null block for every window
+    position) resolve arbitrarily, which is safe for the same reason."""
+    return (ck.at[blocks, offsets].set(k_toks.astype(ck.dtype)),
+            cv.at[blocks, offsets].set(v_toks.astype(cv.dtype)))
 
 
 def cached_sdpa(q, ck, cv, limit, scale: float = None, mask=None,

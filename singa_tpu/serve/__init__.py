@@ -24,6 +24,13 @@ whole serving lifetime runs through exactly two compiled XLA programs.
 * :mod:`~singa_tpu.serve.metrics` — queue/slot gauges, admit/reject/
   evict counters, TTFT and per-token latency histograms through
   ``obs.events``.
+* :mod:`~singa_tpu.serve.spec` — speculative decoding (ISSUE 13):
+  draft-model propose-k / target-model verify-k as a third compiled
+  program over the same paged arena (the draft's KV blocks ride the
+  same block tables); accepted runs are the target's own greedy picks
+  (bitwise identical to ``generate()`` by construction), rejected
+  positions roll back by position/limit truncation, and an injected
+  ``serve.verify`` fault falls back to plain decode for that tick.
 * :mod:`~singa_tpu.serve.disagg` — disaggregated serving (ISSUE 12):
   separately scaled prefill/decode worker pools (engines sharing ONE
   set of compiled programs) behind an SLO-aware :class:`Router` with

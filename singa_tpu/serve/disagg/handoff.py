@@ -44,7 +44,7 @@ design (same subsystem package).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List
+from typing import List, Optional
 
 import jax.numpy as jnp
 
@@ -75,6 +75,12 @@ class HandoffPackage:
     prompt_keys: List[bytes] = field(default_factory=list)
     #: source worker name (events/debugging only)
     src: str = ""
+    #: speculative tier (ISSUE 13): the DRAFT model's dense per-layer
+    #: views for the same blocks (None when the source engine carries
+    #: no draft) — both arenas ride the same block tables, so the
+    #: handoff moves both or the destination's verify rounds would
+    #: start from a cold draft cache and accept nothing
+    draft_kv: Optional[list] = None
 
 
 def extract(engine, slot: int) -> HandoffPackage:
@@ -90,8 +96,18 @@ def extract(engine, slot: int) -> HandoffPackage:
     # activates at the replay length then delivers one token; every
     # decode tick advances both) — no device fetch needed
     pos = req.replay_ids().size - 1
-    dense = engine._handoff(pool.tables, jnp.asarray(slot, jnp.int32),
-                            pool.caches)
+    if pool.draft_caches is not None:
+        # speculative engine: ONE gather call over the combined
+        # per-layer list (target caches + draft caches — a pytree, so
+        # the handoff program still has exactly one jit-cache entry),
+        # split back host-side
+        both = engine._handoff(pool.tables, jnp.asarray(slot, jnp.int32),
+                               pool.caches + pool.draft_caches)
+        dense, draft_kv = both[:len(pool.caches)], both[len(pool.caches):]
+    else:
+        dense = engine._handoff(pool.tables, jnp.asarray(slot, jnp.int32),
+                                pool.caches)
+        draft_kv = None
     keys = engine._req_keys(req)[:req.prompt.size // pool.block_size]
     # point of no return: only after the gather succeeded
     engine._running.pop(slot)
@@ -100,7 +116,7 @@ def extract(engine, slot: int) -> HandoffPackage:
     engine.flight.note("counter", "serve.handoff_out", rid=req.rid,
                        blocks=n_blocks)
     return HandoffPackage(req=req, kv=dense, pos=pos, n_blocks=n_blocks,
-                          prompt_keys=keys)
+                          prompt_keys=keys, draft_kv=draft_kv)
 
 
 def _probe(engine, pkg: HandoffPackage):
@@ -153,6 +169,9 @@ def inject(engine, pkg: HandoffPackage) -> bool:
         # (ROADMAP item 3 note) if handoff copies ever show up in a
         # profile.
         caches = list(pool.caches)
+        dcaches = (list(pool.draft_caches)
+                   if pool.draft_caches is not None
+                   and pkg.draft_kv is not None else None)
         for i, wb in enumerate(owned):
             lo = (n_shared + i) * bs
             for li, (dk, dv) in enumerate(pkg.kv):
@@ -160,7 +179,17 @@ def inject(engine, pkg: HandoffPackage) -> bool:
                 caches[li] = kv_ops.scatter_block_kv(
                     ck, cv, jnp.asarray(wb, jnp.int32),
                     dk[0, lo:lo + bs], dv[0, lo:lo + bs])
+            if dcaches is not None:
+                # the draft arena maps the SAME physical block ids —
+                # one more fixed-shape write per (block, draft layer)
+                for li, (dk, dv) in enumerate(pkg.draft_kv):
+                    ck, cv = dcaches[li]
+                    dcaches[li] = kv_ops.scatter_block_kv(
+                        ck, cv, jnp.asarray(wb, jnp.int32),
+                        dk[0, lo:lo + bs], dv[0, lo:lo + bs])
         pool.caches = caches
+        if dcaches is not None:
+            pool.draft_caches = dcaches
         if engine.share_prefix and pkg.prompt_keys:
             pool.register_prefix(req.prompt, slot, len(pkg.prompt_keys),
                                  keys=pkg.prompt_keys)

@@ -173,8 +173,21 @@ class TierMetrics:
                     out[k] = out.get(k, 0) + v
             return out
 
+        spec_proposed = total("spec_proposed")
+        disp = sum(s["slot_dispatches"] for s in snaps)
+        disp_tokens = sum(s["slot_dispatch_tokens"] for s in snaps)
         return {
             "submitted": total("submitted"),
+            # speculative decoding (ISSUE 13): tier-wide accept/dispatch
+            # accounting — decode workers carry the draft, so the tier
+            # headline aggregates their verify rounds
+            "spec_rounds": total("spec_rounds"),
+            "spec_proposed": spec_proposed,
+            "spec_accepted": total("spec_accepted"),
+            "spec_fallbacks": total("spec_fallbacks"),
+            "accept_rate": (total("spec_accepted") / spec_proposed
+                            if spec_proposed else None),
+            "tokens_per_dispatch": (disp_tokens / disp if disp else None),
             "admitted": total("admitted"),
             # rejections are counted at the TIER door only: a worker's
             # own rejected counter ticks on every QueueFull the router

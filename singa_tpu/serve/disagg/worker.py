@@ -20,6 +20,13 @@ jitted callables never recompile): a whole tier costs exactly one
 engine's compiles, and the per-worker two-program invariant is
 literally the shared caches staying at one entry each (asserted in
 tests/test_faults.py).
+
+Speculative decoding (ISSUE 13): pass ``draft_model=``/``spec_k=``
+through ``engine_kwargs`` and the WHOLE tier carries the draft —
+prefill workers write both arenas (so a handoff package ships draft KV
+alongside target KV, see handoff.py) and decode workers run verify-k
+rounds.  ``SharedPrograms`` carries the verify executable, so a
+homogeneous speculative tier still costs one engine's compiles.
 """
 
 from __future__ import annotations

@@ -88,6 +88,20 @@ each quarantine/recovery dumps the ring to
 ``flight_ref`` points at it.  With no ``record_store`` and no sink the
 engine performs zero file writes.
 
+Speculative decoding (ISSUE 13): ``ServeEngine(draft_model=, spec_k=)``
+replaces the per-tick decode with a **verify-k round** — the THIRD
+gated program (serve/spec.py): the draft proposes k tokens per slot
+(its KV blocks ride the same block tables, a parallel pool in
+``BlockPool``), the target scores all k+1 window positions in one
+dispatch, the longest matching greedy prefix commits and rejected
+positions roll back by truncating the slot's position/limit.  The
+delivered tokens are the target's own picks, so speculative greedy
+streams are bitwise identical to ``generate()`` by construction; an
+injected/transient verify failure past retries falls back to a plain
+decode tick (site ``serve.verify``).  The fixed compiled set becomes
+(prefill, decode, verify, handoff), asserted via
+:meth:`spec_compiled_counts`.
+
 Disaggregated serving (ISSUE 12): the engine is also the worker unit
 of :mod:`singa_tpu.serve.disagg` — a prefill pool ticks with
 ``step(decode=False)`` and hands finished prefills to a decode pool
@@ -155,6 +169,14 @@ class SharedPrograms(NamedTuple):
     prefill: object
     decode: object
     handoff: object
+    #: speculative decoding (serve/spec.py): the draft model the verify
+    #: program's closures capture (None for a plain engine), the
+    #: trace-time k baked into that program, and the verify executable
+    #: itself.  Sharing requires the SAME draft object and equal k —
+    #: a tier mixes spec and plain engines only by NOT sharing programs.
+    draft_ref: object = None
+    spec_k: int = 0
+    verify: object = None
 
 
 class ServeEngine:
@@ -195,8 +217,22 @@ class ServeEngine:
                  record_store: Optional[str] = None,
                  run_id: Optional[str] = None,
                  programs: Optional[SharedPrograms] = None,
+                 draft_model=None, spec_k: int = 0,
                  _sleep: Callable[[float], None] = time.sleep):
         self.model = model
+        # speculative decoding (serve/spec.py): a draft model turns the
+        # per-tick decode into a verify-k round — k proposals + the
+        # pending token scored by ONE target dispatch
+        if (draft_model is None) != (spec_k == 0):
+            raise ValueError(
+                "speculative decoding needs BOTH draft_model and "
+                f"spec_k >= 1 (got draft_model="
+                f"{'set' if draft_model is not None else 'None'}, "
+                f"spec_k={spec_k})")
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        self.draft_model = draft_model
+        self.spec_k = int(spec_k)
         max_pos = getattr(getattr(model, "cfg", None), "max_position", None)
         if max_pos is not None and max_len > max_pos:
             raise ValueError(
@@ -233,6 +269,13 @@ class ServeEngine:
         self._recoveries = 0
         self._incident_seq = itertools.count()
         self._tick_ewma: Optional[float] = None   # measured step() wall s
+        # measured accepted-tokens-per-tick PER SLOT (EWMA): 1.0 for a
+        # plain engine by construction, up to spec_k + 1 under
+        # speculation — the shed eta divides by it so a spec engine
+        # (whose queued requests reach their first token sooner because
+        # slots drain faster) does not over-shed against a 1-token/tick
+        # assumption (scheduler.eta_first_token)
+        self._tpt_ewma: Optional[float] = None
         # admission-cadence hint from an external driver (the
         # disaggregated Router pushes its measured round time here):
         # the shed eta uses the slower of this and the engine's own
@@ -263,13 +306,32 @@ class ServeEngine:
                 spec = jax.eval_shape(lambda: model.init_caches(1, 2))
             arena_dtype = jax.tree.leaves(spec)[0].dtype
         self._params, self._buffers = params, buffers
+        # draft weights snapshotted the same way (param_dtype applies to
+        # the draft too — decode AND verify are weight-read bound)
+        if draft_model is not None:
+            dparams = {n: t.data for n, t in draft_model.get_params().items()}
+            if not dparams:
+                raise ValueError(
+                    "draft model has no initialized params — call "
+                    "draft.compile() (or run one forward) before "
+                    "building a speculative ServeEngine")
+            dbuffers = {n: t.data
+                        for n, t in draft_model._get_buffers().items()}
+            if param_dtype is not None:
+                dparams = {n: (a.astype(param_dtype)
+                               if jnp.issubdtype(a.dtype, jnp.floating)
+                               else a)
+                           for n, a in dparams.items()}
+            self._dparams, self._dbuffers = dparams, dbuffers
+        else:
+            self._dparams = self._dbuffers = None
         # arena construction args kept for recovery rebuilds
         self._num_slots, self._max_len = num_slots, max_len
         self._block_size, self._num_blocks = block_size, num_blocks
         self._arena_dtype = arena_dtype
         self.pool = BlockPool(model, num_slots, max_len,
                               block_size=block_size, num_blocks=num_blocks,
-                              dtype=arena_dtype)
+                              dtype=arena_dtype, draft_model=draft_model)
 
         self._running: Dict[int, Request] = {}      # slot -> request
         # device-resident per-slot last tokens: written by prefill (the
@@ -293,12 +355,22 @@ class ServeEngine:
                     f"programs= sharing requires matching block_size "
                     f"(template {programs.block_size}, this engine "
                     f"{self.pool.block_size})")
+            if programs.draft_ref is not draft_model or \
+                    programs.spec_k != self.spec_k:
+                raise ValueError(
+                    "programs= sharing requires the SAME draft model "
+                    "object and spec_k (the verify program's closures "
+                    f"capture both; template spec_k={programs.spec_k}, "
+                    f"this engine spec_k={self.spec_k})")
             self._prefill = programs.prefill
             self._decode = programs.decode
             self._handoff = programs.handoff
+            self._verify = programs.verify
             return
         bs = self.pool.block_size
         resume = resume_step(model)
+
+        from . import spec as spec_mod
 
         def prefill_chunk(params, buffers, ids, pos, last_idx, slot,
                           tables, toks, caches):
@@ -307,24 +379,20 @@ class ServeEngine:
             # traced offset, pick the chunk's last valid token
             # in-program (only the final chunk's pick survives), and
             # scatter the ONE block this chunk filled back to the arena
+            # (the gather/forward/scatter halves are the SAME helpers
+            # the speculative prefill composes — serve/spec.py — so
+            # the two prefill programs' semantics cannot drift apart)
             row = jax.lax.dynamic_index_in_dim(tables, slot, axis=0,
                                                keepdims=True)   # (1, MB)
-            dense = [kv_ops.gather_block_kv(ck, cv, row)
-                     for ck, cv in caches]
-            logits, dense = resume(params, buffers, ids, pos, dense)
+            logits, dense = spec_mod.resume_on_row(
+                resume, params, buffers, ids, pos, row, caches)
             last = jax.lax.dynamic_slice_in_dim(
                 logits, last_idx, 1, axis=1)[:, 0, :]
             # greedy pick in-program (jnp.argmax — bit-identical to
             # _pick_impl's temperature-0 branch in generate())
             tok = jnp.argmax(last, axis=-1).astype(jnp.int32)[0]
             toks = toks.at[slot].set(tok)
-            wb = jax.lax.dynamic_index_in_dim(
-                row[0], pos // bs, keepdims=False)
-            new = []
-            for (ck, cv), (dk, dv) in zip(caches, dense):
-                kb = jax.lax.dynamic_slice_in_dim(dk[0], pos, bs, axis=0)
-                vb = jax.lax.dynamic_slice_in_dim(dv[0], pos, bs, axis=0)
-                new.append(kv_ops.scatter_block_kv(ck, cv, wb, kb, vb))
+            new = spec_mod.scatter_chunk(row, pos, caches, dense, bs)
             return toks, new
 
         dec = decode_step(model)
@@ -373,7 +441,22 @@ class ServeEngine:
             return [kv_ops.gather_block_kv(ck, cv, row)
                     for ck, cv in caches]
 
-        self._prefill = jax.jit(prefill_chunk, donate_argnums=(8,))
+        if draft_model is not None:
+            # speculative engine: the prefill program also writes the
+            # draft arena (both caches donated), and the VERIFY program
+            # (serve/spec.py) replaces the per-tick decode — the plain
+            # decode program stays as the serve.verify fault-fallback,
+            # so the fixed compiled set is (prefill, decode, verify,
+            # handoff), asserted via spec_compiled_counts()
+            self._prefill = jax.jit(
+                spec_mod.make_spec_prefill(model, draft_model, bs),
+                donate_argnums=(10, 11))
+            self._verify = jax.jit(
+                spec_mod.make_verify(model, draft_model, self.spec_k, bs),
+                donate_argnums=(8, 9))
+        else:
+            self._prefill = jax.jit(prefill_chunk, donate_argnums=(8,))
+            self._verify = None
         self._decode = jax.jit(decode_paged, donate_argnums=(6,))
         self._handoff = jax.jit(handoff_gather)
 
@@ -392,36 +475,79 @@ class ServeEngine:
         after — never more (same fixed shapes as decode's inputs)."""
         return self._handoff._cache_size()
 
+    def spec_compiled_counts(self):
+        """(prefill, decode, verify, handoff) jit-cache entry counts —
+        the FIXED PROGRAM SET invariant of ISSUE 13: a speculative
+        engine's whole serving lifetime compiles exactly the asserted
+        set and nothing else.  ``decode`` is 0 until a ``serve.verify``
+        fault forces a plain-decode fallback tick, ``handoff`` is 0
+        outside a disaggregated tier; no entry ever exceeds 1."""
+        return (self._prefill._cache_size(), self._decode._cache_size(),
+                self._verify._cache_size() if self._verify is not None
+                else 0,
+                self._handoff._cache_size())
+
     def programs(self) -> SharedPrograms:
         """The engine's compiled-program bundle, lendable to another
         same-model/same-block-size engine via ``programs=`` — see
         :class:`SharedPrograms`."""
         return SharedPrograms(self.model, self.pool.block_size,
-                              self._prefill, self._decode, self._handoff)
+                              self._prefill, self._decode, self._handoff,
+                              self.draft_model, self.spec_k, self._verify)
 
-    def lower_programs(self):
+    def lower_programs(self, names=None):
         """jax ``Lowered`` handles of the exactly-two programs (keyed
         ``prefill_chunk`` / ``decode``) plus the optional third
         (``handoff_gather``, the disaggregated tier's KV handoff
-        source) — the hook ``tools/lint/hlo.py`` compiles to optimized
-        HLO and audits (fusions, donation of the KV arena, op
-        histogram).  Lowering is abstract: nothing executes, nothing is
-        donated, and the jit caches (:meth:`compiled_counts`) are
-        untouched.  The traced shapes are exactly the runtime dispatch
-        shapes, so the audited modules ARE the serving modules."""
+        source) and — on a speculative engine — ``verify``; the hook
+        ``tools/lint/hlo.py`` compiles to optimized HLO and audits
+        (fusions, donation of the KV arena, op histogram).  ``names``
+        restricts the set (the gate lowers only ``verify`` from its
+        spec engine — tracing the others there would be pure waste).
+        Lowering is abstract: nothing executes, nothing is donated,
+        and the jit caches (:meth:`compiled_counts`) are untouched.
+        The traced shapes are exactly the runtime dispatch shapes, so
+        the audited modules ARE the serving modules."""
         bs = self.pool.block_size
         zero = jnp.asarray(0, jnp.int32)
-        prefill = self._prefill.lower(
-            self._params, self._buffers, jnp.zeros((1, bs), jnp.int32),
-            zero, jnp.asarray(bs - 1, jnp.int32), zero,
-            self.pool.tables, self._toks, self.pool.caches)
-        decode = self._decode.lower(
-            self._params, self._buffers, self._toks, self.pool.pos,
-            self.pool.active, self.pool.tables, self.pool.caches)
-        handoff = self._handoff.lower(self.pool.tables, zero,
-                                      self.pool.caches)
-        return {"prefill_chunk": prefill, "decode": decode,
-                "handoff_gather": handoff}
+
+        def lower_prefill():
+            if self._verify is not None:
+                return self._prefill.lower(
+                    self._params, self._buffers, self._dparams,
+                    self._dbuffers, jnp.zeros((1, bs), jnp.int32),
+                    zero, jnp.asarray(bs - 1, jnp.int32), zero,
+                    self.pool.tables, self._toks, self.pool.caches,
+                    self.pool.draft_caches)
+            return self._prefill.lower(
+                self._params, self._buffers, jnp.zeros((1, bs), jnp.int32),
+                zero, jnp.asarray(bs - 1, jnp.int32), zero,
+                self.pool.tables, self._toks, self.pool.caches)
+
+        def lower_handoff():
+            caches = (self.pool.caches + self.pool.draft_caches
+                      if self._verify is not None else self.pool.caches)
+            return self._handoff.lower(self.pool.tables, zero, caches)
+
+        def lower_decode():
+            return self._decode.lower(
+                self._params, self._buffers, self._toks, self.pool.pos,
+                self.pool.active, self.pool.tables, self.pool.caches)
+
+        def lower_verify():
+            return self._verify.lower(
+                self._params, self._buffers, self._dparams,
+                self._dbuffers, self._toks, self.pool.pos,
+                self.pool.active, self.pool.tables, self.pool.caches,
+                self.pool.draft_caches)
+
+        thunks = {"prefill_chunk": lower_prefill, "decode": lower_decode,
+                  "handoff_gather": lower_handoff}
+        if self._verify is not None:
+            thunks["verify"] = lower_verify
+        wanted = thunks if names is None else {
+            n: thunks[n] for n in names}
+        return {name: thunk() for name, thunk in wanted.items()}
 
     @property
     def pending(self) -> int:
@@ -512,11 +638,17 @@ class ServeEngine:
         # is what makes the cross-worker timeline a single trace.
         req.trace_id = trace_id or f"{self.run_id}/r{req.rid}"
         p = req.prompt.size
-        if p + req.max_new_tokens > self.pool.max_len:
+        # a speculative engine needs spec_k tokens of arena headroom:
+        # the request's LAST verify round may still write a full
+        # k+1-position window past its final accepted token, and those
+        # writes must stay inside the slot's dense view
+        if p + req.max_new_tokens + self.spec_k > self.pool.max_len:
+            k_note = (f" + spec_k ({self.spec_k})" if self.spec_k
+                      else "")
             raise ValueError(
-                f"prompt ({p}) + max_new_tokens ({req.max_new_tokens}) "
-                f"= {p + req.max_new_tokens} exceeds max_len "
-                f"({self.pool.max_len})")
+                f"prompt ({p}) + max_new_tokens ({req.max_new_tokens})"
+                f"{k_note} = {p + req.max_new_tokens + self.spec_k} "
+                f"exceeds max_len ({self.pool.max_len})")
         with obs_trace.activate(req.trace_id):
             try:
                 self.sched.offer(req)
@@ -593,7 +725,9 @@ class ServeEngine:
                 try:
                     self._ensure_blocks()
                     if self._running:
-                        delivered += self._decode_tick()
+                        delivered += (self._spec_tick()
+                                      if self._verify is not None
+                                      else self._decode_tick())
                 except (RuntimeError, OSError) as e:
                     if isinstance(e, failure.FailureDetected):
                         raise
@@ -623,7 +757,8 @@ class ServeEngine:
         if tick is None:
             return 0.0
         return eta_first_token(position, free_slots=self.pool.free_count,
-                               wave_size=self.pool.num_slots, tick_s=tick)
+                               wave_size=self.pool.num_slots, tick_s=tick,
+                               tokens_per_tick=self._tpt_ewma or 1.0)
 
     def run_until_idle(self, max_steps: Optional[int] = None) -> None:
         """Drive ``step()`` until no request is queued or running.  With
@@ -816,6 +951,21 @@ class ServeEngine:
                     ids = np.zeros((1, bs), np.int32)
                     chunk = replay[start:start + bs]
                     ids[0, :chunk.size] = chunk
+                    if self._verify is not None:
+                        # spec engine: the ONE prefill program writes
+                        # the chunk into BOTH arenas (target + draft)
+                        (self._toks, self.pool.caches,
+                         self.pool.draft_caches) = self._dispatch(
+                            "serve.prefill", self._prefill,
+                            (self._params, self._buffers, self._dparams,
+                             self._dbuffers, jnp.asarray(ids),
+                             jnp.asarray(start, jnp.int32),
+                             jnp.asarray(chunk.size - 1, jnp.int32),
+                             jnp.asarray(slot, jnp.int32),
+                             self.pool.tables, self._toks,
+                             self.pool.caches, self.pool.draft_caches),
+                            rid=req.rid)
+                        continue
                     self._toks, self.pool.caches = self._dispatch(
                         "serve.prefill", self._prefill,
                         (self._params, self._buffers, jnp.asarray(ids),
@@ -897,7 +1047,11 @@ class ServeEngine:
             if req is None:
                 continue
             bs = self.pool.block_size
-            need = (req.prompt.size + len(req.tokens)) // bs + 1
+            # a verify round writes up to position pos + spec_k (the
+            # full k+1 window), so a speculative slot needs its blocks
+            # mapped spec_k positions ahead of a plain one
+            need = (req.prompt.size + len(req.tokens)
+                    + self.spec_k) // bs + 1
             while slot in self._running and \
                     self.pool.mapped_count(slot) < need:
                 got = self._alloc_blocks(1, req.rid)
@@ -940,12 +1094,51 @@ class ServeEngine:
                 done = req.deliver(tok)
                 self.metrics.on_token(dt)
                 self.metrics.on_deliver(req.rid, len(req.tokens))
+                self.metrics.on_slot_dispatch(1)
             if req.on_token is not None:
                 req.on_token(tok, req.handle)
             delivered += 1
             if done:
                 self._finalize(slot)
+        self._note_tpt(delivered, delivered)
         return delivered
+
+    def _spec_tick(self) -> int:
+        """One speculative verify round (serve/spec.py) — with a
+        PLAIN-DECODE fallback when the verify DISPATCH dies past its
+        retry budget (injected ``serve.verify`` faults included): one
+        target-correct token per slot still lands this tick, the
+        accepted stream is unchanged (plain decode is the same target
+        argmax), and only the draft cache takes a gap at the fallback
+        position — a later accept-rate cost, never a correctness one.
+        Only :class:`~singa_tpu.serve.spec.VerifyDispatchFailed` takes
+        this path — nothing was committed yet, so a plain tick on the
+        untouched arena is safe.  A failure AFTER the dispatch (result
+        fetch, delivery) is half-committed and propagates to step()'s
+        arena-recovery handler instead, as does a fallback tick that
+        ALSO fails."""
+        from . import spec as spec_mod
+        participants = len(self._running)
+        try:
+            delivered = spec_mod.verify_round(self)
+        except spec_mod.VerifyDispatchFailed as e:
+            self.metrics.on_spec_fallback()
+            warnings.warn(
+                f"serve: verify round failed past retries "
+                f"({type(e).__name__}: {e}); falling back to plain "
+                f"decode for this tick", stacklevel=2)
+            return self._decode_tick()
+        self._note_tpt(delivered, participants)
+        return delivered
+
+    def _note_tpt(self, delivered: int, participants: int) -> None:
+        """Fold one tick's accepted-tokens-per-slot into the EWMA the
+        shed eta consumes (scheduler.eta_first_token tokens_per_tick)."""
+        if not participants:
+            return
+        tpt = delivered / participants
+        self._tpt_ewma = tpt if self._tpt_ewma is None else \
+            0.8 * self._tpt_ewma + 0.2 * tpt
 
     def _finalize(self, slot: int, evicted: bool = False) -> None:
         req = self._running.pop(slot)
@@ -989,7 +1182,8 @@ class ServeEngine:
                                   self._max_len,
                                   block_size=self._block_size,
                                   num_blocks=self._num_blocks,
-                                  dtype=self._arena_dtype)
+                                  dtype=self._arena_dtype,
+                                  draft_model=self.draft_model)
             self._toks = jnp.zeros((self._num_slots,), jnp.int32)
             requeue = []
             for req in inflight:

@@ -39,6 +39,18 @@ name                        kind       meaning
 ``serve.step``              span       one engine step (host wall clock)
 ``serve.prefill``           span       one prefill dispatch (+ fetch)
 ``serve.decode``            span       one decode dispatch (+ fetch)
+``serve.verify``            span       one speculative verify round
+                                       (draft propose-k + target
+                                       verify in ONE dispatch + fetch;
+                                       ``k`` attr)
+``serve.spec_proposed``     counter    draft tokens proposed this round
+                                       (k per active slot)
+``serve.spec_accepted``     counter    proposals the target's own
+                                       greedy picks confirmed
+``serve.spec_fallbacks``    counter    verify rounds that fell back to
+                                       plain decode (``serve.verify``
+                                       fault past retries)
+``serve.accept_rate``       histogram  per-(slot, round) accepted / k
 ``serve.token``             counter    one token delivered to a request
                                        (prefill first token, decode
                                        tick, recovery/preemption replay
@@ -95,6 +107,19 @@ class ServeMetrics:
         self.prefix_hits = 0
         self.prefix_hit_tokens = 0
         self.steps = 0
+        # speculative decoding (ISSUE 13): per-(slot, round) accounting
+        # for the accept rate and the tokens-per-dispatch headline —
+        # slot_dispatches counts per-slot participations in a decode OR
+        # verify dispatch (a plain tick is the 1-token case), so
+        # tokens_per_dispatch = slot_dispatch_tokens / slot_dispatches
+        # is comparable across spec and plain engines
+        self.spec_rounds = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_fallbacks = 0
+        self.slot_dispatches = 0
+        self.slot_dispatch_tokens = 0
+        self._accept = _Hist()
         self._ttft = _Hist()
         self._token = _Hist()
 
@@ -154,6 +179,53 @@ class ServeMetrics:
         events.counter("serve.prefix_hit_tokens", tokens)
         self._note("counter", "serve.prefix_hits", tokens=tokens)
 
+    # -- speculative decoding (ISSUE 13) -----------------------------------
+    def on_spec_round(self, proposed: int, accepted: int) -> None:
+        """One (slot, verify round): ``proposed`` = k draft tokens,
+        ``accepted`` = how many of them the target's own greedy picks
+        confirmed (the round still delivers accepted + 1 tokens — the
+        correction/bonus pick is the target's, not the draft's)."""
+        self.spec_rounds += 1
+        self.spec_proposed += proposed
+        self.spec_accepted += accepted
+        rate = accepted / proposed if proposed else 0.0
+        self._accept.observe(rate)
+        events.counter("serve.spec_proposed", proposed)
+        events.counter("serve.spec_accepted", accepted)
+        events.histogram("serve.accept_rate", rate)
+        self._note("counter", "serve.spec_accepted", accepted=accepted,
+                   proposed=proposed)
+
+    def on_spec_fallback(self) -> None:
+        """A verify round died past retries and this tick ran plain
+        decode instead — stream unchanged, accept rate pays later."""
+        self.spec_fallbacks += 1
+        events.counter("serve.spec_fallbacks", 1)
+        self._note("counter", "serve.spec_fallbacks")
+
+    def on_slot_dispatch(self, tokens: int) -> None:
+        """One slot's share of one decode/verify dispatch, yielding
+        ``tokens`` delivered tokens — the denominator/numerator pair of
+        the ``tokens_per_dispatch`` headline."""
+        self.slot_dispatches += 1
+        self.slot_dispatch_tokens += tokens
+
+    @property
+    def accept_rate(self) -> Optional[float]:
+        """Overall accepted / proposed (None before any verify round)."""
+        if not self.spec_proposed:
+            return None
+        return self.spec_accepted / self.spec_proposed
+
+    @property
+    def tokens_per_dispatch(self) -> Optional[float]:
+        """Delivered tokens per per-slot dispatch participation (None
+        before any decode/verify tick; exactly 1.0 for a plain
+        engine)."""
+        if not self.slot_dispatches:
+            return None
+        return self.slot_dispatch_tokens / self.slot_dispatches
+
     # -- latency / delivery ------------------------------------------------
     def on_first_token(self, ttft_s: float) -> None:
         self._ttft.observe(ttft_s * 1e3)
@@ -195,6 +267,15 @@ class ServeMetrics:
             "prefix_hits": self.prefix_hits,
             "prefix_hit_tokens": self.prefix_hit_tokens,
             "steps": self.steps,
+            "spec_rounds": self.spec_rounds,
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
+            "spec_fallbacks": self.spec_fallbacks,
+            "slot_dispatches": self.slot_dispatches,
+            "slot_dispatch_tokens": self.slot_dispatch_tokens,
+            "accept_rate": self.accept_rate,
+            "tokens_per_dispatch": self.tokens_per_dispatch,
+            "accept_rate_hist": self._accept.summary(),
             "ttft_ms": self._ttft.summary(),
             "token_ms": self._token.summary(),
         }

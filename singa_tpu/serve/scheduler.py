@@ -29,7 +29,8 @@ __all__ = ["QueueFull", "Request", "RequestHandle", "Scheduler",
 
 
 def eta_first_token(position: int, *, free_slots: int, wave_size: int,
-                    tick_s: float) -> float:
+                    tick_s: float,
+                    tokens_per_tick: float = 1.0) -> float:
     """Seconds until the queued request at ``position`` could plausibly
     deliver its first token — the ONE eta model behind
     :meth:`Scheduler.shed_overload` (engines and the disaggregated
@@ -50,10 +51,22 @@ def eta_first_token(position: int, *, free_slots: int, wave_size: int,
     clocks.  Before PR 12 the eta always used the engine's own
     tick EWMA, which under-estimated queue wait by (router round /
     engine tick) and let doomed requests through to burn prefills
-    instead of being shed."""
+    instead of being shed.
+
+    ``tokens_per_tick`` is the measured ACCEPTED-tokens-per-tick per
+    slot (``ServeEngine._tpt_ewma``): a speculative verify-k engine
+    delivers up to ``k + 1`` tokens per dispatch, so its running slots
+    free up proportionally faster and a queued request's wave count is
+    worth ``tick_s / tokens_per_tick`` seconds, not ``tick_s``.
+    Before ISSUE 13 the model hard-coded 1 token per tick, which
+    over-estimated a spec engine's queue wait by that factor and shed
+    requests that would have made their deadlines comfortably.  Values
+    below 1 are clamped — a partially-delivered tick must not make the
+    eta OPTIMISTIC about a plain engine."""
     if position < free_slots:
         return 0.0
-    return tick_s * (1 + (position - free_slots) // max(1, wave_size))
+    waves = 1 + (position - free_slots) // max(1, wave_size)
+    return tick_s * waves / max(1.0, tokens_per_tick)
 
 QUEUED = "queued"
 RUNNING = "running"

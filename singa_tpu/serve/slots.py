@@ -84,7 +84,7 @@ class BlockPool:
 
     def __init__(self, model, num_slots: int, max_len: int, *,
                  block_size: int = 16, num_blocks: Optional[int] = None,
-                 dtype=None):
+                 dtype=None, draft_model=None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if max_len < 2:
@@ -115,6 +115,32 @@ class BlockPool:
             spec = jax.eval_shape(
                 lambda: model.init_caches(num_blocks, block_size))
             self.caches = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, dtype), spec)
+        # speculative decoding (serve/spec.py): the DRAFT model's KV
+        # blocks ride the SAME block tables — draft caches are a second
+        # per-layer pool with identical (num_blocks, block_size) leading
+        # dims (draft layer/head/dim shapes differ freely), so every
+        # host-side mapping decision (admit, grow, evict, prefix share,
+        # preempt, handoff) covers both arenas with one index update.
+        # A shared full prompt block therefore shares its draft KV too:
+        # the spec prefill writes both, and block content is a
+        # deterministic function of the chain-keyed prefix either way.
+        self.draft_model = draft_model
+        if draft_model is None:
+            self.draft_caches = None
+        elif dtype is None:
+            self.draft_caches = draft_model.init_caches(num_blocks,
+                                                        block_size)
+        else:
+            # the serving-dtype override applies to BOTH arenas: decode
+            # and verify are weight/KV-read bound, and a full-precision
+            # draft arena would double the draft's KV traffic (and,
+            # under self-speculation, let draft and target argmaxes
+            # diverge by reading different-precision KV)
+            import jax
+            spec = jax.eval_shape(
+                lambda: draft_model.init_caches(num_blocks, block_size))
+            self.draft_caches = jax.tree.map(
                 lambda s: jnp.zeros(s.shape, dtype), spec)
         self.tables = jnp.zeros((num_slots, self.max_blocks), jnp.int32)
         self.pos = jnp.zeros((num_slots,), jnp.int32)

@@ -1,0 +1,279 @@
+"""Speculative decoding over the paged KV arena (ISSUE 13).
+
+The committed hlocost baselines classify decode as MEMORY-bound: every
+decode dispatch streams the whole weight + KV working set through HBM
+to emit one token per slot.  Speculative decoding raises
+tokens-per-dispatch instead of trying to make the dispatch cheaper: a
+small DRAFT model proposes ``k`` tokens per slot, and the target model
+scores all ``k + 1`` window positions in ONE compute-denser **verify**
+dispatch — the third gated program, next to prefill and decode.
+
+How one verify round works (all of it inside the single compiled
+``verify`` program; ``k`` is a trace-time constant):
+
+1. **propose** — the draft runs ``k + 1`` single-token steps over its
+   own dense cache view (gathered through the SAME block tables as the
+   target's: the draft arena is a parallel per-layer block pool in
+   :class:`~singa_tpu.serve.slots.BlockPool`), greedily picking
+   ``d1..dk`` from the pending token ``t0``.  The extra (k+1)-th step
+   exists only to write ``dk``'s draft KV, so a fully-accepted round
+   leaves no gap in the draft cache.
+2. **verify** — the target scores the window ``[t0, d1..dk]`` at
+   per-slot positions in one ``(num_slots, k+1)`` forward
+   (``cached_sdpa``'s per-row ``limit`` and the per-row RoPE offset
+   vector already support multi-token windows), writing the window's
+   KV for BOTH arenas via the fixed-shape multi-token scatter
+   (``ops.kv_cache.scatter_tokens_kv``).
+3. **accept + commit/rollback** — the accepted run is the longest
+   prefix of proposals matching the target's own greedy picks; the
+   delivered tokens are literally the TARGET's argmaxes
+   (``cand[:, :a+1]``), which is why speculative greedy streams are
+   bitwise identical to ``generate()`` *by construction* — the draft
+   can only change HOW MANY target picks one dispatch yields, never
+   their values.  Rejected positions are rolled back by TRUNCATING the
+   slot's position/attention limit (``new_pos = pos + a + 1``): the
+   stale KV past the new limit is unreachable (masked by every
+   reader's validity window) and is overwritten by the next round —
+   no arena reshape, no scrubbing, no per-``k`` program.
+
+Fault containment (site ``serve.verify``, registered in
+``faults/sites.py``): an injected/transient verify failure past the
+retry budget falls back to a PLAIN decode tick for that round instead
+of wedging the slot or rebuilding the arena — the accepted stream is
+unaffected (plain decode is the same target argmax), at the cost of a
+gap in the draft cache at the fallback position, which can only lower
+the accept rate of later rounds, never change accepted tokens.
+
+Draft quality is strictly a PERFORMANCE knob: a perfect draft
+(self-speculation, ``draft_model is model``) accepts everything and
+delivers ``k + 1`` tokens per dispatch; an adversarial draft accepts
+nothing and the engine still makes one target-correct token of
+progress per round (tests/test_spec.py proves both ends bitwise equal
+to ``generate()``).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models._generate import decode_step, resume_step
+from ..obs import events
+from ..obs import trace as obs_trace
+from ..ops import kv_cache as kv_ops
+
+__all__ = ["make_spec_prefill", "make_verify", "verify_round",
+           "VerifyDispatchFailed", "resume_on_row", "scatter_chunk"]
+
+
+class VerifyDispatchFailed(RuntimeError):
+    """The verify DISPATCH died past its retry budget (injection site
+    ``serve.verify`` or a real pre-launch transient).  The only
+    exception :meth:`ServeEngine._spec_tick` converts into a
+    plain-decode fallback: at that point nothing was committed, so a
+    plain tick on the untouched arena is safe.  Any failure AFTER the
+    dispatch (result fetch, delivery) propagates unchanged instead —
+    the round is half-committed and only the step-level arena recovery
+    may touch it (falling back there would decode the new pending
+    token at a stale position and silently diverge the stream)."""
+
+
+def resume_on_row(resume, params, buffers, ids, pos, row, caches):
+    """Gather ``row``'s dense per-layer view and run ``resume`` (a
+    ``models._generate.resume_step`` closure) on it at traced offset
+    ``pos`` — the shared first half of every prefill-chunk program
+    (plain AND speculative), so the two engines' prefill semantics can
+    never drift apart."""
+    dense = [kv_ops.gather_block_kv(ck, cv, row) for ck, cv in caches]
+    return resume(params, buffers, ids, pos, dense)
+
+
+def scatter_chunk(row, pos, caches, dense, block_size):
+    """Scatter the ONE physical block a prefill chunk filled back into
+    the paged arena — the shared second half of every prefill-chunk
+    program (see :func:`resume_on_row`)."""
+    bs = block_size
+    wb = jax.lax.dynamic_index_in_dim(row[0], pos // bs, keepdims=False)
+    new = []
+    for (ck, cv), (dk, dv) in zip(caches, dense):
+        kb = jax.lax.dynamic_slice_in_dim(dk[0], pos, bs, axis=0)
+        vb = jax.lax.dynamic_slice_in_dim(dv[0], pos, bs, axis=0)
+        new.append(kv_ops.scatter_block_kv(ck, cv, wb, kb, vb))
+    return new
+
+
+def make_spec_prefill(model, draft, block_size: int):
+    """The spec engine's prefill-chunk closure: identical to the plain
+    engine's (gather the slot's dense view, run the cached forward at
+    the traced offset, pick the chunk's last token in-program, scatter
+    ONE block back) — plus the same chunk through the DRAFT model into
+    the draft arena, so a prefilled slot always has both caches warm.
+    The draft's chunk logits are unused (the TARGET picks the first
+    token) and XLA dead-code-eliminates its lm_head."""
+    bs = block_size
+    resume = resume_step(model)
+    dresume = resume_step(draft)
+
+    def prefill_chunk_spec(params, buffers, dparams, dbuffers, ids, pos,
+                           last_idx, slot, tables, toks, caches, dcaches):
+        row = jax.lax.dynamic_index_in_dim(tables, slot, axis=0,
+                                           keepdims=True)       # (1, MB)
+        logits, dense = resume_on_row(resume, params, buffers, ids,
+                                      pos, row, caches)
+        last = jax.lax.dynamic_slice_in_dim(
+            logits, last_idx, 1, axis=1)[:, 0, :]
+        tok = jnp.argmax(last, axis=-1).astype(jnp.int32)[0]
+        toks = toks.at[slot].set(tok)
+        new = scatter_chunk(row, pos, caches, dense, bs)
+        _, ddense = resume_on_row(dresume, dparams, dbuffers, ids, pos,
+                                  row, dcaches)
+        dnew = scatter_chunk(row, pos, dcaches, ddense, bs)
+        return toks, new, dnew
+
+    return prefill_chunk_spec
+
+
+def make_verify(model, draft, spec_k: int, block_size: int):
+    """Build the verify program's closure (see the module docstring for
+    the three phases).  Returns
+    ``(accepted, cand, new_toks, new_pos, caches, dcaches)`` where
+    ``accepted`` is the per-slot count of accepted PROPOSALS (0..k) and
+    ``cand`` is the (num_slots, k+1) matrix of the target's greedy
+    picks — the host delivers ``cand[slot, :accepted+1]``.  Inactive
+    slots are masked exactly like plain decode: positions clamped to 0,
+    every window write redirected to the null block, token entries and
+    positions frozen."""
+    k, bs = spec_k, block_size
+    dec_d = decode_step(draft)
+    res_t = resume_step(model)
+
+    def verify(params, buffers, dparams, dbuffers, toks, pos, active,
+               tables, caches, dcaches):
+        posc = jnp.where(active, pos, 0)
+
+        # -- 1. draft propose: k+1 single-token greedy steps ------------
+        ddense = [kv_ops.gather_block_kv(ck, cv, tables)
+                  for ck, cv in dcaches]
+        cur, dp = toks, posc
+        props = []
+        for j in range(k + 1):
+            dlogits, ddense = dec_d(dparams, dbuffers, cur[:, None], dp,
+                                    ddense)
+            cur = jnp.argmax(dlogits.astype(jnp.float32),
+                             axis=-1).astype(jnp.int32)
+            if j < k:
+                props.append(cur)
+            dp = dp + 1
+        props = jnp.stack(props, axis=1)                       # (S, k)
+
+        # window scatter targets, shared by both arenas: position
+        # pos+j lands at [table[slot, (pos+j)//bs], (pos+j)%bs]
+        wpos = posc[:, None] + jnp.arange(k + 1)[None, :]      # (S, k+1)
+        wblk = jnp.take_along_axis(tables, wpos // bs, axis=1)
+        wblk = jnp.where(active[:, None], wblk, 0)
+        woff = jnp.where(active[:, None], wpos % bs, 0)
+
+        def window(c, p):
+            return jax.lax.dynamic_slice_in_dim(c, p, k + 1, axis=0)
+
+        def scatter_window(cs, dense):
+            new = []
+            for (ck, cv), (dk, dv) in zip(cs, dense):
+                kw = jax.vmap(window)(dk, posc)        # (S, k+1, K, D)
+                vw = jax.vmap(window)(dv, posc)
+                new.append(kv_ops.scatter_tokens_kv(ck, cv, wblk, woff,
+                                                    kw, vw))
+            return new
+
+        new_d = scatter_window(dcaches, ddense)
+
+        # -- 2. target verify: one (S, k+1) forward ---------------------
+        win_ids = jnp.concatenate([toks[:, None], props], axis=1)
+        dense = [kv_ops.gather_block_kv(ck, cv, tables)
+                 for ck, cv in caches]
+        logits, dense = res_t(params, buffers, win_ids, posc, dense)
+        cand = jnp.argmax(logits.astype(jnp.float32),
+                          axis=-1).astype(jnp.int32)           # (S, k+1)
+        new_t = scatter_window(caches, dense)
+
+        # -- 3. accept the longest matching greedy prefix ---------------
+        match = (props == cand[:, :k]).astype(jnp.int32)
+        acc = jnp.cumprod(match, axis=1).sum(axis=1)           # (S,) 0..k
+        new_tok = jnp.take_along_axis(cand, acc[:, None], axis=1)[:, 0]
+        new_toks = jnp.where(active, new_tok, toks)
+        # rollback IS this truncation: rejected positions stay written
+        # but sit past the new limit, unreachable and overwritten next
+        new_pos = jnp.where(active, posc + acc + 1, pos)
+        acc = jnp.where(active, acc, 0)
+        return acc, cand, new_toks, new_pos, new_t, new_d
+
+    return verify
+
+
+def verify_round(engine) -> int:
+    """One speculative tick over the whole arena: dispatch the verify
+    program, then commit each slot's accepted run host-side — deliver
+    ``accepted + 1`` tokens (the target's own picks) in stream order,
+    stopping early at EOS/budget like any other delivery path.  Same
+    subsystem-package access pattern as ``disagg/handoff.py``: this is
+    the implementation behind ``ServeEngine._spec_tick``."""
+    from ..utils import failure
+    k = engine.spec_k
+    t0 = time.perf_counter()
+    with events.span("serve.verify", active=len(engine._running), k=k):
+        try:
+            out = engine._dispatch(
+                "serve.verify", engine._verify,
+                (engine._params, engine._buffers, engine._dparams,
+                 engine._dbuffers, engine._toks, engine.pool.pos,
+                 engine.pool.active, engine.pool.tables,
+                 engine.pool.caches, engine.pool.draft_caches),
+                active=len(engine._running))
+        except (RuntimeError, OSError) as e:
+            if isinstance(e, failure.FailureDetected):
+                raise
+            # ONLY the un-committed dispatch failure is fallback-safe;
+            # everything past this point is half-committed state whose
+            # failures must escalate (see VerifyDispatchFailed)
+            raise VerifyDispatchFailed(
+                f"{type(e).__name__}: {e}") from e
+        (acc_v, cand_v, engine._toks, new_pos, engine.pool.caches,
+         engine.pool.draft_caches) = out
+        acc = np.asarray(acc_v)    # singalint: disable=SGL008 the designed per-tick sync: one (S,) + one (S, k+1) int fetch commits a whole verify round
+        cand = np.asarray(cand_v)
+    engine.pool.pos = new_pos
+    dt = time.perf_counter() - t0
+    delivered = 0
+    for slot in list(engine._running):
+        req = engine._running[slot]
+        a = int(acc[slot])
+        run = [int(t) for t in cand[slot, :a + 1]]
+        done = False
+        n = 0
+        with obs_trace.activate(req.trace_id):
+            engine.metrics.on_spec_round(k, a)
+            for tok in run:
+                done = req.deliver(tok)
+                n += 1
+                engine.metrics.on_deliver(req.rid, len(req.tokens))
+                if done:
+                    # budget/EOS mid-run: the leftover accepted tokens
+                    # are DISCARDED (generate() would never have
+                    # produced them either) and the slot is released
+                    break
+            # per-token cost = this dispatch's latency amortized over
+            # the tokens it yielded for this slot (a plain decode tick
+            # is the n == 1 case of the same definition)
+            for _ in range(n):
+                engine.metrics.on_token(dt / n)
+            engine.metrics.on_slot_dispatch(n)
+        if req.on_token is not None:
+            for tok in run[:n]:
+                req.on_token(tok, req.handle)
+        delivered += n
+        if done:
+            engine._finalize(slot)
+    return delivered

@@ -53,6 +53,7 @@ class TestCrossPoolEta:
 
     def _engine_eta(self, own_tick, hint, position, free=0, slots=2):
         eng = SimpleNamespace(_tick_ewma=own_tick, tick_hint_s=hint,
+                              _tpt_ewma=None,
                               pool=SimpleNamespace(free_count=free,
                                                    num_slots=slots))
         return ServeEngine._eta_first_token(eng, position)
@@ -87,6 +88,7 @@ class TestCrossPoolEta:
         # own tick 10 ms: every position looks reachable in time
         eta_own = lambda pos: ServeEngine._eta_first_token(
             SimpleNamespace(_tick_ewma=0.01, tick_hint_s=None,
+                            _tpt_ewma=None,
                             pool=SimpleNamespace(free_count=1,
                                                  num_slots=1)), pos)
         assert sched.shed_overload(now, eta_own) == []
@@ -94,6 +96,7 @@ class TestCrossPoolEta:
         # deadline (eta 400 ms) and are shed NOW
         eta_tier = lambda pos: ServeEngine._eta_first_token(
             SimpleNamespace(_tick_ewma=0.01, tick_hint_s=0.2,
+                            _tpt_ewma=None,
                             pool=SimpleNamespace(free_count=1,
                                                  num_slots=1)), pos)
         shed = sched.shed_overload(now, eta_tier)

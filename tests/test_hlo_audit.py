@@ -5,7 +5,7 @@ lean.
 The invariants under test are the gates' contract:
   * the committed baselines under tools/lint/data/hlo/ (structure) and
     tools/lint/data/hlo/cost/ (cost) are CLEAN against a fresh lowering
-    of all five flagship programs — so any future change that moves a
+    of all seven flagship programs — so any future change that moves a
     fusion, collective, donation, flop count, HBM byte, peak-memory
     byte or wire byte fails CI with a named finding until it is
     reviewed via ``--update-baselines``;
@@ -28,7 +28,7 @@ The invariants under test are the gates' contract:
     wire_bytes) roundtrips through the obs schema, and
     ``cost_features()`` returns the stable documented dict per program.
 
-Budget discipline: ONE module fixture lowers all five programs
+Budget discipline: ONE module fixture lowers all seven programs
 (~15 s); every other test summarizes texts or diffs summaries in
 memory.  The defused and many-chunk train-step variants are the only
 extra compiles (tiny 1-block config — the cheap lowering).  Per-metric
@@ -51,7 +51,7 @@ REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 @pytest.fixture(scope="module")
 def texts():
-    """All five flagship programs (incl. train_step_dp2_int8, the
+    """All seven flagship programs (incl. train_step_dp2_int8, the
     error-feedback int8-ring DP step) lowered ONCE — the file's whole
     compile budget (plus the two seeded train-step variants); tests
     share and never mutate it."""
@@ -135,6 +135,11 @@ def test_summaries_encode_the_flagship_invariants(summaries):
         summaries["train_step_dp2"]["donated_outputs"]
     assert summaries["prefill_chunk"]["donated_outputs"] > 0
     assert summaries["decode"]["donated_outputs"] > 0
+    # the speculative verify round donates BOTH arenas (target + draft
+    # block pools are updated in place) and stays collective-free
+    assert summaries["verify"]["donated_outputs"] > \
+        summaries["decode"]["donated_outputs"]
+    assert summaries["verify"]["collectives"]["total"] == 0
     # the disagg handoff gather reads the arena without consuming it
     assert summaries["handoff_gather"]["donated_outputs"] == 0
     assert summaries["handoff_gather"]["collectives"]["total"] == 0
@@ -169,6 +174,12 @@ def test_cost_summaries_encode_the_flagship_invariants(costs):
     # the handoff gather must NOT donate: a failed handoff has to
     # leave the source arena valid for the router to re-route
     assert costs["handoff_gather"]["donated_bytes"] == 0
+    # one verify dispatch packs k+1 draft steps plus a (k+1)-token
+    # target window: it must be compute-DENSER per dispatch than the
+    # one-token decode program — the whole point of ISSUE 13
+    assert costs["verify"]["flops"] > 2 * costs["decode"]["flops"]
+    assert costs["verify"]["intensity"] > costs["decode"]["intensity"]
+    assert costs["verify"]["wire_bytes"] == 0
     assert costs["train_step"]["flops"] == \
         2 * costs["train_step_dp2"]["flops"]
     assert costs["train_step"]["wire_bytes"] == 0

@@ -466,6 +466,23 @@ class TestRegistryRules:
         """, "SGL007")
         assert out == []
 
+    def test_spec_verify_site_is_registered(self):
+        """ISSUE 13: the speculative verify seam is a real registry
+        entry — plans/dumps naming it lint clean, typos fire."""
+        out = lint("""
+            from singa_tpu import faults
+
+            faults.fire("serve.verify", attempt=0, active=4)
+        """, "SGL007")
+        assert out == []
+        out = lint("""
+            from singa_tpu import faults
+
+            faults.fire("serve.verfy", attempt=0)
+        """, "SGL007")
+        assert codes_of(out) == ["SGL007"]
+        assert "serve.verfy" in out[0].message
+
     def test_typoed_disagg_site_fires(self):
         out = lint("""
             from singa_tpu import faults
@@ -535,10 +552,12 @@ class TestFlightSite:
 
     def test_registered_sites_are_clean(self):
         # injection sites AND the incident-only seams both validate
+        # (serve.verify: the ISSUE 13 speculative seam)
         out = lint("""
             class Engine:
                 def ok(self):
                     self.flight.dump("serve.prefill", "runs/incidents")
+                    self.flight.dump("serve.verify", "runs/incidents")
                     self.flight.dump("serve.arena", "runs/incidents")
                     self._flight_dump("train.fatal", "msg")
         """, "SGL009")

@@ -1,0 +1,479 @@
+"""Speculative decoding on the paged KV arena (singa_tpu/serve/spec.py,
+ISSUE 13) — tier-1 CPU coverage on LlamaConfig.tiny().
+
+The invariants under test are the subsystem's correctness envelope:
+
+  * **identity end** — self-speculation (draft == target) must accept
+    EVERY proposal and the streams must be bitwise identical to
+    ``generate()`` (anything rejected means the k+1-token verify
+    window diverged from sequential decode);
+  * **adversarial end** — a draft built to always disagree forces full
+    rejection every round, and the streams are STILL bitwise identical
+    to ``generate()`` (the delivered tokens are the target's own
+    picks; rejected-position rollback — pos/limit truncation — may
+    never leak into accepted state);
+  * **fault end** — an injected ``serve.verify`` failure falls back to
+    a plain-decode tick, streams unchanged;
+  * **fixed program set** — (prefill, decode, verify, handoff) jit
+    caches hold exactly the asserted entries through all of the above;
+  * **disagg tier** — a speculative 1:1 prefill/decode tier (draft KV
+    riding the handoff) stays bitwise AND keeps accept rate 1.0 under
+    self-speculation (a cold draft cache would accept ~nothing);
+  * the shed-eta satellite (tokens-per-tick EWMA), the serve_load
+    spec-field schema pair, and the committed spec-compare records'
+    tokens/s win (frozen data — deterministic in tier-1).
+
+Budget discipline (ROADMAP item 6): ONE module self-speculation engine
+is shared by the identity, fault and tier tests (the tier shares its
+programs — only the handoff gather compiles extra); the adversarial
+engine is the only other compile pair; generate() references reuse two
+prompt shapes.  The k-sweep and the growth/preemption interplay run in
+the slow lane.
+"""
+
+import os
+import warnings
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from singa_tpu import faults, models, tensor
+from singa_tpu.faults import FaultPlan, FaultSpec
+from singa_tpu.obs import record as obs_record
+from singa_tpu.obs import schema
+from singa_tpu.serve import Router, ServeEngine, build_pools
+from singa_tpu.serve.engine import ServeEngine as _Eng
+from singa_tpu.serve.scheduler import (Request, Scheduler,
+                                       eta_first_token)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the two prompt shapes every generate() reference reuses (bounding
+#: the _gen_sessions compile count for the whole file)
+LENS = (5, 8)
+NNEW = 9
+K = 2
+
+
+@pytest.fixture(scope="module")
+def llama():
+    tensor.set_seed(0)
+    m = models.Llama(models.LlamaConfig.tiny())
+    m.eval()
+    m.compile([tensor.from_numpy(np.zeros((1, 4), np.int32))],
+              is_train=False, use_graph=False)
+    return m
+
+
+@pytest.fixture(scope="module")
+def engine(llama):
+    """The shared self-speculation engine (draft == target, k=2)."""
+    return ServeEngine(llama, num_slots=4, max_len=48, block_size=8,
+                       draft_model=llama, spec_k=K)
+
+
+def _prompts(n, seed=7):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 256, (LENS[i % len(LENS)],)).astype(np.int32)
+            for i in range(n)]
+
+
+def _refs(llama, prompts, n_new=NNEW):
+    return [llama.generate(p[None], max_new_tokens=n_new)[0, p.size:]
+            for p in prompts]
+
+
+class _AdversarialDraft:
+    """A draft that can never agree with the target: it negates the
+    logits, so its greedy pick is the target's argmin — every proposal
+    is rejected and each verify round makes exactly one (target-
+    correct) token of progress.  Delegates params/buffers/caches to
+    the wrapped model so the engine's ``_bound`` snapshotting works."""
+
+    def __init__(self, model):
+        self._m = model
+
+    def __getattr__(self, name):
+        return getattr(self._m, name)
+
+    def forward_cached(self, ids, caches, pos):
+        logits, caches = self._m.forward_cached(ids, caches, pos)
+        return -logits, caches
+
+
+# ---------------------------------------------------------------------------
+# the correctness envelope (ordering matters: the fixed-program-set
+# assertions tighten monotonically — decode compiles only at the fault
+# fallback test; -p no:randomly keeps file order)
+# ---------------------------------------------------------------------------
+
+class TestSelfSpeculation:
+    def test_streams_bitwise_equal_generate_and_all_accepted(
+            self, llama, engine):
+        prompts = _prompts(4)
+        refs = _refs(llama, prompts)
+        hs = [engine.submit(p, max_new_tokens=NNEW) for p in prompts]
+        engine.run_until_idle()
+        for ref, h in zip(refs, hs):
+            np.testing.assert_array_equal(ref, np.asarray(h.tokens))
+        snap = engine.metrics.snapshot()
+        # draft == target: anything rejected means the multi-token
+        # verify window diverged from sequential decode
+        assert snap["accept_rate"] == 1.0
+        assert snap["spec_rounds"] > 0
+        # tokens-per-dispatch beats plain decode's 1.0 (budget-clipped
+        # final rounds keep it below the k+1 ceiling)
+        assert 1.0 < snap["tokens_per_dispatch"] <= K + 1
+        # fixed program set: prefill + verify only — no decode (no
+        # fallback ran yet), no handoff (no tier), nothing recompiled
+        assert engine.spec_compiled_counts() == (1, 0, 1, 0)
+        assert engine.pool.free_count == engine.pool.num_slots
+        assert (engine.pool.ref == 0).all()
+
+    def test_eos_stops_mid_accepted_run(self, llama, engine):
+        """An EOS inside an accepted run finishes the request at the
+        EOS token (leftover accepted tokens discarded), exactly like
+        generate()'s semantics."""
+        prompt = _prompts(1, seed=11)[0]
+        ref = _refs(llama, [prompt])[0]
+        eos = int(ref[3])
+        k = int(np.where(ref == eos)[0][0])
+        h = engine.submit(prompt, max_new_tokens=NNEW, eos_id=eos)
+        engine.run_until_idle()
+        assert h.finish_reason == "eos"
+        assert h.tokens == [int(t) for t in ref[:k + 1]]
+        assert engine.pool.free_count == engine.pool.num_slots
+
+    def test_injected_verify_fault_falls_back_bitwise(self, llama,
+                                                      engine):
+        """The ``serve.verify`` site (ISSUE 13 satellite): a verify
+        failure past the retry budget costs ONE plain-decode tick, not
+        the slot, not the arena — streams stay bitwise identical and
+        the only jit-cache change is the decode program compiling."""
+        prompts = _prompts(2, seed=23)
+        refs = _refs(llama, prompts)
+        fb0 = engine.metrics.spec_fallbacks
+        # three consecutive fires exhaust the default retry budget
+        # (max_dispatch_retries=2) on ONE verify dispatch — a single
+        # fire would be absorbed by backoff retry, not fallback
+        plan = FaultPlan([FaultSpec("serve.verify", "error", every=1,
+                                    times=3)])
+        with faults.active(plan), warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            hs = [engine.submit(p, max_new_tokens=NNEW) for p in prompts]
+            engine.run_until_idle()
+        assert plan.fire_count() == 3
+        assert engine.metrics.spec_fallbacks - fb0 >= 1
+        for ref, h in zip(refs, hs):
+            np.testing.assert_array_equal(ref, np.asarray(h.tokens))
+        # the fallback compiled the plain decode program — and nothing
+        # else moved
+        assert engine.spec_compiled_counts() == (1, 1, 1, 0)
+
+    def test_disagg_spec_tier_bitwise_with_draft_kv_handoff(
+            self, llama, engine):
+        """A speculative 1:1 tier: prefill workers write BOTH arenas,
+        the handoff ships draft KV next to target KV, decode workers
+        verify.  Streams bitwise AND accept rate 1.0 — a handoff that
+        dropped the draft blocks would leave the decode worker's draft
+        cache cold and accept ~nothing (the regression this test
+        exists to catch)."""
+        prompts = _prompts(3, seed=29)
+        refs = _refs(llama, prompts)
+        pw, dw = build_pools(llama, 1, 1, template=engine, num_slots=4,
+                             max_len=48, block_size=8,
+                             draft_model=llama, spec_k=K)
+        tier = Router(pw, dw)
+        hs = [tier.submit(p, max_new_tokens=NNEW) for p in prompts]
+        tier.run_until_idle()
+        for ref, h in zip(refs, hs):
+            np.testing.assert_array_equal(ref, np.asarray(h.tokens))
+        assert tier.metrics.handoffs >= 1
+        snap = tier.metrics.snapshot()
+        assert snap["accept_rate"] == 1.0
+        assert snap["tokens_per_dispatch"] > 1.0
+        # the whole tier rode the template's shared programs: one
+        # entry each, plus the (lazily compiled) handoff gather
+        # (decode is 1 iff the fault-fallback test already ran — only
+        # its <= 1 bound is this test's business)
+        counts = engine.spec_compiled_counts()
+        assert (counts[0], counts[2], counts[3]) == (1, 1, 1)
+        assert counts[1] <= 1
+
+
+class TestSpecRecovery:
+    def test_arena_rebuild_replays_spec_streams_bitwise(self, llama,
+                                                        engine):
+        """Mid-stream arena recovery on a speculative engine: the
+        rebuild reconstructs BOTH block pools (target + draft) and the
+        spec prefill re-warms both from prompt + tokens-so-far — the
+        replayed streams stay bitwise and nothing recompiles (same
+        shapes, same programs)."""
+        prompts = _prompts(2, seed=37)
+        refs = _refs(llama, prompts)
+        counts0 = engine.spec_compiled_counts()
+        hs = [engine.submit(p, max_new_tokens=NNEW) for p in prompts]
+        engine.step()                  # both admitted, mid-stream
+        engine.recover("test: simulated device event")
+        engine.run_until_idle()
+        for ref, h in zip(refs, hs):
+            np.testing.assert_array_equal(ref, np.asarray(h.tokens))
+        assert engine.metrics.recoveries >= 1
+        assert engine.pool.draft_caches is not None
+        assert engine.spec_compiled_counts() == counts0
+
+
+class TestAdversarialDraft:
+    def test_full_rejection_rolls_back_exactly(self, llama):
+        """Every proposal rejected, every round: rollback must restore
+        the slot so exactly that the stream still equals generate()
+        bitwise — any leaked rejected-token KV (a write below the
+        truncated limit, a stale position) would corrupt a later
+        token.  One target-pick of progress per round is the floor the
+        verify design guarantees regardless of draft quality."""
+        adv = _AdversarialDraft(llama)
+        eng = ServeEngine(llama, num_slots=2, max_len=48, block_size=8,
+                          draft_model=adv, spec_k=K)
+        prompts = _prompts(2, seed=31)
+        refs = _refs(llama, prompts)
+        hs = [eng.submit(p, max_new_tokens=NNEW) for p in prompts]
+        eng.run_until_idle()
+        for ref, h in zip(refs, hs):
+            np.testing.assert_array_equal(ref, np.asarray(h.tokens))
+        snap = eng.metrics.snapshot()
+        assert snap["accept_rate"] == 0.0
+        # one token per slot per round — the rejected-everything floor
+        assert snap["tokens_per_dispatch"] == 1.0
+        assert snap["spec_rounds"] == snap["slot_dispatches"]
+        assert eng.spec_compiled_counts() == (1, 0, 1, 0)
+        assert (eng.pool.ref == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# engine validation (host-only)
+# ---------------------------------------------------------------------------
+
+class TestSpecValidation:
+    def test_draft_and_k_must_come_together(self, llama):
+        with pytest.raises(ValueError, match="spec_k"):
+            ServeEngine(llama, 2, 32, block_size=8, draft_model=llama)
+        with pytest.raises(ValueError, match="draft_model"):
+            ServeEngine(llama, 2, 32, block_size=8, spec_k=2)
+
+    def test_submit_enforces_spec_headroom(self, engine):
+        """prompt + budget + spec_k must fit max_len: the LAST verify
+        round still writes a full k+1 window."""
+        with pytest.raises(ValueError, match="spec_k"):
+            engine.submit(np.ones(8, np.int32),
+                          max_new_tokens=48 - 8 - K + 1)
+        assert engine.pending == 0
+
+    def test_programs_sharing_requires_same_draft_and_k(self, llama,
+                                                        engine):
+        with pytest.raises(ValueError, match="draft"):
+            ServeEngine(llama, 4, 48, block_size=8,
+                        programs=engine.programs())
+
+
+# ---------------------------------------------------------------------------
+# shed-eta satellite: accepted-tokens-per-tick EWMA
+# ---------------------------------------------------------------------------
+
+class TestSpecEta:
+    def test_eta_scales_inversely_with_tokens_per_tick(self):
+        base = eta_first_token(5, free_slots=1, wave_size=2, tick_s=1.0)
+        spec = eta_first_token(5, free_slots=1, wave_size=2, tick_s=1.0,
+                               tokens_per_tick=3.0)
+        assert spec == pytest.approx(base / 3.0)
+        # sub-1 rates clamp: a partial tick must not make a plain
+        # engine's eta optimistic
+        assert eta_first_token(5, free_slots=1, wave_size=2, tick_s=1.0,
+                               tokens_per_tick=0.25) == base
+        # inside the free window nothing changes
+        assert eta_first_token(0, free_slots=1, wave_size=2, tick_s=1.0,
+                               tokens_per_tick=3.0) == 0.0
+
+    def _eta(self, tick, tpt, position):
+        eng = SimpleNamespace(_tick_ewma=tick, tick_hint_s=None,
+                              _tpt_ewma=tpt,
+                              pool=SimpleNamespace(free_count=0,
+                                                   num_slots=1))
+        return _Eng._eta_first_token(eng, position)
+
+    def test_shed_overload_stops_over_shedding_spec_engines(self):
+        """REGRESSION (the ISSUE 13 satellite bug): the eta assumed 1
+        token per tick, so a verify-k engine — whose slots drain k+1
+        tokens per tick and free up proportionally sooner — shed
+        queued requests that would have made their deadlines.  With
+        the measured EWMA fed through, the same queue survives."""
+        sched = Scheduler(max_queue=8)
+        reqs = [Request(np.ones(4, np.int32), 4, deadline_s=0.5,
+                        eos_id=None, on_token=None) for _ in range(4)]
+        for r in reqs:
+            sched.offer(r)
+        now = reqs[0].submitted_at
+        # plain model of a 150 ms tick: positions >= 3 need 600 ms,
+        # past the 500 ms deadline -> shed
+        assert len(sched.shed_overload(
+            now, lambda p: self._eta(0.15, None, p))) == 1
+        # same tick, but the engine MEASURED ~3 accepted tokens/tick:
+        # the eta shrinks 3x and nothing else is shed
+        assert sched.shed_overload(
+            now, lambda p: self._eta(0.15, 3.0, p)) == []
+        assert sched.depth == 3
+
+
+# ---------------------------------------------------------------------------
+# schema: the accept_rate / tokens_per_dispatch pair
+# ---------------------------------------------------------------------------
+
+class TestSpecFieldSchema:
+    BASE = {"requests": 10, "completed": 10, "shed": 0, "rejected": 0,
+            "tokens_per_s": 100.0, "ttft_p50_ms": 5.0,
+            "ttft_p99_ms": 20.0}
+
+    def test_plain_payload_needs_no_spec_fields(self):
+        schema.validate_serve_load_payload(dict(self.BASE))
+
+    def test_full_pair_is_valid(self):
+        schema.validate_serve_load_payload(
+            {**self.BASE, "accept_rate": 0.9, "tokens_per_dispatch": 3.1})
+
+    def test_half_a_pair_is_rejected(self):
+        for present, missing in (("accept_rate", "tokens_per_dispatch"),
+                                 ("tokens_per_dispatch", "accept_rate")):
+            with pytest.raises(schema.SchemaError, match=missing):
+                schema.validate_serve_load_payload(
+                    {**self.BASE, present: 1.0})
+
+    def test_non_numeric_is_rejected_and_throughput_kind_covered(self):
+        with pytest.raises(schema.SchemaError, match="accept_rate"):
+            schema.validate_serve_load_payload(
+                {**self.BASE, "accept_rate": "high",
+                 "tokens_per_dispatch": 3.0})
+        tp = {"tokens_per_s": 1.0, "speedup_vs_sequential": 1.0,
+              "ttft_p50_ms": 1.0, "ttft_p99_ms": 1.0, "requests": 1,
+              "accept_rate": 1.0}
+        with pytest.raises(schema.SchemaError, match="tokens_per_dispatch"):
+            schema.validate_serve_payload(tp)
+
+
+# ---------------------------------------------------------------------------
+# the committed spec-compare evidence (frozen records)
+# ---------------------------------------------------------------------------
+
+def _spec_pairs(store_path):
+    groups = {}
+    for e in obs_record.RunRecord(store_path).entries():
+        if e["kind"] != "serve_load":
+            continue
+        p = e.get("payload", {})
+        if p.get("spec_pair_id"):
+            groups.setdefault(p["spec_pair_id"], []).append(p)
+    return {k: v for k, v in groups.items() if len(v) >= 2}
+
+
+class TestCommittedSpecPair:
+    def test_committed_pair_shows_the_tokens_per_s_win(self):
+        """ISSUE-13 acceptance: the committed spec-compare pair (same
+        Poisson/SLO harness, interleaved-median trials) shows the
+        speculative engine delivering MORE end-to-end tokens/s than
+        the plain engine — and more than the best point of the
+        committed PR 12 ratio sweep.  Every committed pair must
+        satisfy the contract."""
+        store = os.path.join(REPO, "runs", "records.jsonl")
+        pairs = _spec_pairs(store)
+        assert pairs, ("no committed spec-compare serve_load records "
+                       "(tools/loadgen.py --spec-compare)")
+        pr12_best = max(
+            (e["payload"]["tokens_per_s"]
+             for e in obs_record.RunRecord(store).entries()
+             if e["kind"] == "serve_load"
+             and e.get("payload", {}).get("sweep_id")), default=0.0)
+        for pair_id, pts in pairs.items():
+            by_seq = sorted(pts, key=lambda p: p["spec_seq"])
+            plain, spec = by_seq[0], by_seq[-1]
+            assert plain["spec_k"] == 0 and spec["spec_k"] >= 1, pair_id
+            assert spec["tokens_per_s"] > plain["tokens_per_s"], (
+                pair_id, spec["tokens_per_s"], plain["tokens_per_s"])
+            assert spec["tokens_per_s"] > pr12_best
+            # the mechanism behind the win is on the record too
+            assert spec["tokens_per_dispatch"] > 1.0
+            assert 0.0 < spec["accept_rate"] <= 1.0
+            # same offered workload on both sides
+            assert spec["requests"] == plain["requests"]
+            for p in (plain, spec):
+                schema.validate_serve_load_payload(p)
+
+
+# ---------------------------------------------------------------------------
+# slow lane: k sweep + growth/preemption interplay
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestSpecSlow:
+    def test_k_sweep_stays_bitwise(self, llama):
+        """Identity across k (1, 3, 5): the verify program's shape is
+        per-k, but every k must produce the same greedy stream (the
+        cheap k=2 sibling in the fast lane keeps the mechanism
+        covered)."""
+        prompts = _prompts(2, seed=41)
+        refs = _refs(llama, prompts)
+        for k in (1, 3, 5):
+            eng = ServeEngine(llama, num_slots=2, max_len=48,
+                              block_size=8, draft_model=llama, spec_k=k)
+            hs = [eng.submit(p, max_new_tokens=NNEW) for p in prompts]
+            eng.run_until_idle()
+            for ref, h in zip(refs, hs):
+                np.testing.assert_array_equal(ref, np.asarray(h.tokens))
+            assert eng.metrics.snapshot()["accept_rate"] == 1.0
+
+    def test_growth_preemption_keeps_spec_streams_bitwise(self, llama):
+        """A block pool too small for both slots: decode-time growth
+        (which must map spec_k positions of headroom) exhausts the
+        pool, the youngest request is preempted mid-speculation, and
+        its replay still reproduces the exact stream."""
+        eng = ServeEngine(llama, num_slots=2, max_len=48, block_size=8,
+                          num_blocks=8, draft_model=llama, spec_k=K)
+        prompts = _prompts(2, seed=43)
+        refs = [llama.generate(p[None], max_new_tokens=20)[0, p.size:]
+                for p in prompts]
+        hs = [eng.submit(p, max_new_tokens=20) for p in prompts]
+        eng.run_until_idle()
+        for ref, h in zip(refs, hs):
+            np.testing.assert_array_equal(ref, np.asarray(h.tokens))
+        assert eng.metrics.preempted >= 1
+        assert eng.spec_compiled_counts() == (1, 0, 1, 0)
+
+    def test_live_spec_compare_reproduces_the_direction(self):
+        """The committed spec-pair regime re-run end to end (the
+        TestLiveRatioSweep analog): interleaved trials, medians —
+        the speculative side must not lose tokens/s, and its dispatch
+        density must be near the k+1 ceiling."""
+        import statistics
+        from tools import loadgen
+        from singa_tpu.serve.metrics import ServeMetrics
+
+        m = loadgen._build_model()
+        engines = {}
+        for k in (0, 7):
+            spec = {"draft_model": m, "spec_k": k} if k else {}
+            e = ServeEngine(m, 1, 64, block_size=8, max_queue=48, **spec)
+            e.submit(np.arange(1, 7, dtype=np.int32), max_new_tokens=4)
+            e.run_until_idle()
+            engines[k] = e
+        res = {0: [], 7: []}
+        for _ in range(5):
+            for k, e in engines.items():
+                e.metrics = ServeMetrics(flight=e.flight)
+                wl = loadgen.build_workload(
+                    24, 2000.0, 0, prompt_lens=(4, 6, 8),
+                    new_tokens=(40,), tenants=0, shared_len=0)
+                p = loadgen.run_load(e, wl, deadline_s=300.0)
+                res[k].append(p["tokens_per_s"])
+        plain = statistics.median(res[0])
+        spec = statistics.median(res[7])
+        assert spec > plain, (plain, spec)
+        snap = engines[7].metrics.snapshot()
+        assert snap["tokens_per_dispatch"] > 6.0

@@ -7,11 +7,12 @@
 #
 #   stage 1  full audit   `python -m tools.lint`            exit 10
 #            (static SGL rules + HLO structure gate + cost gate over
-#             the SIX flagship programs — train_step, train_step_dp2,
+#             the SEVEN flagship programs — train_step, train_step_dp2,
 #             train_step_dp2_int8 (the int8-ring wire-bytes win,
 #             COST005-gated vs the f32 DP baseline), prefill_chunk,
-#             decode, handoff_gather (the disagg tier's KV handoff
-#             source) — one shared lowering, tools/lint/{rules,hlo,cost}.py)
+#             decode, verify (the speculative verify-k round), and
+#             handoff_gather (the disagg tier's KV handoff source) —
+#             one shared lowering, tools/lint/{rules,hlo,cost}.py)
 #   stage 2  records      `python -m tools.lint --records`  exit 11
 #            (telemetry/record store validation incl. the extended
 #             hlo_audit cost numerics, the wire-byte pair on
@@ -24,7 +25,11 @@
 #            exit 13 (a tiny 1:1 prefill/decode tier serves 8 requests
 #             with greedy streams asserted IDENTICAL to a single-engine
 #             ServeEngine run — the KV handoff path end to end)
-#   stage 5  tier-1 tests  the ROADMAP.md tier-1 command     exit 20
+#   stage 5  spec smoke   `python -m tools.loadgen --spec-smoke`
+#            exit 14 (self-speculation verify-k streams asserted
+#             IDENTICAL to generate() and a plain engine, accept rate
+#             asserted 1.0 — the speculative decode path end to end)
+#   stage 6  tier-1 tests  the ROADMAP.md tier-1 command     exit 20
 #
 # Exit 0 = every stage green.  Intentional compiled-program changes are
 # re-baselined first via `python -m tools.lint --hlo --update-baselines`
@@ -32,21 +37,24 @@
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
-echo "== ci_gate stage 1/5: full audit (static + HLO structure + cost) =="
+echo "== ci_gate stage 1/6: full audit (static + HLO structure + cost) =="
 JAX_PLATFORMS=cpu python -m tools.lint || exit 10
 
-echo "== ci_gate stage 2/5: record validation =="
+echo "== ci_gate stage 2/6: record validation =="
 JAX_PLATFORMS=cpu python -m tools.lint --records || exit 11
 
-echo "== ci_gate stage 3/5: obsq SLO smoke (trace-derived vs committed fixture) =="
+echo "== ci_gate stage 3/6: obsq SLO smoke (trace-derived vs committed fixture) =="
 JAX_PLATFORMS=cpu python -m tools.obsq slo --check \
     --records tests/data/obsq/records.jsonl \
     --events tests/data/obsq/events.jsonl || exit 12
 
-echo "== ci_gate stage 4/5: disagg smoke (1:1 tier streams == single engine) =="
+echo "== ci_gate stage 4/6: disagg smoke (1:1 tier streams == single engine) =="
 JAX_PLATFORMS=cpu python -m tools.loadgen --disagg-smoke || exit 13
 
-echo "== ci_gate stage 5/5: tier-1 test suite (ROADMAP.md budget) =="
+echo "== ci_gate stage 5/6: spec smoke (self-speculation streams == generate()) =="
+JAX_PLATFORMS=cpu python -m tools.loadgen --spec-smoke || exit 14
+
+echo "== ci_gate stage 6/6: tier-1 test suite (ROADMAP.md budget) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

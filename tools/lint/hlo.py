@@ -71,13 +71,18 @@ BASELINE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #: gradient sync, whose committed COST005 wire_bytes baseline proves
 #: (and permanently gates) the >=3x wire reduction vs train_step_dp2's
 #: f32 collectives; prefill_chunk / decode are the serve engine's
-#: exactly-two programs; handoff_gather is the engine's optional THIRD
-#: program — the disaggregated tier's KV handoff source (one slot's
-#: dense per-layer view through its block-table row; no donation by
-#: design, so a failed handoff leaves the source arena valid).
+#: exactly-two programs; verify is the SPECULATIVE engine's third
+#: program (serve/spec.py: k+1 draft propose steps + one k+1-token
+#: target verify in a single dispatch, both arenas donated — lowered
+#: from a self-speculation engine at spec_k=2, which carries the same
+#: structure as any draft at the audited tiny config); handoff_gather
+#: is the engine's optional program for the disaggregated tier's KV
+#: handoff source (one slot's dense per-layer view through its
+#: block-table row; no donation by design, so a failed handoff leaves
+#: the source arena valid).
 FLAGSHIP_PROGRAMS = ("train_step", "train_step_dp2",
                      "train_step_dp2_int8", "prefill_chunk", "decode",
-                     "handoff_gather")
+                     "verify", "handoff_gather")
 
 #: summary format version — bump on incompatible metric changes; a
 #: baseline with another version fails the gate (HLO001) instead of
@@ -620,10 +625,16 @@ def lower_train_step(dp: bool = False, fused_loss: bool = True,
         parallel.set_mesh(saved_mesh)
 
 
-def _lower_serve_programs() -> Dict[str, str]:
+def _lower_serve_programs(want_verify: bool = True) -> Dict[str, str]:
     """Optimized-HLO texts of the serve engine's exactly-two programs
     plus the optional handoff gather (tiny Llama, 2 slots) via
-    ``ServeEngine.lower_programs()``."""
+    ``ServeEngine.lower_programs()`` — and, from a SECOND, speculative
+    engine (self-speculation draft at spec_k=2), the ``verify``
+    program.  The plain engine stays the source of the
+    prefill/decode/handoff baselines (a spec engine's prefill also
+    writes the draft arena, which would be a different audited
+    module), and only verify is compiled from the spec engine, so each
+    flagship program is still lowered exactly once."""
     _ensure_cpu_backend()
     import numpy as np
     from singa_tpu import models, tensor
@@ -640,6 +651,12 @@ def _lower_serve_programs() -> Dict[str, str]:
              for name, lowered in eng.lower_programs().items()}
     # lowering must never have touched the engine's own executables
     assert_program_count(eng, (0, 0))
+    if want_verify:
+        spec_eng = ServeEngine(m, num_slots=2, max_len=16, block_size=8,
+                               draft_model=m, spec_k=2)
+        lowered = spec_eng.lower_programs(names=("verify",))
+        texts["verify"] = lowered["verify"].compile().as_text()
+        assert spec_eng.spec_compiled_counts() == (0, 0, 0, 0)
     return texts
 
 
@@ -661,9 +678,9 @@ def lower_flagship_texts(programs: Optional[Iterable[str]] = None
     if "train_step_dp2_int8" in wanted:
         texts["train_step_dp2_int8"] = lower_train_step(
             compression="int8_ring")
-    serve_names = ("prefill_chunk", "decode", "handoff_gather")
+    serve_names = ("prefill_chunk", "decode", "verify", "handoff_gather")
     if any(name in wanted for name in serve_names):
-        serve = _lower_serve_programs()
+        serve = _lower_serve_programs(want_verify="verify" in wanted)
         for name in serve_names:
             if name in wanted:
                 texts[name] = serve[name]

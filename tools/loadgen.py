@@ -18,6 +18,13 @@ shed when the offered rate exceeds capacity.
     python -m tools.loadgen --ratio-sweep 3:1,2:2,1:3 --rate 40
     python -m tools.loadgen --disagg-smoke     # CI: tier == engine
 
+    # speculative decoding (ISSUE 13): verify-k through a
+    # self-speculation draft; --spec-compare commits the plain-vs-spec
+    # serve_load pair (shared spec_pair_id, interleaved-median trials)
+    python -m tools.loadgen --spec-k 4 --new-tokens 32
+    python -m tools.loadgen --spec-compare --num-slots 1 --spec-k 7
+    python -m tools.loadgen --spec-smoke       # CI: spec == generate()
+
 The run drives ``ServeEngine.step()`` directly (arrivals are submitted
 the tick their timestamp passes; ``QueueFull`` rejections count as
 overload outcomes, not errors) and reports SLO percentiles from the
@@ -160,6 +167,13 @@ def run_load(engine, workload: List[_Arrival], *,
         "ttft_p50_ms": round(ttft.get("p50", 0.0), 3),
         "ttft_p99_ms": round(ttft.get("p99", 0.0), 3),
     }
+    if snap.get("accept_rate") is not None:
+        # speculative engine/tier: the pair joins the headline (schema
+        # both-or-neither contract, _SPEC_FIELDS) — accept rate plus the
+        # tokens-per-dispatch density the spec path exists to raise
+        payload["accept_rate"] = round(snap["accept_rate"], 4)
+        payload["tokens_per_dispatch"] = round(
+            snap["tokens_per_dispatch"] or 0.0, 3)
     payload["detail"] = {
         "wall_s": round(wall, 3),
         "generated_tokens": tokens,
@@ -173,6 +187,8 @@ def run_load(engine, workload: List[_Arrival], *,
         "token_p50_ms": round((snap["token_ms"] or {}).get("p50", 0.0),
                               3),
         "router_faults": router_faults,
+        "spec_rounds": int(snap.get("spec_rounds", 0)),
+        "spec_fallbacks": int(snap.get("spec_fallbacks", 0)),
     }
     tier = getattr(engine, "tier_stats", None)
     if tier is not None:
@@ -188,10 +204,17 @@ def run_load(engine, workload: List[_Arrival], *,
     return payload
 
 
-def append_record(payload: dict, store: Optional[str] = None) -> str:
+def append_record(payload: dict, store: Optional[str] = None,
+                  prefix: str = "load") -> str:
     """Write the headline (schema-required fields + numeric extras;
     the ``detail`` sub-dict stays out of the durable record) as a
-    ``serve_load`` entry.  Returns the store path."""
+    ``serve_load`` entry.  Returns the store path.
+
+    ``prefix`` must DIFFER between two appends from the same process in
+    the same second: the store keys entries by ``(run_id, platform,
+    smoke)`` and ``new_run_id``'s timestamp has second resolution, so
+    back-to-back same-prefix appends (the --spec-compare pair) would
+    silently overwrite each other."""
     import jax
 
     from singa_tpu.obs import record as obs_record
@@ -205,11 +228,19 @@ def append_record(payload: dict, store: Optional[str] = None) -> str:
     entry = obs_record.new_entry(
         "serve_load", platform, platform != "tpu",
         getattr(dev, "device_kind", "") or platform,
-        run_id=obs_record.new_run_id("load"), payload=body)
+        run_id=obs_record.new_run_id(prefix), payload=body)
     schema.validate_entry(entry)           # fail before touching disk
     store = store or os.path.join(_REPO, obs_record.DEFAULT_STORE)
     obs_record.RunRecord(store).append(entry)
     return store
+
+
+def _spec_kwargs(spec_k, model):
+    """The ServeEngine speculative kwargs for ``--spec-k`` — ONE place
+    parameterizes every engine/tier/template builder (self-speculation
+    draft; a template built differently from its workers would only
+    surface at programs= validation time)."""
+    return {"draft_model": model, "spec_k": spec_k} if spec_k else {}
 
 
 def _build_model():
@@ -225,16 +256,20 @@ def _build_model():
 def _build_tier(model, n_prefill: int, n_decode: int, args, store,
                 template=None):
     """A Router over N + M same-config workers (sharing ``template``'s
-    compiled programs when given, so a ratio sweep compiles once)."""
+    compiled programs when given, so a ratio sweep compiles once).
+    With ``--spec-k`` the whole tier carries the (self-speculation)
+    draft — prefill workers write both arenas, decode workers verify."""
     from singa_tpu.serve import Router, build_pools
 
+    spec = _spec_kwargs(getattr(args, "spec_k", 0), model)
     pw, dw = build_pools(model, n_prefill, n_decode, template=template,
                          num_slots=args.num_slots, max_len=args.max_len,
                          block_size=args.block_size,
                          num_blocks=args.num_blocks,
                          share_prefix=not args.no_share,
+                         max_queue=args.max_queue,
                          backoff_base=0.005, backoff_max=0.05,
-                         max_recoveries=100, record_store=store)
+                         max_recoveries=100, record_store=store, **spec)
     return Router(pw, dw, tenant_quota=args.tenant_quota,
                   record_store=store)
 
@@ -302,6 +337,133 @@ def disagg_smoke() -> int:
     return 0
 
 
+def spec_smoke() -> int:
+    """The CI gate's speculative-decoding stage: the same 8 prompts
+    decoded three ways — ``generate()``, a plain engine, and a
+    self-speculation engine (draft == target, spec_k=3) — must produce
+    IDENTICAL greedy streams, and self-speculation must accept every
+    proposal (the identity end of the correctness envelope; the
+    adversarial end lives in tests/test_spec.py).  One cheap command:
+    ``python -m tools.loadgen --spec-smoke``."""
+    from singa_tpu.serve import ServeEngine
+
+    m = _build_model()
+    rng = np.random.RandomState(13)
+    prompts = [rng.randint(0, m.cfg.vocab_size, (int(n),)).astype(np.int32)
+               for n in (4, 6, 9, 12, 5, 7, 10, 8)]
+    plain = ServeEngine(m, num_slots=4, max_len=32, block_size=8)
+    ref = [plain.submit(p, max_new_tokens=6) for p in prompts]
+    plain.run_until_idle()
+    ref_toks = [h.tokens for h in ref]
+    gen = m.generate(prompts[0][None], max_new_tokens=6)[0,
+                                                         prompts[0].size:]
+    if list(map(int, gen)) != ref_toks[0]:
+        print("spec-smoke: FAIL — plain engine drifted from generate()",
+              file=sys.stderr)
+        return 1
+    spec = ServeEngine(m, num_slots=4, max_len=32, block_size=8,
+                       draft_model=m, spec_k=3)
+    got = [spec.submit(p, max_new_tokens=6) for p in prompts]
+    spec.run_until_idle()
+    got_toks = [h.tokens for h in got]
+    if got_toks != ref_toks:
+        for i, (a, b) in enumerate(zip(ref_toks, got_toks)):
+            if a != b:
+                print(f"spec-smoke: FAIL — request {i} diverged: "
+                      f"plain={a} spec={b}", file=sys.stderr)
+        return 1
+    snap = spec.metrics.snapshot()
+    if snap["accept_rate"] != 1.0:
+        print(f"spec-smoke: FAIL — self-speculation accept_rate "
+              f"{snap['accept_rate']} != 1.0 (the draft IS the target; "
+              f"anything rejected means the verify window diverged "
+              f"from sequential decode)", file=sys.stderr)
+        return 1
+    print(f"spec-smoke: OK — {len(prompts)} streams identical "
+          f"(generate == plain == spec_k=3), accept_rate 1.0, "
+          f"{snap['tokens_per_dispatch']:.2f} tokens/dispatch")
+    return 0
+
+
+def spec_compare(args, store, trials: int = 3) -> int:
+    """``--spec-compare``: the SAME Poisson workload through a plain
+    engine and a self-speculation verify-k engine (the PR 12-era
+    baseline vs ISSUE 13), one ``serve_load`` record each, paired by a
+    shared ``spec_pair_id`` — the committed pair is the frozen evidence
+    tier-1 asserts the end-to-end tokens/s win from
+    (tests/test_spec.py, same contract as the ratio-sweep records).
+
+    Trials are INTERLEAVED (plain, spec, plain, spec, ...) and each
+    side records its median-tokens/s run: single back-to-back passes on
+    a shared CPU box drift by more than the effect under measurement,
+    and an interleaved median is evidence where an A-then-B pair is
+    weather."""
+    from singa_tpu.obs import record as obs_record
+    from singa_tpu.serve import ServeEngine
+    from singa_tpu.serve.metrics import ServeMetrics
+
+    m = _build_model()
+    new_tokens = tuple(int(t) for t in args.new_tokens.split(",")
+                       if t.strip())
+    prompt_lens = tuple(int(t) for t in args.prompt_lens.split(",")
+                        if t.strip())
+    pair_id = obs_record.new_run_id("specpair")
+    variants = (0, args.spec_k or 3)
+    engines = {}
+    for spec_k in variants:
+        spec = _spec_kwargs(spec_k, m)
+        eng = ServeEngine(m, args.num_slots, args.max_len,
+                          block_size=args.block_size,
+                          num_blocks=args.num_blocks,
+                          share_prefix=not args.no_share,
+                          max_queue=args.max_queue,
+                          backoff_base=0.005, backoff_max=0.05,
+                          max_recoveries=100, record_store=store, **spec)
+        # warm the programs so neither side pays a mid-run compile
+        eng.submit(build_workload(1, 1.0, args.seed + 1,
+                                  vocab=m.cfg.vocab_size)[0].prompt,
+                   max_new_tokens=2)
+        eng.run_until_idle()
+        engines[spec_k] = eng
+    runs = {spec_k: [] for spec_k in variants}
+    for trial in range(max(1, trials)):
+        for spec_k in variants:
+            eng = engines[spec_k]
+            eng.metrics = ServeMetrics(flight=eng.flight)
+            wl = build_workload(args.requests, args.rate, args.seed,
+                                prompt_lens=prompt_lens,
+                                new_tokens=new_tokens,
+                                tenants=args.tenants,
+                                shared_len=args.shared_prefix,
+                                vocab=m.cfg.vocab_size)
+            runs[spec_k].append(run_load(eng, wl,
+                                         deadline_s=args.deadline))
+    rows = []
+    for seq, spec_k in enumerate(variants):
+        ordered = sorted(runs[spec_k], key=lambda p: p["tokens_per_s"])
+        payload = ordered[len(ordered) // 2]       # median trial
+        payload["spec_pair_id"] = pair_id
+        payload["spec_seq"] = seq
+        payload["spec_k"] = spec_k
+        payload["spec_trials"] = len(ordered)
+        rows.append(payload)
+        print(f"# {'spec_k=' + str(spec_k) if spec_k else 'plain'}  "
+              f"tokens/s={payload['tokens_per_s']} (median of "
+              f"{len(ordered)})  ttft_p99={payload['ttft_p99_ms']} ms"
+              + (f"  accept_rate={payload['accept_rate']}"
+                 f"  tokens/dispatch={payload['tokens_per_dispatch']}"
+                 if spec_k else ""), file=sys.stderr)
+        print(json.dumps(payload, indent=2))
+        if store is not None:
+            append_record(payload, store,
+                          prefix=f"load-spec{spec_k}")
+    plain_tps, spec_tps = (r["tokens_per_s"] for r in rows)
+    print(f"# spec vs plain tokens/s: {spec_tps} vs {plain_tps} "
+          f"({spec_tps / plain_tps:.2f}x, pair {pair_id})",
+          file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="open-loop Poisson traffic through the paged "
@@ -324,7 +486,15 @@ def main(argv=None) -> int:
                     help="comma-separated generation-budget mix drawn "
                          "per request (generation-heavy mixes sharpen "
                          "the decode-side of a ratio sweep)")
+    ap.add_argument("--prompt-lens", default="6,10,16,24",
+                    help="comma-separated private-suffix prompt-length "
+                         "mix (short prompts + long generations isolate "
+                         "the decode path a --spec-k comparison is "
+                         "about)")
     ap.add_argument("--num-slots", type=int, default=8)
+    ap.add_argument("--max-queue", type=int, default=None,
+                    help="admission-queue capacity (default: the "
+                         "engine's 2*num_slots)")
     ap.add_argument("--max-len", type=int, default=64)
     ap.add_argument("--block-size", type=int, default=8)
     ap.add_argument("--num-blocks", type=int, default=None)
@@ -351,10 +521,28 @@ def main(argv=None) -> int:
                     help="CI smoke: 1:1 tier streams asserted "
                          "identical to a single engine (8 requests); "
                          "exits non-zero on divergence")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="speculative decoding: propose/verify k "
+                         "tokens per round through a self-speculation "
+                         "draft (0 = plain decode)")
+    ap.add_argument("--spec-compare", action="store_true",
+                    help="run the SAME workload through a plain and a "
+                         "speculative engine, one serve_load record "
+                         "each paired by spec_pair_id — the committed "
+                         "tokens/s-win evidence")
+    ap.add_argument("--spec-smoke", action="store_true",
+                    help="CI smoke: self-speculation streams asserted "
+                         "identical to generate() and a plain engine, "
+                         "accept rate asserted 1.0; exits non-zero on "
+                         "divergence")
     args = ap.parse_args(argv)
 
     if args.disagg_smoke:
         return disagg_smoke()
+    if args.spec_smoke:
+        return spec_smoke()
+    if args.spec_k < 0:
+        ap.error("--spec-k must be >= 0")
 
     from singa_tpu.obs import record as obs_record
     from singa_tpu.serve import ServeEngine
@@ -366,20 +554,28 @@ def main(argv=None) -> int:
     store = (None if args.no_record else
              args.store or os.path.join(_REPO, obs_record.DEFAULT_STORE))
 
+    if args.spec_compare:
+        return spec_compare(args, store)
+
     m = _build_model()
     new_tokens = tuple(int(t) for t in args.new_tokens.split(",")
                        if t.strip())
+    prompt_lens = tuple(int(t) for t in args.prompt_lens.split(",")
+                        if t.strip())
 
     if args.ratio_sweep:
         points = parse_ratios(args.ratio_sweep)
         # every point's tier shares ONE template engine's compiled
         # programs, so the sweep pays one compile no matter how many
         # ratios it visits — and a shared sweep_id groups the points
-        # for the direction assertion in tests/test_disagg.py
+        # for the direction assertion in tests/test_disagg.py.  The
+        # template must carry the same draft/spec_k the workers get:
+        # programs= sharing validates draft identity
+        spec = _spec_kwargs(args.spec_k, m)
         template = ServeEngine(m, args.num_slots, args.max_len,
                                block_size=args.block_size,
                                num_blocks=args.num_blocks,
-                               share_prefix=not args.no_share)
+                               share_prefix=not args.no_share, **spec)
         # warm every program (incl. the lazily-compiled handoff
         # gather) through a throwaway 1:1 tier, so the first sweep
         # point does not pay a mid-run compile the others skip
@@ -394,6 +590,7 @@ def main(argv=None) -> int:
             tier = _build_tier(m, n, mdec, args, store,
                                template=template)
             wl = build_workload(args.requests, args.rate, args.seed,
+                                prompt_lens=prompt_lens,
                                 new_tokens=new_tokens,
                                 tenants=args.tenants,
                                 shared_len=args.shared_prefix,
@@ -425,17 +622,20 @@ def main(argv=None) -> int:
             ap.error("--tenant-quota needs a tier "
                      "(--prefill-workers/--decode-workers) — a plain "
                      "engine has no tenant door")
+        spec = _spec_kwargs(args.spec_k, m)
         eng = ServeEngine(m, args.num_slots, args.max_len,
                           block_size=args.block_size,
                           num_blocks=args.num_blocks,
                           share_prefix=not args.no_share,
+                          max_queue=args.max_queue,
                           backoff_base=0.005, backoff_max=0.05,
                           # a chaos soak may recover many times; the
                           # engine-default budget of 2 is tuned for unit
                           # scenarios, not sustained injection
                           max_recoveries=100,
-                          record_store=store)
+                          record_store=store, **spec)
     wl = build_workload(args.requests, args.rate, args.seed,
+                        prompt_lens=prompt_lens,
                         new_tokens=new_tokens,
                         tenants=args.tenants,
                         shared_len=args.shared_prefix,
