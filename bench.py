@@ -402,7 +402,28 @@ def bench_llama_generate(dev, on_tpu: bool) -> None:
         "ms_per_token": round(dt / N * 1e3, 2)})
 
 
-def bench_serve(dev, on_tpu: bool) -> None:
+def _serve_knobs(model, platform: str, defaults: dict) -> dict:
+    """Table-resolved serve-arena knobs (ISSUE 14): explicit env
+    overrides (``SINGA_BENCH_NUM_SLOTS`` / ``SINGA_BENCH_BLOCK_SIZE``,
+    same style as ``SINGA_BENCH_LLAMA_BATCH``) win, then the committed
+    best-config table's entry for this (model, platform), then the
+    bench's own hand-carried ``defaults`` — announced loudly once by
+    the table layer when no committed entry decides."""
+    from singa_tpu.autotune import table as autotune_table
+
+    explicit = {}
+    for knob, env in (("num_slots", "SINGA_BENCH_NUM_SLOTS"),
+                      ("block_size", "SINGA_BENCH_BLOCK_SIZE")):
+        raw = os.environ.get(env)
+        explicit[knob] = int(raw) if raw else None
+    knobs = autotune_table.resolve(
+        "serve", autotune_table.model_key(model), platform, explicit,
+        defaults=defaults)
+    return {"num_slots": int(knobs["num_slots"]),
+            "block_size": int(knobs["block_size"])}
+
+
+def bench_serve(dev, on_tpu: bool, record: bool = True) -> None:
     """serve_throughput: a mixed prompt-length request stream through
     the continuous-batching ServeEngine vs the same stream served as
     sequential GenerateMixin.generate calls (ISSUE 2 acceptance: >=1.5x
@@ -451,17 +472,21 @@ def bench_serve(dev, on_tpu: bool) -> None:
         num_slots, max_len, block_size, n_new = 12, 192, 32, 64
         plens, reps = (32, 64, 96, 128), 6
     else:
-        # serve-bench config: big enough that decode reads real weight
-        # traffic (the tiny test config is per-op-overhead bound, which
-        # under-rewards batched decode), small enough to stay in budget
-        cfg = models.LlamaConfig(
-            vocab_size=1024, dim=256, num_layers=4, num_heads=8,
-            num_kv_heads=4, ffn_dim=688, max_position=128)
+        # serve-bench config (models/llama.py serve_bench: shared with
+        # the autotune serve sweep so the committed best-config entry
+        # keys to the same architecture this bench resolves)
+        cfg = models.LlamaConfig.serve_bench()
         num_slots, max_len, block_size, n_new = 12, 48, 8, 24
         # 24 requests over 12 slots: two full occupancy waves
         plens, reps = (6, 10, 12, 16), 6
     m = models.Llama(cfg)
     m.eval()
+    # arena knobs resolve through the committed best-config table
+    # (explicit env overrides win; the hardcoded pair above is the
+    # loud-once fallback when no table entry covers this model)
+    kn = _serve_knobs(m, "tpu" if on_tpu else "cpu",
+                      {"num_slots": num_slots, "block_size": block_size})
+    num_slots, block_size = kn["num_slots"], kn["block_size"]
     prompts = [np.random.randint(0, cfg.vocab_size, (p,)).astype(np.int32)
                for p in plens for _ in range(reps)]
     m.compile([tensor.from_numpy(prompts[0][None])], is_train=False,
@@ -613,8 +638,9 @@ def bench_serve(dev, on_tpu: bool) -> None:
         raise AssertionError(
             f"{mismatched}/{len(prompts)} engine outputs diverged from "
             f"GenerateMixin.generate greedy decode")
-    _record_serve(payload, "tpu" if on_tpu else "cpu",
-                  getattr(dev, "device_kind", "") or dev.platform)
+    if record:
+        _record_serve(payload, "tpu" if on_tpu else "cpu",
+                      getattr(dev, "device_kind", "") or dev.platform)
 
 
 def _record_serve(payload: dict, platform: str, device_kind: str) -> None:
@@ -1130,7 +1156,9 @@ def _record_hlo_audit() -> None:
 def _serve_only_main() -> None:
     """`python bench.py --serve`: run ONLY the serve_throughput bench on
     the current backend (CPU unless a TPU resolved) — the quick check of
-    the ISSUE-2 acceptance numbers without the full orchestrator."""
+    the ISSUE-2 acceptance numbers without the full orchestrator.
+    `--no-record` skips the store append (the CI gate's table-resolved
+    smoke must not dirty the committed store on every run)."""
     import jax
 
     dev = jax.devices()[0]
@@ -1140,7 +1168,7 @@ def _serve_only_main() -> None:
     parallel.set_mesh(None)
     device.set_default_device(device.create_tpu_device() if on_tpu
                               else device.create_cpu_device())
-    bench_serve(dev, on_tpu)
+    bench_serve(dev, on_tpu, record="--no-record" not in sys.argv)
 
 
 if __name__ == "__main__":

@@ -113,6 +113,18 @@ class LlamaConfig:
                            max_position=128, rope_theta=10000.0)
 
     @staticmethod
+    def serve_bench() -> "LlamaConfig":
+        """The CPU serve-bench config (bench.py bench_serve and the
+        autotune serve sweep share it — the table entry the bench
+        resolves must come from a sweep of the SAME architecture):
+        big enough that decode reads real weight traffic (the tiny
+        test config is per-op-overhead bound, which under-rewards
+        batched decode), small enough to stay in a CPU bench budget."""
+        return LlamaConfig(vocab_size=1024, dim=256, num_layers=4,
+                           num_heads=8, num_kv_heads=4, ffn_dim=688,
+                           max_position=128)
+
+    @staticmethod
     def small() -> "LlamaConfig":
         """~110M-param config for single-chip benchmarking."""
         return LlamaConfig(vocab_size=32000, dim=768, num_layers=12,

@@ -39,6 +39,7 @@ __all__ = ["SCHEMA_VERSION", "SchemaError", "require", "validate_entry",
            "validate_multichip_doc", "validate_serve_payload",
            "validate_serve_load_payload", "validate_train_run_payload",
            "validate_incident_payload", "validate_hlo_audit_payload",
+           "validate_autotune_sweep_payload",
            "validate_wire_byte_fields", "validate_flight_ref",
            "validate_serve_tier_fields", "validate_spec_fields",
            "entry_key"]
@@ -47,7 +48,7 @@ __all__ = ["SCHEMA_VERSION", "SchemaError", "require", "validate_entry",
 SCHEMA_VERSION = 1
 
 _KINDS = ("session", "bench", "serve_throughput", "serve_load",
-          "train_run", "incident", "hlo_audit")
+          "train_run", "incident", "hlo_audit", "autotune_sweep")
 
 #: required numeric payload fields of a serve_throughput entry — the
 #: serving bench's headline quantities (tools/record_check.py lints
@@ -115,6 +116,21 @@ _WIRE_BYTE_FIELDS = ("wire_bytes_compressed", "wire_bytes_f32_equiv")
 _HLO_AUDIT_FIELDS = ("programs", "drifted", "fusions", "collectives",
                      "while_loops", "flops", "hbm_bytes", "peak_bytes",
                      "wire_bytes")
+
+#: required payload fields of an autotune_sweep entry — one measured
+#: point (point >= 0) or the fit summary (point == -1) of a knob sweep
+#: (singa_tpu.autotune.sweep): which domain/model the sweep tuned,
+#: which sweep group the point belongs to, what was measured.  The
+#: ``knobs`` dict is structurally validated here (non-empty, numeric
+#: values); knob-NAME reality against the registry is the dynamic
+#: audit's job (``python -m tools.lint --records`` imports
+#: singa_tpu.autotune.knobs), keeping this module free of an
+#: autotune import cycle.  A fit record must carry ``loo_rel_err`` —
+#: a committed best config without its trustworthiness number is a
+#: vibe, which is exactly what ISSUE 14 bans
+_AUTOTUNE_STR_FIELDS = ("domain", "model", "objective_name", "sweep_id")
+_AUTOTUNE_NUM_FIELDS = ("objective", "point")
+_AUTOTUNE_DOMAINS = ("train", "serve")
 
 #: required string payload fields of an incident entry — one fired
 #: fault or recovery action (singa_tpu.faults / ServeEngine resilience):
@@ -232,6 +248,9 @@ def validate_entry(entry: Any, ctx: str = "entry") -> None:
             validate_incident_payload(payload, f"{ctx}: incident payload")
         elif kind == "hlo_audit":
             validate_hlo_audit_payload(payload, f"{ctx}: hlo_audit payload")
+        elif kind == "autotune_sweep":
+            validate_autotune_sweep_payload(
+                payload, f"{ctx}: autotune_sweep payload")
         elif kind == "bench":
             validate_wire_byte_fields(payload, f"{ctx}: bench payload")
 
@@ -342,6 +361,54 @@ def validate_hlo_audit_payload(payload: Any,
     whose counts went missing could not answer 'when did the fusion
     count change' later, which is the entire point of keeping it."""
     _require_numeric_fields(payload, _HLO_AUDIT_FIELDS, ctx)
+
+
+def validate_autotune_sweep_payload(payload: Any,
+                                    ctx: str = "autotune_sweep payload"
+                                    ) -> None:
+    """One autotune sweep point or fit summary: the string quartet
+    (``domain``/``model``/``objective_name``/``sweep_id``) non-empty
+    with a registered domain, ``objective``/``point`` numeric, and a
+    non-empty all-numeric ``knobs`` object.  A fit record (``point ==
+    -1``) must additionally carry its numeric ``loo_rel_err`` — the
+    predictor's committed trustworthiness; a measurement point
+    carrying one by accident is equally rejected (it would read as a
+    calibration claim no fit produced)."""
+    for f in _AUTOTUNE_STR_FIELDS:
+        v = require(payload, f, ctx)
+        _expect(isinstance(v, str) and v,
+                f"{ctx}: {f!r} must be a non-empty string, got {v!r}",
+                field=f)
+    _expect(payload["domain"] in _AUTOTUNE_DOMAINS,
+            f"{ctx}: 'domain' must be one of {_AUTOTUNE_DOMAINS}, got "
+            f"{payload['domain']!r}", field="domain")
+    _require_numeric_fields(payload, _AUTOTUNE_NUM_FIELDS, ctx)
+    knobs = require(payload, "knobs", ctx)
+    _expect(isinstance(knobs, dict) and bool(knobs),
+            f"{ctx}: 'knobs' must be a non-empty object, got {knobs!r}",
+            field="knobs")
+    for name, value in knobs.items():
+        _expect(isinstance(value, (int, float))
+                and not isinstance(value, bool),
+                f"{ctx}: knob {name!r} must be numeric, got {value!r}",
+                field="knobs")
+    features = payload.get("features")
+    if features is not None:
+        _expect(isinstance(features, dict),
+                f"{ctx}: 'features' must be an object, got "
+                f"{features!r}", field="features")
+        for name, value in features.items():
+            _expect(isinstance(value, (int, float))
+                    and not isinstance(value, bool),
+                    f"{ctx}: feature {name!r} must be numeric, got "
+                    f"{value!r}", field="features")
+    if int(payload["point"]) == -1:
+        _require_numeric_fields(payload, ("loo_rel_err",), ctx)
+    else:
+        _expect("loo_rel_err" not in payload,
+                f"{ctx}: 'loo_rel_err' belongs to the fit record "
+                f"(point == -1), not a measurement point",
+                field="loo_rel_err")
 
 
 def validate_incident_payload(payload: Any,

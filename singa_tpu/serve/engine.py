@@ -217,12 +217,21 @@ class ServeEngine:
                  record_store: Optional[str] = None,
                  run_id: Optional[str] = None,
                  programs: Optional[SharedPrograms] = None,
-                 draft_model=None, spec_k: int = 0,
+                 draft_model=None, spec_k: Optional[int] = None,
                  _sleep: Callable[[float], None] = time.sleep):
         self.model = model
         # speculative decoding (serve/spec.py): a draft model turns the
         # per-tick decode into a verify-k round — k proposals + the
-        # pending token scored by ONE target dispatch
+        # pending token scored by ONE target dispatch.  spec_k=None
+        # with a draft resolves the window depth from the committed
+        # best-config table (ISSUE 14 / ROADMAP item 2b: the table's k
+        # comes from measured accept_rate / tokens_per_dispatch
+        # records); an explicit integer always wins
+        if draft_model is not None and spec_k is None:
+            from ..autotune import table as autotune_table
+            spec_k = autotune_table.resolve_spec_k(model)
+        if spec_k is None:
+            spec_k = 0
         if (draft_model is None) != (spec_k == 0):
             raise ValueError(
                 "speculative decoding needs BOTH draft_model and "

@@ -1,0 +1,632 @@
+"""The record-driven autotuner (ISSUE 14): knob registry, predictor,
+sweep records, best-config table, consumer resolution, and the
+committed frozen evidence.
+
+Everything here is host-only — no jit compiles (the one ServeEngine
+construction resolves spec_k before any program exists), per ROADMAP
+item 6's tier-1 budget."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+from singa_tpu.autotune import knobs as at_knobs           # noqa: E402
+from singa_tpu.autotune import predictor as at_predictor   # noqa: E402
+from singa_tpu.autotune import sweep as at_sweep           # noqa: E402
+from singa_tpu.autotune import table as at_table           # noqa: E402
+from singa_tpu.obs import record as obs_record             # noqa: E402
+from singa_tpu.obs import schema                           # noqa: E402
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+#: the committed-evidence trustworthiness bound (acceptance): every
+#: committed fit record's mean leave-one-out relative error must stay
+#: under this — the frozen values are ~0.05 (tiny serve), ~0.17
+#: (serve-bench), ~0.06 (train dp2)
+LOO_BOUND = 0.25
+
+
+def _fresh_warnings():
+    """The table layer warns once per process; tests about the
+    warnings must start clean."""
+    at_table._WARNED.clear()
+
+
+def _linear_points(n_slots=(2, 4, 8), blocks=(4, 8)):
+    return [{"knobs": {"num_slots": s, "block_size": b},
+             "objective": 10.0 * s + 2.0 * b + 0.1 * s * b}
+            for s in n_slots for b in blocks]
+
+
+# ---------------------------------------------------------------------------
+# knob registry
+# ---------------------------------------------------------------------------
+
+class TestKnobs:
+    def test_grid_points_cartesian_deterministic(self):
+        pts = at_knobs.grid_points("serve", {"num_slots": [4, 8],
+                                             "block_size": [8]})
+        assert pts == [{"block_size": 8, "num_slots": 4},
+                       {"block_size": 8, "num_slots": 8}]
+
+    def test_unknown_knob_is_loud(self):
+        with pytest.raises(at_knobs.KnobError, match="unknown serve "
+                                                     "knob 'slots'"):
+            at_knobs.grid_points("serve", {"slots": [4]})
+
+    def test_unknown_domain_is_loud(self):
+        with pytest.raises(at_knobs.KnobError, match="unknown autotune "
+                                                     "domain"):
+            at_knobs.require_knobs("infer", {"num_slots": 4})
+
+    def test_bool_knob_value_rejected(self):
+        errs = at_knobs.validate_knobs("train", {"int8_ring": True})
+        assert errs and "must be numeric" in errs[0]
+
+    def test_registry_covers_the_advertised_knobs(self):
+        # the ISSUE-14 knob set, verbatim
+        assert sorted(at_knobs.KNOBS["train"]) == ["batch", "ce_chunk",
+                                                   "int8_ring"]
+        assert sorted(at_knobs.KNOBS["serve"]) == ["block_size",
+                                                   "num_slots", "spec_k"]
+
+
+# ---------------------------------------------------------------------------
+# predictor
+# ---------------------------------------------------------------------------
+
+class TestPredictor:
+    def test_fit_is_deterministic(self):
+        pts = _linear_points()
+        p1, r1 = at_predictor.fit_points("serve", pts)
+        p2, r2 = at_predictor.fit_points("serve", pts)
+        assert r1 == r2
+        q = {"num_slots": 6, "block_size": 8}
+        assert p1.predict(q) == p2.predict(q)
+
+    def test_ridge_recovers_a_near_linear_objective(self):
+        pred, report = at_predictor.fit_points("serve",
+                                               _linear_points())
+        est = pred.predict({"num_slots": 6, "block_size": 8})
+        true = 10.0 * 6 + 2.0 * 8 + 0.1 * 6 * 8
+        assert abs(est - true) / true < 0.05
+        assert report["loo_rel_err"] < 0.1
+        assert report["n"] == 6
+
+    def test_nearest_returns_a_measured_point(self):
+        pts = _linear_points()
+        pred, _ = at_predictor.fit_points("serve", pts)
+        hit = pred.nearest({"num_slots": 8, "block_size": 8})
+        assert hit["knobs"] == {"num_slots": 8, "block_size": 8}
+
+    def test_empty_store_is_loud(self):
+        with pytest.raises(ValueError, match="no 'serve' sweep points"):
+            at_predictor.fit_points("serve", [])
+
+    def test_unknown_knob_is_loud(self):
+        with pytest.raises(ValueError, match="unknown serve knob"):
+            at_predictor.fit_points(
+                "serve", [{"knobs": {"bogus": 1}, "objective": 1.0}])
+
+    def test_ragged_knob_keys_are_loud(self):
+        pts = [{"knobs": {"num_slots": 4}, "objective": 1.0},
+               {"knobs": {"num_slots": 4, "block_size": 8},
+                "objective": 2.0}]
+        with pytest.raises(ValueError, match="differ from point 0"):
+            at_predictor.fit_points("serve", pts)
+
+    def test_missing_objective_is_loud(self):
+        with pytest.raises(ValueError, match="no numeric objective"):
+            at_predictor.fit_points(
+                "serve", [{"knobs": {"num_slots": 4}},
+                          {"knobs": {"num_slots": 8}},
+                          {"knobs": {"num_slots": 12}}])
+
+    def test_two_points_report_maximal_distrust(self):
+        _, report = at_predictor.fit_points(
+            "serve", [{"knobs": {"num_slots": 2}, "objective": 1.0},
+                      {"knobs": {"num_slots": 4}, "objective": 2.0}])
+        assert report["loo_rel_err"] == 1.0
+
+    def test_best_point_respects_direction(self):
+        serve = [{"knobs": {"num_slots": s}, "objective": float(s)}
+                 for s in (2, 4, 8)]
+        assert at_predictor.best_point("serve",
+                                       serve)["knobs"]["num_slots"] == 8
+        train = [{"knobs": {"batch": b}, "objective": float(b)}
+                 for b in (2, 4, 8)]
+        assert at_predictor.best_point("train",
+                                       train)["knobs"]["batch"] == 2
+
+
+# ---------------------------------------------------------------------------
+# sweep records + schema + lint
+# ---------------------------------------------------------------------------
+
+def _fake_sweep(tmp_path, grid=None):
+    store = str(tmp_path / "runs" / "records.jsonl")
+    pts = at_knobs.grid_points("serve", grid or {"num_slots": [2, 4, 8],
+                                                 "block_size": [4, 8]})
+
+    def measure(k):
+        return 5.0 * k["num_slots"] + k["block_size"], \
+            {"wire_bytes": 100.0 * k["num_slots"]}
+
+    sid, entries = at_sweep.run_sweep(
+        "serve", "llama-d64-L2", pts, measure, store,
+        platform="cpu", device="cpu")
+    return store, sid, entries
+
+
+class TestSweepStore:
+    def test_run_sweep_appends_validated_group(self, tmp_path):
+        store, sid, entries = _fake_sweep(tmp_path)
+        assert len(entries) == 6
+        assert obs_record.RunRecord(store).validate() == []
+        _, pts, fit = at_sweep.sweep_points_from_store(store, "serve")
+        assert [p["point"] for p in pts] == list(range(6))
+        assert all(p["sweep_id"] == sid for p in pts)
+        assert fit is None
+
+    def test_fit_record_round_trip(self, tmp_path):
+        store, sid, _ = _fake_sweep(tmp_path)
+        _, pts, _ = at_sweep.sweep_points_from_store(store, "serve")
+        pred, report = at_predictor.fit_points("serve", pts)
+        best = at_predictor.best_point("serve", pts)
+        at_sweep.append_fit(store, domain="serve", model="llama-d64-L2",
+                            platform="cpu", device="cpu", sweep_id=sid,
+                            best=best, report=report)
+        assert obs_record.RunRecord(store).validate() == []
+        _, pts2, fit = at_sweep.sweep_points_from_store(store, "serve")
+        assert len(pts2) == 6
+        assert fit is not None
+        assert fit["loo_rel_err"] == report["loo_rel_err"]
+        assert fit["knobs"] == best["knobs"]
+
+    def test_empty_store_is_loud(self, tmp_path):
+        store = str(tmp_path / "records.jsonl")
+        with pytest.raises(LookupError, match="no 'serve' "
+                                              "autotune_sweep records"):
+            at_sweep.sweep_points_from_store(store, "serve")
+
+    def test_unknown_sweep_id_is_loud(self, tmp_path):
+        store, _, _ = _fake_sweep(tmp_path)
+        with pytest.raises(LookupError, match="nope"):
+            at_sweep.sweep_points_from_store(store, "serve",
+                                             sweep_id="nope")
+
+    def test_schema_rejects_point_with_loo(self):
+        with pytest.raises(schema.SchemaError,
+                           match="belongs to the fit record"):
+            obs_record.new_entry(
+                "autotune_sweep", "cpu", True, "cpu",
+                payload={"domain": "serve", "model": "m",
+                         "objective_name": "tokens_per_s",
+                         "sweep_id": "s", "point": 0, "objective": 1.0,
+                         "knobs": {"num_slots": 4},
+                         "loo_rel_err": 0.1})
+
+    def test_schema_requires_loo_on_fit_record(self):
+        with pytest.raises(schema.SchemaError, match="loo_rel_err"):
+            obs_record.new_entry(
+                "autotune_sweep", "cpu", True, "cpu",
+                payload={"domain": "serve", "model": "m",
+                         "objective_name": "tokens_per_s",
+                         "sweep_id": "s", "point": -1,
+                         "objective": 1.0,
+                         "knobs": {"num_slots": 4}})
+
+    def test_schema_rejects_unregistered_domain(self):
+        with pytest.raises(schema.SchemaError, match="domain"):
+            obs_record.new_entry(
+                "autotune_sweep", "cpu", True, "cpu",
+                payload={"domain": "infer", "model": "m",
+                         "objective_name": "x", "sweep_id": "s",
+                         "point": 0, "objective": 1.0,
+                         "knobs": {"num_slots": 4}})
+
+    def test_records_audit_flags_unregistered_knob_name(self, tmp_path):
+        """The schema checks knob SHAPE; `tools.lint --records` checks
+        knob NAMES against the registry — a typo'd knob in a committed
+        record must fail CI, not fit a predictor on noise."""
+        from tools.lint import audit
+
+        store = str(tmp_path / "runs" / "records.jsonl")
+        entry = obs_record.new_entry(
+            "autotune_sweep", "cpu", True, "cpu",
+            payload={"domain": "serve", "model": "m",
+                     "objective_name": "tokens_per_s", "sweep_id": "s",
+                     "point": 0, "objective": 1.0,
+                     "knobs": {"slots": 4}})
+        obs_record.RunRecord(store).append(entry)
+        errors = audit._check_autotune(str(tmp_path), store)
+        assert errors and "unknown serve knob 'slots'" in errors[0]
+
+
+# ---------------------------------------------------------------------------
+# best-config table
+# ---------------------------------------------------------------------------
+
+def _write_table(tmp_path, run_ids=("r1",), spec_k=None, version=None):
+    knobs = {"num_slots": 12, "block_size": 8}
+    if spec_k is not None:
+        knobs["spec_k"] = spec_k
+    doc = {"schema_version": (schema.SCHEMA_VERSION if version is None
+                              else version),
+           "configs": {"serve/llama-d64-L2/cpu": {
+               "knobs": knobs, "objective_name": "tokens_per_s",
+               "objective": 100.0, "sweep_id": "s",
+               "run_id": run_ids[0], "loo_rel_err": 0.1}}}
+    path = str(tmp_path / "best.json")
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+class TestTable:
+    def test_resolution_precedence(self, tmp_path):
+        """The contract every consumer rides: explicit > table >
+        built-in default (and the fallback announces itself once)."""
+        path = _write_table(tmp_path)
+        resolved = at_table.resolve("serve", "llama-d64-L2", "cpu", {},
+                                    path=path)
+        assert resolved["num_slots"] == 12
+        forced = at_table.resolve("serve", "llama-d64-L2", "cpu",
+                                  {"num_slots": 3}, path=path)
+        assert forced["num_slots"] == 3
+        # a knob the table does not carry falls to the default
+        assert resolved["spec_k"] == at_knobs.DEFAULTS["serve"]["spec_k"]
+
+    def test_missing_table_falls_back_loudly_once(self, tmp_path,
+                                                  capsys):
+        _fresh_warnings()
+        missing = str(tmp_path / "nope.json")
+        r1 = at_table.resolve("serve", "llama-d64-L2", "cpu", {},
+                              path=missing)
+        r2 = at_table.resolve("serve", "llama-d64-L2", "cpu", {},
+                              path=missing)
+        assert r1 == r2 == {k: at_knobs.DEFAULTS["serve"][k]
+                            for k in at_knobs.DEFAULTS["serve"]}
+        err = capsys.readouterr().err
+        assert err.count("no best-config table") == 1
+
+    def test_stale_schema_version_fails_loudly(self, tmp_path):
+        path = _write_table(tmp_path, version=0)
+        errors = at_table.validate_table(json.load(open(path)))
+        assert errors and "stale" in errors[0]
+        with pytest.raises(ValueError, match="stale"):
+            at_table.load_table(path)
+
+    def test_update_table_rebuilds_over_a_stale_table(self, tmp_path):
+        """`fit --update-best` is the documented remedy the stale-table
+        error points at, so it must be able to RUN over a stale table:
+        the old doc is discarded (announced) and rebuilt fresh."""
+        _fresh_warnings()
+        path = _write_table(tmp_path, version=0)
+        with pytest.raises(ValueError, match="stale"):
+            at_table.load_table(path)
+        at_table.update_table("serve/llama-d64-L2/cpu", {
+            "knobs": {"num_slots": 4, "block_size": 8},
+            "objective_name": "tokens_per_s", "objective": 1.0,
+            "sweep_id": "s", "run_id": "r", "loo_rel_err": 0.5}, path)
+        doc = at_table.load_table(path)
+        assert doc["schema_version"] == schema.SCHEMA_VERSION
+        assert doc["configs"]["serve/llama-d64-L2/cpu"][
+            "knobs"]["num_slots"] == 4
+
+    def test_corrupt_store_does_not_blame_the_table(self, tmp_path):
+        """One malformed store line must surface as a STORE error, not
+        as spurious 'table cites a missing run_id' errors against
+        every best.json entry."""
+        from tools.lint import audit
+
+        store = tmp_path / "runs" / "records.jsonl"
+        store.parent.mkdir(parents=True)
+        store.write_text("not json\n")
+        table_dir = tmp_path / "tools" / "autotune" / "data"
+        table_dir.mkdir(parents=True)
+        _write_table(table_dir, run_ids=("whatever",))
+        errors = audit._check_autotune(str(tmp_path), str(store))
+        assert not any("does not exist in the record store" in e
+                       for e in errors)
+
+    def test_table_citing_missing_run_id_fails_records_audit(
+            self, tmp_path):
+        from tools.lint import audit
+
+        store = str(tmp_path / "runs" / "records.jsonl")
+        _, sid, entries = _fake_sweep(tmp_path,
+                                      grid={"num_slots": [2, 4],
+                                            "block_size": [8]})
+        table_dir = tmp_path / "tools" / "autotune" / "data"
+        table_dir.mkdir(parents=True)
+        _write_table(table_dir, run_ids=("ghost-run",))
+        errors = audit._check_autotune(str(tmp_path), store)
+        assert any("ghost-run" in e and "does not exist" in e
+                   for e in errors)
+        # pointing it at a real measured run clears the audit
+        _write_table(table_dir, run_ids=(entries[0]["run_id"],))
+        assert audit._check_autotune(str(tmp_path), store) == []
+
+    def test_pick_spec_k_needs_a_win_and_matches_model(self):
+        def entry(rid, pair, k, tps, tpd=None, model="llama-d64-L2"):
+            p = {"spec_pair_id": pair, "spec_k": k, "tokens_per_s": tps,
+                 "model": model}
+            if k:
+                p["accept_rate"] = 1.0
+                p["tokens_per_dispatch"] = tpd
+            return {"kind": "serve_load", "platform": "cpu",
+                    "run_id": rid, "payload": p}
+
+        entries = [entry("p0", "A", 0, 100.0),
+                   # k=3 wins END-TO-END tokens/s (1.4x) even though
+                   # k=7 has the denser dispatches (6.8 vs 3.5) — the
+                   # serve objective, not dispatch density, ranks
+                   entry("s3", "A", 3, 140.0, 3.5),
+                   entry("s7", "A", 7, 130.0, 6.8),
+                   # a LOSING spec side must not qualify
+                   entry("p1", "B", 0, 100.0),
+                   entry("s9", "B", 9, 90.0, 9.5),
+                   # another model's winning pair must not leak in
+                   entry("p2", "C", 0, 50.0, model="other"),
+                   entry("s5", "C", 5, 99.0, 4.9, model="other")]
+        picked = at_table.pick_spec_k(entries, "cpu",
+                                      model="llama-d64-L2")
+        assert picked["spec_k"] == 3 and picked["run_id"] == "s3"
+        assert picked["tokens_per_s_win"] == pytest.approx(1.4)
+        assert at_table.pick_spec_k([e for e in entries
+                                     if e["payload"]["spec_pair_id"]
+                                     == "B"],
+                                    "cpu", model="llama-d64-L2") is None
+
+    def test_resolve_spec_k_table_and_fallback(self, tmp_path,
+                                               monkeypatch):
+        class Llama:
+            pass
+
+        m = Llama()
+        m.cfg = type("Cfg", (), {"dim": 64, "num_layers": 2})()
+        assert at_table.model_key(m) == "llama-d64-L2"
+        path = _write_table(tmp_path, spec_k=5)
+        monkeypatch.setenv(at_table.ENV_TABLE, path)
+        assert at_table.resolve_spec_k(m, "cpu") == 5
+        _fresh_warnings()
+        # table advises spec_k=0 but the caller brought a draft:
+        # fall back, loudly
+        monkeypatch.setenv(at_table.ENV_TABLE,
+                           _write_table(tmp_path, spec_k=0))
+        assert at_table.resolve_spec_k(m, "cpu") == \
+            at_table.SPEC_K_FALLBACK
+
+
+# ---------------------------------------------------------------------------
+# consumers resolve through the table (overrides win — regression)
+# ---------------------------------------------------------------------------
+
+class TestConsumers:
+    def test_engine_spec_k_resolution(self, tmp_path, monkeypatch):
+        """ServeEngine(spec_k=None) resolves the verify window from
+        the committed table; an explicit spec_k always wins; no draft
+        means plain decode.  Construction only — no program compiles."""
+        from singa_tpu import models, tensor
+        from singa_tpu.serve import ServeEngine
+
+        tensor.set_seed(0)
+        m = models.Llama(models.LlamaConfig.tiny())
+        m.eval()
+        m.compile([tensor.from_numpy(np.zeros((1, 4), np.int32))],
+                  is_train=False, use_graph=False)
+        monkeypatch.setenv(at_table.ENV_TABLE,
+                           _write_table(tmp_path, spec_k=2))
+        eng = ServeEngine(m, 2, 64, block_size=8, draft_model=m)
+        assert eng.spec_k == 2
+        explicit = ServeEngine(m, 2, 64, block_size=8, draft_model=m,
+                               spec_k=4)
+        assert explicit.spec_k == 4
+        plain = ServeEngine(m, 2, 64, block_size=8)
+        assert plain.spec_k == 0
+        # the draft/spec contract is unchanged: an explicit 0 with a
+        # draft is still a loud error
+        with pytest.raises(ValueError, match="BOTH draft_model"):
+            ServeEngine(m, 2, 64, block_size=8, draft_model=m,
+                        spec_k=0)
+
+    def test_loadgen_resolution(self, tmp_path, monkeypatch):
+        import argparse
+
+        from tools import loadgen
+
+        class Llama:
+            pass
+
+        m = Llama()
+        m.cfg = type("Cfg", (), {"dim": 64, "num_layers": 2})()
+        monkeypatch.setenv(at_table.ENV_TABLE, _write_table(tmp_path))
+        args = argparse.Namespace(num_slots=None, block_size=None)
+        loadgen._resolve_serve_knobs(args, m)
+        assert (args.num_slots, args.block_size) == (12, 8)
+        # explicit CLI values win
+        args = argparse.Namespace(num_slots=5, block_size=4)
+        loadgen._resolve_serve_knobs(args, m)
+        assert (args.num_slots, args.block_size) == (5, 4)
+        # no table entry: today's constants, not a crash
+        _fresh_warnings()
+        monkeypatch.setenv(at_table.ENV_TABLE,
+                           str(tmp_path / "missing.json"))
+        args = argparse.Namespace(num_slots=None, block_size=None)
+        loadgen._resolve_serve_knobs(args, m)
+        assert (args.num_slots, args.block_size) == (
+            at_knobs.DEFAULTS["serve"]["num_slots"],
+            at_knobs.DEFAULTS["serve"]["block_size"])
+
+    def test_bench_resolution(self, tmp_path, monkeypatch):
+        import bench
+
+        class Llama:
+            pass
+
+        m = Llama()
+        m.cfg = type("Cfg", (), {"dim": 64, "num_layers": 2})()
+        monkeypatch.setenv(at_table.ENV_TABLE, _write_table(tmp_path))
+        kn = bench._serve_knobs(m, "cpu", {"num_slots": 7,
+                                           "block_size": 16})
+        assert kn == {"num_slots": 12, "block_size": 8}
+        # explicit env override wins over the table
+        monkeypatch.setenv("SINGA_BENCH_NUM_SLOTS", "6")
+        kn = bench._serve_knobs(m, "cpu", {"num_slots": 7,
+                                           "block_size": 16})
+        assert kn == {"num_slots": 6, "block_size": 8}
+        # no table: the bench's own hand-carried defaults
+        _fresh_warnings()
+        monkeypatch.delenv("SINGA_BENCH_NUM_SLOTS")
+        monkeypatch.setenv(at_table.ENV_TABLE,
+                           str(tmp_path / "missing.json"))
+        kn = bench._serve_knobs(m, "cpu", {"num_slots": 7,
+                                           "block_size": 16})
+        assert kn == {"num_slots": 7, "block_size": 16}
+
+
+# ---------------------------------------------------------------------------
+# obsq diff --sweep
+# ---------------------------------------------------------------------------
+
+class TestObsqSweep:
+    def test_sweep_rows_flatten_knobs(self, tmp_path):
+        from tools import obsq
+
+        store, sid, _ = _fake_sweep(tmp_path,
+                                    grid={"num_slots": [2, 4],
+                                          "block_size": [8]})
+        header, rows = obsq.diff_rows(store, None, sweep=sid)
+        assert "knobs.num_slots" in header
+        assert "features.wire_bytes" in header
+        assert len(rows) == 2                 # no Δ row for a sweep
+        col = header.index("knobs.num_slots")
+        assert [r[col] for r in rows] == [2, 4]
+
+    def test_unknown_sweep_is_loud(self, tmp_path):
+        from tools import obsq
+
+        store, _, _ = _fake_sweep(tmp_path,
+                                  grid={"num_slots": [2],
+                                        "block_size": [8]})
+        with pytest.raises(LookupError, match="sweep_id 'nope'"):
+            obsq.diff_rows(store, None, sweep="nope")
+
+
+# ---------------------------------------------------------------------------
+# the committed frozen evidence (acceptance)
+# ---------------------------------------------------------------------------
+
+def _committed_groups():
+    store = os.path.join(REPO, "runs", "records.jsonl")
+    groups = {}
+    for e in obs_record.RunRecord(store).entries():
+        if e["kind"] != "autotune_sweep":
+            continue
+        p = e["payload"]
+        groups.setdefault(p["sweep_id"], []).append(
+            {**p, "run_id": e["run_id"], "platform": e["platform"]})
+    return groups
+
+
+class TestCommittedEvidence:
+    def test_committed_sweeps_meet_the_floor(self):
+        """>= 6 points across >= 2 actually-VARYING knobs under one
+        sweep_id, with a fit record carrying the bounded LOO error."""
+        groups = _committed_groups()
+        assert groups, ("no committed autotune_sweep records "
+                        "(python -m tools.autotune sweep)")
+        qualifying = 0
+        for sid, rows in groups.items():
+            pts = [r for r in rows if r["point"] >= 0]
+            fits = [r for r in rows if r["point"] == -1]
+            assert len(fits) == 1, (sid, "every committed sweep "
+                                         "carries exactly one fit "
+                                         "record")
+            assert fits[0]["loo_rel_err"] <= LOO_BOUND, (
+                sid, fits[0]["loo_rel_err"])
+            varying = {k for p in pts for k, v in p["knobs"].items()
+                       if v != pts[0]["knobs"][k]}
+            if len(pts) >= 6 and len(varying) >= 2:
+                qualifying += 1
+        assert qualifying >= 1
+
+    def test_committed_table_is_the_measured_argbest(self):
+        """The acceptance core: for every committed best-config entry,
+        re-derive the argbest from the frozen sweep records and assert
+        the table matches — the table is proven, not claimed."""
+        doc = at_table.load_table(os.path.join(REPO,
+                                               at_table.DEFAULT_TABLE))
+        assert doc is not None, "no committed best-config table"
+        assert doc["schema_version"] == schema.SCHEMA_VERSION
+        groups = _committed_groups()
+        for key, entry in doc["configs"].items():
+            domain = key.split("/")[0]
+            pts = [r for r in groups[entry["sweep_id"]]
+                   if r["point"] >= 0]
+            best = at_predictor.best_point(domain, pts)
+            swept = set(pts[0]["knobs"])
+            assert {k: v for k, v in entry["knobs"].items()
+                    if k in swept} == best["knobs"], key
+            assert entry["objective"] == best["objective"], key
+            assert entry["run_id"] == best["run_id"], key
+            # the table's trustworthiness number IS the fit record's
+            fit = next(r for r in groups[entry["sweep_id"]]
+                       if r["point"] == -1)
+            assert entry["loo_rel_err"] == fit["loo_rel_err"], key
+
+    def test_committed_spec_k_comes_from_the_pair_records(self):
+        """ROADMAP item-2b acceptance: the tiny-model serve entry's
+        spec_k re-derives from the committed accept_rate /
+        tokens_per_dispatch pair records via pick_spec_k, and its
+        evidence run exists in the store."""
+        doc = at_table.load_table(os.path.join(REPO,
+                                               at_table.DEFAULT_TABLE))
+        store = os.path.join(REPO, "runs", "records.jsonl")
+        entries = obs_record.RunRecord(store).entries()
+        key = "serve/llama-d64-L2/cpu"
+        entry = doc["configs"][key]
+        picked = at_table.pick_spec_k(entries, "cpu",
+                                      model="llama-d64-L2")
+        assert picked is not None, ("no committed spec pair with a "
+                                    "tokens/s win for the tiny model")
+        assert entry["knobs"]["spec_k"] == picked["spec_k"]
+        ev = entry["spec_evidence"]
+        assert ev["run_id"] == picked["run_id"]
+        assert ev["accept_rate"] == picked["accept_rate"]
+        assert ev["tokens_per_dispatch"] == \
+            picked["tokens_per_dispatch"]
+        assert any(e["run_id"] == ev["run_id"] for e in entries)
+
+    def test_committed_fit_reproduces_from_frozen_points(self):
+        """Determinism across processes: re-fitting the committed
+        points reproduces the committed LOO error exactly."""
+        groups = _committed_groups()
+        for sid, rows in groups.items():
+            pts = [r for r in rows if r["point"] >= 0]
+            fit = next(r for r in rows if r["point"] == -1)
+            _, report = at_predictor.fit_points(fit["domain"], pts)
+            assert report["loo_rel_err"] == pytest.approx(
+                fit["loo_rel_err"], rel=1e-9), sid
+
+    def test_committed_train_sweep_carries_analytic_features(self):
+        """The measured/analytic union the ISSUE names: the committed
+        train sweep's points carry per-point cost features, and the
+        int8_ring knob moves wire_bytes exactly as the COST005 gate
+        says (72,288 vs 279,304 B)."""
+        groups = _committed_groups()
+        train = [rows for rows in groups.values()
+                 if any(r["domain"] == "train" for r in rows)]
+        assert train, "no committed train sweep"
+        for rows in train:
+            pts = [r for r in rows if r["point"] >= 0]
+            wires = {r["knobs"]["int8_ring"]:
+                     r["features"]["wire_bytes"] for r in pts}
+            assert wires[1] < wires[0], wires

@@ -256,8 +256,16 @@ class TestAdversarialDraft:
 
 class TestSpecValidation:
     def test_draft_and_k_must_come_together(self, llama):
+        # a draft with spec_k=None resolves the window from the
+        # committed best-config table (ISSUE 14 — the autotuner picks
+        # k; tests/test_autotune.py pins the resolution precedence);
+        # an explicit spec_k=0 alongside a draft is still a loud error
+        eng = ServeEngine(llama, 2, 32, block_size=8,
+                          draft_model=llama)
+        assert eng.spec_k >= 1
         with pytest.raises(ValueError, match="spec_k"):
-            ServeEngine(llama, 2, 32, block_size=8, draft_model=llama)
+            ServeEngine(llama, 2, 32, block_size=8, draft_model=llama,
+                        spec_k=0)
         with pytest.raises(ValueError, match="draft_model"):
             ServeEngine(llama, 2, 32, block_size=8, spec_k=2)
 

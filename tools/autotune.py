@@ -1,0 +1,584 @@
+"""autotune — the record-driven autotuner's front door (ISSUE 14).
+
+Closes the ROADMAP item-4 loop: the obs record store already holds
+analytic per-program cost features and a measured bench/serve
+trajectory; this CLI sweeps the knobs the repo actually exposes, fits
+a deterministic predictor on the records, and commits the best-config
+table that bench.py / ServeEngine / tools/loadgen.py consult by
+default::
+
+    # measure a knob grid -> one autotune_sweep record per point
+    python -m tools.autotune sweep --domain serve --model tiny
+    python -m tools.autotune sweep --domain serve --model serve-bench
+    python -m tools.autotune sweep --domain train
+
+    # fit the predictor on the newest sweep, print the LOO report,
+    # append the fit record, and (reviewed flow, like the HLO gate's
+    # --update-baselines) rewrite tools/autotune/data/best.json
+    python -m tools.autotune fit --domain serve --update-best
+
+    # what would a consumer resolve right now?
+    python -m tools.autotune best --domain serve --model llama-d64-L2
+
+    # validate the committed table against the committed store
+    # (schema staleness, knob-name reality, evidence run_ids)
+    python -m tools.autotune check
+
+    # CI: tiny 2-point sweep -> fit -> table round-trip in a temp
+    # store + the committed-table check (ci_gate stage, exit != 0)
+    python -m tools.autotune smoke
+
+Sweep measurements go through the SAME entry points production uses —
+serve points drive a real ``ServeEngine`` through ``tools.loadgen``'s
+open-loop workload; train points time the compiled DP2 train step and
+attach the per-point analytic cost features (``tools.lint.cost``) off
+the point's own lowering, so the predictor's design matrix is the
+union of measured and analytic columns the ISSUE names.
+
+Debugging front door for a sweep: ``python -m tools.obsq diff --sweep
+<sweep_id>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ensure_repo_on_path() -> None:
+    if _REPO not in sys.path:
+        sys.path.insert(0, _REPO)
+
+
+#: default knob grids per domain — small, honest CPU-rig grids (>= 6
+#: points across >= 2 knobs, the committed-evidence floor); a hardware
+#: session re-sweeps with --grid overrides
+_DEFAULT_GRIDS: Dict[str, Dict[str, List[int]]] = {
+    "serve": {"num_slots": [4, 8, 12], "block_size": [4, 8]},
+    "train": {"batch": [2, 4], "ce_chunk": [16, 64],
+              "int8_ring": [0, 1]},
+}
+
+#: CLI model aliases for the serve sweep (the train sweep always uses
+#: the tiny flagship config the cost gate lowers)
+_SERVE_MODELS = ("tiny", "serve-bench")
+
+
+def _log(msg: str) -> None:
+    print(f"# autotune: {msg}", file=sys.stderr)
+
+
+def _platform_device() -> Tuple[str, str]:
+    import jax
+
+    platform = jax.default_backend()
+    dev = jax.devices()[0]
+    return platform, getattr(dev, "device_kind", "") or platform
+
+
+def _parse_grid(specs: Optional[List[str]],
+                domain: str) -> Dict[str, List[int]]:
+    """``--grid num_slots=4,8`` (repeatable) -> {"num_slots": [4, 8]};
+    no specs -> the domain's default grid."""
+    if not specs:
+        return dict(_DEFAULT_GRIDS[domain])
+    grid: Dict[str, List[int]] = {}
+    for spec in specs:
+        if "=" not in spec:
+            raise ValueError(f"--grid: expected KNOB=V1,V2,..., got "
+                             f"{spec!r}")
+        name, _, vals = spec.partition("=")
+        try:
+            grid[name.strip()] = [int(v) for v in vals.split(",")
+                                  if v.strip()]
+        except ValueError:
+            raise ValueError(f"--grid {spec!r}: values must be "
+                             f"integers")
+        if not grid[name.strip()]:
+            raise ValueError(f"--grid {spec!r}: no values")
+    return grid
+
+
+def _build_serve_model(name: str):
+    from singa_tpu import models, tensor
+
+    tensor.set_seed(0)
+    if name == "tiny":
+        cfg = models.LlamaConfig.tiny()
+    elif name == "serve-bench":
+        cfg = models.LlamaConfig.serve_bench()
+    else:
+        raise ValueError(f"unknown serve sweep model {name!r} "
+                         f"(choices: {_SERVE_MODELS})")
+    import numpy as np
+
+    m = models.Llama(cfg)
+    m.eval()
+    m.compile([tensor.from_numpy(np.zeros((1, 4), np.int32))],
+              is_train=False, use_graph=False)
+    return m
+
+
+def _store_features(store_path: str) -> Dict[str, float]:
+    """Constant analytic features for serve points: the newest
+    committed hlo_audit cost numerics.  Constant across one sweep's
+    points (the serve knobs don't re-lower the flagship programs), so
+    the fit standardizes them away today — they become live columns
+    when sweeps accumulate across platforms/PRs, which is why they are
+    carried now."""
+    from singa_tpu.obs import record as obs_record
+
+    try:
+        e = obs_record.RunRecord(store_path).latest(kind="hlo_audit",
+                                                    smoke=True)
+    except Exception:  # noqa: BLE001 - a corrupt store fails elsewhere
+        return {}
+    if not e:
+        return {}
+    p = e.get("payload", {})
+    return {k: float(p[k]) for k in ("flops", "hbm_bytes", "peak_bytes",
+                                     "wire_bytes") if k in p}
+
+
+def _serve_measure(model, *, requests: int, rate: float, seed: int,
+                   max_len: int, deadline: float,
+                   features: Dict[str, float], trials: int = 3,
+                   new_tokens: Tuple[int, ...] = (16, 32),
+                   prompt_lens: Tuple[int, ...] = (6, 10, 16)
+                   ) -> Callable[[Dict[str, Any]],
+                                 Tuple[float, Dict[str, Any]]]:
+    """Measure one serve knob point: a fresh ServeEngine at the
+    point's arena shape, warmed, then the SAME open-loop Poisson
+    workload every point sees; objective = median delivered tokens/s
+    over ``trials`` runs.
+
+    The default rate/mix SATURATES the engine (arrivals far above
+    capacity, generation-heavy budgets): an arrival-bound workload
+    measures the Poisson clock, not the knobs — every point reads the
+    same tokens/s and the sweep ranks noise.  The median-of-trials is
+    the same shared-CPU-weather defense ``--spec-compare`` uses."""
+    from singa_tpu.serve import ServeEngine
+    from singa_tpu.serve.metrics import ServeMetrics
+    from tools.loadgen import build_workload, run_load
+
+    def measure(knobs: Dict[str, Any]) -> Tuple[float, Dict[str, Any]]:
+        spec = {}
+        if int(knobs.get("spec_k", 0)):
+            spec = {"draft_model": model, "spec_k": int(knobs["spec_k"])}
+        eng = ServeEngine(model, int(knobs["num_slots"]), max_len,
+                          block_size=int(knobs["block_size"]),
+                          max_queue=2 * requests, **spec)
+        warm = build_workload(1, 1.0, seed + 1,
+                              vocab=model.cfg.vocab_size)
+        eng.submit(warm[0].prompt, max_new_tokens=2)
+        eng.run_until_idle()
+        results = []
+        for _ in range(max(1, trials)):
+            eng.metrics = ServeMetrics(flight=eng.flight)
+            wl = build_workload(requests, rate, seed,
+                                prompt_lens=prompt_lens,
+                                new_tokens=new_tokens,
+                                vocab=model.cfg.vocab_size)
+            payload = run_load(eng, wl, deadline_s=deadline)
+            results.append(float(payload["tokens_per_s"]))
+        eng.close()
+        results.sort()
+        return results[len(results) // 2], dict(features)
+
+    return measure
+
+
+def _train_measure(steps: int = 8
+                   ) -> Callable[[Dict[str, Any]],
+                                 Tuple[float, Dict[str, Any]]]:
+    """Measure one train knob point on the DP2 mesh (the audited
+    topology, same shape as `bench.py --quantized`): compile the
+    flagship tiny train step at the point's batch / CE chunk /
+    compression, time `steps` back-to-back steps, and attach the
+    point's OWN analytic cost features off its compiled HLO — the
+    measured/analytic union the predictor fits on."""
+    import jax
+    import numpy as np
+
+    from singa_tpu import models, opt, parallel, tensor
+    from tools.lint import cost as lint_cost
+
+    def measure(knobs: Dict[str, Any]) -> Tuple[float, Dict[str, Any]]:
+        tensor.set_seed(0)
+        np.random.seed(0)
+        parallel.set_mesh(parallel.make_mesh({"data": 2}))
+        try:
+            cfg = models.LlamaConfig.tiny()
+            cfg.num_layers = 1
+            cfg.fused_loss = True
+            cfg.fused_loss_chunk = int(knobs["ce_chunk"])
+            m = models.Llama(cfg)
+            compression = "int8_ring" if int(knobs.get("int8_ring", 0)) \
+                else None
+            m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.01, momentum=0.9),
+                                        compression=compression))
+            ids = tensor.from_numpy(
+                np.zeros((int(knobs["batch"]), 16), np.int32))
+            m.compile([ids], is_train=True, use_graph=True)
+            m.train_step(ids)                     # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                res = m.train_step(ids)
+            jax.block_until_ready(res[1].data)
+            dt_ms = (time.perf_counter() - t0) / steps * 1e3
+            summary = lint_cost.summarize_cost(m.graph.compiled_hlo(),
+                                               "autotune_train_point")
+            feats = {k: float(summary[k])
+                     for k in ("flops", "hbm_bytes", "peak_bytes",
+                               "wire_bytes")}
+            return dt_ms, feats
+        finally:
+            parallel.set_mesh(None)
+
+    return measure
+
+
+# ---------------------------------------------------------------------------
+# subcommands
+# ---------------------------------------------------------------------------
+
+def cmd_sweep(args) -> int:
+    from singa_tpu.autotune import knobs as at_knobs
+    from singa_tpu.autotune import sweep as at_sweep
+    from singa_tpu.autotune import table as at_table
+
+    grid = _parse_grid(args.grid, args.domain)
+    points = at_knobs.grid_points(args.domain, grid)
+    store = args.store or os.path.join(_REPO, "runs", "records.jsonl")
+
+    if args.domain == "train":
+        from singa_tpu.utils.virtcpu import pin_virtual_cpu
+
+        if not pin_virtual_cpu(8):
+            raise SystemExit(
+                "autotune sweep --domain train needs the virtual-CPU "
+                "DP mesh but a jax backend is already initialized "
+                "differently — run in a fresh process")
+        # the audited flagship-tiny DP2 step (same config the cost
+        # gate lowers as train_step_dp2*): 1-layer d64 llama
+        model_key = "llama-d64-L1-dp2"
+        measure = _train_measure(steps=args.steps)
+    else:
+        model = _build_serve_model(args.model)
+        model_key = at_table.model_key(model)
+        measure = _serve_measure(
+            model, requests=args.requests, rate=args.rate,
+            seed=args.seed, max_len=args.max_len,
+            deadline=args.deadline, trials=args.trials,
+            features=_store_features(store))
+
+    platform, device = _platform_device()
+    _log(f"{args.domain} sweep over {len(points)} points "
+         f"({', '.join(f'{k}={v}' for k, v in sorted(grid.items()))}) "
+         f"model={model_key} platform={platform}")
+    sweep_id, entries = at_sweep.run_sweep(
+        args.domain, model_key, points, measure, store,
+        platform=platform, device=device, smoke=platform != "tpu",
+        log=_log)
+    _log(f"{len(entries)} autotune_sweep entries (sweep {sweep_id}) "
+         f"appended to {store}")
+    print(sweep_id)
+    return 0
+
+
+def cmd_fit(args) -> int:
+    from singa_tpu.autotune import predictor as at_predictor
+    from singa_tpu.autotune import sweep as at_sweep
+    from singa_tpu.autotune import table as at_table
+    from singa_tpu.obs import record as obs_record
+
+    store = args.store or os.path.join(_REPO, "runs", "records.jsonl")
+    sweep_id, pts, old_fit = at_sweep.sweep_points_from_store(
+        store, args.domain, model=args.model, platform=args.platform,
+        sweep_id=args.sweep)
+    model_key = pts[0]["model"]
+    pred, report = at_predictor.fit_points(args.domain, pts)
+    best = at_predictor.best_point(args.domain, pts)
+    _log(f"fit {args.domain}/{model_key}/{args.platform}: "
+         f"{report['n']} points, loo_rel_err mean="
+         f"{report['loo_rel_err']:.4f} max="
+         f"{report['loo_rel_err_max']:.4f}")
+    _log(f"measured argbest: {best['knobs']} -> "
+         f"{best['objective_name']}={best['objective']:.3f} "
+         f"(run {best['run_id']})")
+
+    knobs = dict(best["knobs"])
+    spec_evidence = None
+    if args.domain == "serve" and "spec_k" not in knobs:
+        # ROADMAP item-2b wire-up: spec_k comes from the committed
+        # accept_rate / tokens_per_dispatch pair records
+        entries = obs_record.RunRecord(store).entries()
+        picked = at_table.pick_spec_k(entries, args.platform,
+                                      model=model_key)
+        if picked is not None:
+            knobs["spec_k"] = picked["spec_k"]
+            spec_evidence = picked
+            _log(f"spec_k={picked['spec_k']} from committed pair "
+                 f"{picked['pair_id']} (accept_rate="
+                 f"{picked['accept_rate']}, tokens/dispatch="
+                 f"{picked['tokens_per_dispatch']})")
+        else:
+            knobs["spec_k"] = 0
+            _log("no committed spec pair shows a tokens/s win; "
+                 "spec_k=0")
+
+    if old_fit is None or args.refit:
+        at_sweep.append_fit(
+            store, domain=args.domain, model=model_key,
+            platform=args.platform,
+            device=pts[0].get("device", args.platform),
+            sweep_id=sweep_id, best=best, report=report,
+            smoke=args.platform != "tpu", spec_evidence=spec_evidence)
+        _log(f"fit record appended to {store}")
+    else:
+        _log(f"sweep {sweep_id} already has a fit record "
+             f"(--refit to supersede the fit values)")
+
+    doc = {
+        "knobs": knobs,
+        "objective_name": best["objective_name"],
+        "objective": best["objective"],
+        "sweep_id": sweep_id,
+        "run_id": best["run_id"],
+        "loo_rel_err": report["loo_rel_err"],
+    }
+    if spec_evidence is not None:
+        doc["spec_evidence"] = {
+            "pair_id": spec_evidence["pair_id"],
+            "run_id": spec_evidence["run_id"],
+            "accept_rate": spec_evidence["accept_rate"],
+            "tokens_per_dispatch":
+                spec_evidence["tokens_per_dispatch"],
+        }
+    key = at_table.config_key(args.domain, model_key, args.platform)
+    print(json.dumps({key: doc}, indent=2, sort_keys=True))
+    if args.update_best:
+        path = at_table.update_table(key, doc, args.table)
+        _log(f"best-config table updated: {path} [{key}]")
+    else:
+        _log("dry run (pass --update-best to rewrite the committed "
+             "table)")
+    return 0
+
+
+def cmd_best(args) -> int:
+    from singa_tpu.autotune import table as at_table
+
+    knobs = at_table.resolve(args.domain, args.model, args.platform,
+                             {}, path=args.table)
+    print(json.dumps({at_table.config_key(args.domain, args.model,
+                                          args.platform): knobs},
+                     indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_check(args) -> int:
+    """Validate the committed table + sweep records (the same checks
+    ``python -m tools.lint --records`` runs, scoped to autotune so the
+    ci_gate stage can fail on exactly this layer)."""
+    from tools.lint import audit
+
+    root = os.path.abspath(args.root or _REPO)
+    store = os.path.join(root, "runs", "records.jsonl")
+    errors = audit._check_autotune(root, store, table=args.table)
+    table = args.table or os.path.join(root,
+                                       _table_relpath())
+    if not os.path.exists(table):
+        _log(f"note: no best-config table at {table} "
+             f"(consumers fall back to built-in defaults)")
+    for e in errors:
+        print(f"autotune check: {e}", file=sys.stderr)
+    if errors:
+        print(f"autotune check: {len(errors)} error(s)",
+              file=sys.stderr)
+        return 1
+    print(f"autotune check: table + sweep records valid in {root}")
+    return 0
+
+
+def _table_relpath() -> str:
+    from singa_tpu.autotune import table as at_table
+
+    return at_table.DEFAULT_TABLE
+
+
+def cmd_smoke(args) -> int:
+    """The ci_gate autotune stage: (a) the committed table + sweep
+    records validate (incl. the stale-schema-version guard); (b) a
+    REAL tiny 2-point sweep -> fit -> table write -> resolve round-trip
+    in a temp store proves the whole loop end to end without touching
+    committed state."""
+    from singa_tpu.autotune import knobs as at_knobs
+    from singa_tpu.autotune import predictor as at_predictor
+    from singa_tpu.autotune import sweep as at_sweep
+    from singa_tpu.autotune import table as at_table
+
+    rc = cmd_check(argparse.Namespace(root=None, table=None))
+    if rc != 0:
+        return rc
+
+    with tempfile.TemporaryDirectory(prefix="autotune-smoke-") as tmp:
+        store = os.path.join(tmp, "records.jsonl")
+        table = os.path.join(tmp, "best.json")
+        model = _build_serve_model("tiny")
+        model_key = at_table.model_key(model)
+        platform, device = _platform_device()
+        grid = {"num_slots": [2, 4]}
+        points = at_knobs.grid_points("serve", grid)
+        for p in points:
+            p["block_size"] = 8
+        measure = _serve_measure(model, requests=6, rate=50.0, seed=0,
+                                 max_len=64, deadline=30.0, features={})
+        sweep_id, entries = at_sweep.run_sweep(
+            "serve", model_key, points, measure, store,
+            platform=platform, device=device, smoke=True, log=_log)
+        _, pts, _ = at_sweep.sweep_points_from_store(store, "serve")
+        pred, report = at_predictor.fit_points("serve", pts)
+        best = at_predictor.best_point("serve", pts)
+        at_sweep.append_fit(store, domain="serve", model=model_key,
+                            platform=platform, device=device,
+                            sweep_id=sweep_id, best=best,
+                            report=report, smoke=True)
+        key = at_table.config_key("serve", model_key, platform)
+        at_table.update_table(key, {
+            "knobs": dict(best["knobs"]),
+            "objective_name": best["objective_name"],
+            "objective": best["objective"], "sweep_id": sweep_id,
+            "run_id": best["run_id"],
+            "loo_rel_err": report["loo_rel_err"]}, table)
+        resolved = at_table.resolve("serve", model_key, platform, {},
+                                    path=table)
+        if resolved["num_slots"] != best["knobs"]["num_slots"]:
+            print(f"autotune smoke: FAIL — round-trip resolve "
+                  f"{resolved} != committed best {best['knobs']}",
+                  file=sys.stderr)
+            return 1
+        # the store written by the loop must itself lint clean
+        from singa_tpu.obs import record as obs_record
+
+        errors = obs_record.RunRecord(store).validate()
+        if errors:
+            for e in errors:
+                print(f"autotune smoke: {e}", file=sys.stderr)
+            return 1
+        # explicit values must beat the table (the override contract)
+        forced = at_table.resolve("serve", model_key, platform,
+                                  {"num_slots": 3}, path=table)
+        if forced["num_slots"] != 3:
+            print("autotune smoke: FAIL — explicit knob did not win "
+                  "over the table", file=sys.stderr)
+            return 1
+    print(f"autotune smoke: OK — 2-point sweep -> fit (loo_rel_err="
+          f"{report['loo_rel_err']:.3f}) -> table round-trip; "
+          f"committed table + records valid")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    _ensure_repo_on_path()
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.autotune",
+        description="record-driven autotuner: sweep knobs, fit the "
+                    "predictor, commit the best-config table")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_sweep = sub.add_parser(
+        "sweep", help="measure a knob grid; one autotune_sweep record "
+                      "per point under a shared sweep_id")
+    p_sweep.add_argument("--domain", choices=("serve", "train"),
+                         required=True)
+    p_sweep.add_argument("--model", choices=_SERVE_MODELS,
+                         default="tiny",
+                         help="serve sweep architecture (train always "
+                              "sweeps the audited tiny DP2 step)")
+    p_sweep.add_argument("--grid", action="append", metavar="K=V1,V2",
+                         default=None,
+                         help="knob values (repeatable; default: the "
+                              "domain's built-in grid)")
+    p_sweep.add_argument("--store", default=None)
+    p_sweep.add_argument("--requests", type=int, default=48)
+    p_sweep.add_argument("--rate", type=float, default=1000.0,
+                         help="offered arrivals/s — far above capacity "
+                              "by default, so the ENGINE is the "
+                              "bottleneck being ranked, not the "
+                              "Poisson clock")
+    p_sweep.add_argument("--trials", type=int, default=3,
+                         help="workload runs per point; the median "
+                              "tokens/s is recorded")
+    p_sweep.add_argument("--seed", type=int, default=0)
+    p_sweep.add_argument("--max-len", type=int, default=80)
+    p_sweep.add_argument("--deadline", type=float, default=300.0)
+    p_sweep.add_argument("--steps", type=int, default=8,
+                         help="train sweep: timed steps per point")
+    p_sweep.set_defaults(fn=cmd_sweep)
+
+    p_fit = sub.add_parser(
+        "fit", help="fit the predictor on a sweep, append the fit "
+                    "record, optionally rewrite best.json")
+    p_fit.add_argument("--domain", choices=("serve", "train"),
+                       required=True)
+    p_fit.add_argument("--model", default=None,
+                       help="model KEY (e.g. llama-d64-L2); default: "
+                            "the newest sweep's")
+    p_fit.add_argument("--platform", default="cpu")
+    p_fit.add_argument("--sweep", default=None, metavar="SWEEP_ID",
+                       help="which sweep group (default: newest)")
+    p_fit.add_argument("--store", default=None)
+    p_fit.add_argument("--table", default=None)
+    p_fit.add_argument("--refit", action="store_true",
+                       help="supersede an existing fit record")
+    p_fit.add_argument("--update-best", action="store_true",
+                       help="rewrite the committed best-config table "
+                            "(review the diff in the PR, same flow as "
+                            "--update-baselines)")
+    p_fit.set_defaults(fn=cmd_fit)
+
+    p_best = sub.add_parser(
+        "best", help="print the resolved knobs a consumer would use")
+    p_best.add_argument("--domain", choices=("serve", "train"),
+                        required=True)
+    p_best.add_argument("--model", required=True,
+                        help="model KEY (e.g. llama-d64-L2)")
+    p_best.add_argument("--platform", default="cpu")
+    p_best.add_argument("--table", default=None)
+    p_best.set_defaults(fn=cmd_best)
+
+    p_check = sub.add_parser(
+        "check", help="validate the committed best-config table + "
+                      "autotune_sweep records (stale schema_version "
+                      "fails loudly)")
+    p_check.add_argument("--root", default=None)
+    p_check.add_argument("--table", default=None)
+    p_check.set_defaults(fn=cmd_check)
+
+    p_smoke = sub.add_parser(
+        "smoke", help="CI: committed-table check + a real 2-point "
+                      "sweep -> fit -> table round-trip in a temp "
+                      "store")
+    p_smoke.set_defaults(fn=cmd_smoke)
+
+    args = parser.parse_args(argv)
+    try:
+        return args.fn(args)
+    except (OSError, ValueError, LookupError) as e:
+        print(f"autotune: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    import signal
+    if hasattr(signal, "SIGPIPE"):
+        signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    raise SystemExit(main())

@@ -29,7 +29,15 @@
 #            exit 14 (self-speculation verify-k streams asserted
 #             IDENTICAL to generate() and a plain engine, accept rate
 #             asserted 1.0 — the speculative decode path end to end)
-#   stage 6  tier-1 tests  the ROADMAP.md tier-1 command     exit 20
+#   stage 6  autotune     `python -m tools.autotune smoke` + the
+#            table-resolved consumers, exit 15
+#            (committed best.json + autotune_sweep records validate —
+#             incl. the stale-schema_version guard — then a real
+#             2-point sweep -> fit -> table round-trip in a temp
+#             store, then tools/loadgen.py and bench.py --serve run
+#             END TO END with table-resolved arena knobs, no store
+#             writes)
+#   stage 7  tier-1 tests  the ROADMAP.md tier-1 command     exit 20
 #
 # Exit 0 = every stage green.  Intentional compiled-program changes are
 # re-baselined first via `python -m tools.lint --hlo --update-baselines`
@@ -37,24 +45,30 @@
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
-echo "== ci_gate stage 1/6: full audit (static + HLO structure + cost) =="
+echo "== ci_gate stage 1/7: full audit (static + HLO structure + cost) =="
 JAX_PLATFORMS=cpu python -m tools.lint || exit 10
 
-echo "== ci_gate stage 2/6: record validation =="
+echo "== ci_gate stage 2/7: record validation =="
 JAX_PLATFORMS=cpu python -m tools.lint --records || exit 11
 
-echo "== ci_gate stage 3/6: obsq SLO smoke (trace-derived vs committed fixture) =="
+echo "== ci_gate stage 3/7: obsq SLO smoke (trace-derived vs committed fixture) =="
 JAX_PLATFORMS=cpu python -m tools.obsq slo --check \
     --records tests/data/obsq/records.jsonl \
     --events tests/data/obsq/events.jsonl || exit 12
 
-echo "== ci_gate stage 4/6: disagg smoke (1:1 tier streams == single engine) =="
+echo "== ci_gate stage 4/7: disagg smoke (1:1 tier streams == single engine) =="
 JAX_PLATFORMS=cpu python -m tools.loadgen --disagg-smoke || exit 13
 
-echo "== ci_gate stage 5/6: spec smoke (self-speculation streams == generate()) =="
+echo "== ci_gate stage 5/7: spec smoke (self-speculation streams == generate()) =="
 JAX_PLATFORMS=cpu python -m tools.loadgen --spec-smoke || exit 14
 
-echo "== ci_gate stage 6/6: tier-1 test suite (ROADMAP.md budget) =="
+echo "== ci_gate stage 6/7: autotune smoke (sweep -> fit -> table -> consumers) =="
+JAX_PLATFORMS=cpu python -m tools.autotune smoke || exit 15
+JAX_PLATFORMS=cpu python -m tools.loadgen --requests 6 --rate 50 \
+    --no-record || exit 15
+JAX_PLATFORMS=cpu python bench.py --serve --no-record || exit 15
+
+echo "== ci_gate stage 7/7: tier-1 test suite (ROADMAP.md budget) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

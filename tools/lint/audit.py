@@ -18,7 +18,7 @@ import glob
 import json
 import os
 import sys
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 _REPO_ROOT = os.path.abspath(
     os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
@@ -76,6 +76,58 @@ def check_records_root(root: str) -> List[str]:
         errors.extend(obs_record.RunRecord(store).validate())
         errors.extend(_check_flight_refs(store))
     errors.extend(_check_incident_dumps(root))
+    errors.extend(_check_autotune(root, store))
+    return errors
+
+
+def _check_autotune(root: str, store: str,
+                    table: Optional[str] = None) -> List[str]:
+    """The autotune layer's record hygiene (ISSUE 14): every committed
+    ``autotune_sweep`` entry's knob NAMES must be registered in
+    ``singa_tpu.autotune.knobs.KNOBS`` (the schema checks shape; a
+    typo'd knob would otherwise fit a predictor on noise), and the
+    committed best-config table — when one exists — must validate
+    against the current schema version AND cite only run_ids that
+    exist in the store (a best point must reference its measured
+    evidence; a stale-version table fails loudly instead of silently
+    steering configs).  ``table`` overrides the committed location so
+    ``tools.autotune check --table`` can vet a CANDIDATE table against
+    the same store before it is committed."""
+    _ensure_repo_on_path()
+    from singa_tpu.autotune import knobs as at_knobs
+    from singa_tpu.autotune import table as at_table
+    from singa_tpu.obs import record as obs_record
+    from singa_tpu.obs import schema
+
+    errors: List[str] = []
+    run_ids: Optional[set] = None
+    if os.path.exists(store):
+        try:
+            entries = obs_record.RunRecord(store).entries()
+        except schema.SchemaError:
+            # the store lint above already reported it; run_ids stays
+            # None so the table check below does not pile spurious
+            # 'cites a run_id which does not exist' errors on top of
+            # the one real store error
+            entries = []
+        else:
+            run_ids = {e["run_id"] for e in entries}
+        for e in entries:
+            if e["kind"] != "autotune_sweep":
+                continue
+            p = e["payload"]
+            ctx = f"{store}: {e['run_id']}"
+            errors.extend(at_knobs.validate_knobs(
+                p.get("domain"), p.get("knobs"), ctx=ctx))
+
+    table = table or os.path.join(root, at_table.DEFAULT_TABLE)
+    if os.path.exists(table):
+        doc, err = _load_json(table)
+        if err:
+            errors.append(err)
+        else:
+            errors.extend(at_table.validate_table(
+                doc, ctx=table, store_run_ids=run_ids))
     return errors
 
 

@@ -153,6 +153,14 @@ def run_load(engine, workload: List[_Arrival], *,
             break
     wall = time.monotonic() - t0
     snap = engine.metrics.snapshot()
+    # stamp the architecture key (ISSUE 14): the autotuner's spec_k
+    # picker matches records to a (model, platform) strictly, so a
+    # pair measured on one architecture can never decide another's k
+    served_model = getattr(engine, "model", None)
+    model_key = None
+    if served_model is not None:
+        from singa_tpu.autotune import table as autotune_table
+        model_key = autotune_table.model_key(served_model)
     done = [h for h in handles if h is not None]
     completed = sum(1 for h in done
                     if h.finish_reason in ("eos", "length"))
@@ -167,6 +175,8 @@ def run_load(engine, workload: List[_Arrival], *,
         "ttft_p50_ms": round(ttft.get("p50", 0.0), 3),
         "ttft_p99_ms": round(ttft.get("p99", 0.0), 3),
     }
+    if model_key is not None:
+        payload["model"] = model_key
     if snap.get("accept_rate") is not None:
         # speculative engine/tier: the pair joins the headline (schema
         # both-or-neither contract, _SPEC_FIELDS) — accept rate plus the
@@ -251,6 +261,27 @@ def _build_model():
     m.compile([tensor.from_numpy(np.zeros((1, 4), np.int32))],
               is_train=False, use_graph=False)
     return m
+
+
+def _resolve_serve_knobs(args, model) -> dict:
+    """Fill ``args.num_slots`` / ``args.block_size`` from the committed
+    best-config table (``singa_tpu.autotune.table``) when the CLI left
+    them at their None defaults.  Precedence is the autotuner's
+    contract: an explicit flag always wins; else the table's entry for
+    this (model, platform); else the registry's hand-carried constants
+    (``autotune.knobs.DEFAULTS`` — the 8/8 pair this CLI shipped with,
+    ONE source of truth), announced loudly once."""
+    import jax
+
+    from singa_tpu.autotune import table as autotune_table
+
+    knobs = autotune_table.resolve(
+        "serve", autotune_table.model_key(model), jax.default_backend(),
+        {"num_slots": args.num_slots, "block_size": args.block_size})
+    args.num_slots = int(knobs["num_slots"])
+    args.block_size = int(knobs["block_size"])
+    return {"num_slots": args.num_slots,
+            "block_size": args.block_size}
 
 
 def _build_tier(model, n_prefill: int, n_decode: int, args, store,
@@ -403,6 +434,7 @@ def spec_compare(args, store, trials: int = 3) -> int:
     from singa_tpu.serve.metrics import ServeMetrics
 
     m = _build_model()
+    _resolve_serve_knobs(args, m)
     new_tokens = tuple(int(t) for t in args.new_tokens.split(",")
                        if t.strip())
     prompt_lens = tuple(int(t) for t in args.prompt_lens.split(",")
@@ -491,12 +523,18 @@ def main(argv=None) -> int:
                          "mix (short prompts + long generations isolate "
                          "the decode path a --spec-k comparison is "
                          "about)")
-    ap.add_argument("--num-slots", type=int, default=8)
+    ap.add_argument("--num-slots", type=int, default=None,
+                    help="decode-batch slots (default: the committed "
+                         "best-config table's value for this model+"
+                         "platform, else 8)")
     ap.add_argument("--max-queue", type=int, default=None,
                     help="admission-queue capacity (default: the "
                          "engine's 2*num_slots)")
     ap.add_argument("--max-len", type=int, default=64)
-    ap.add_argument("--block-size", type=int, default=8)
+    ap.add_argument("--block-size", type=int, default=None,
+                    help="paged-KV block size (default: the committed "
+                         "best-config table's value for this model+"
+                         "platform, else 8)")
     ap.add_argument("--num-blocks", type=int, default=None)
     ap.add_argument("--no-share", action="store_true",
                     help="disable prefix-cache sharing in the engine")
@@ -558,6 +596,7 @@ def main(argv=None) -> int:
         return spec_compare(args, store)
 
     m = _build_model()
+    _resolve_serve_knobs(args, m)
     new_tokens = tuple(int(t) for t in args.new_tokens.split(",")
                        if t.strip())
     prompt_lens = tuple(int(t) for t in args.prompt_lens.split(",")

@@ -19,6 +19,11 @@ JSONL; obsq is the layer that answers questions:
     python -m tools.obsq diff hlo_audit --last 5
     python -m tools.obsq diff serve_load --fields tokens_per_s,ttft_p99_ms
 
+    # one sweep group's points (autotune_sweep or loadgen ratio-sweep
+    # records) as a table with knob columns flattened in — the
+    # autotuner's debugging front door (ISSUE 14)
+    python -m tools.obsq diff --sweep atsweep-20260804-...
+
 What ``slo`` recomputes, and from what:
 
 * **TTFT p50/p99** — the ``serve.ttft_ms`` histogram observations are
@@ -215,27 +220,61 @@ def _pick_record(store_path: str, run_id: Optional[str],
 # diff — metric trajectory across records
 # ---------------------------------------------------------------------------
 
-def diff_rows(store_path: str, kind: str, last: int = 5,
-              fields: Optional[List[str]] = None
+def _flat_payload_items(payload: Dict[str, Any]):
+    """Numeric payload items, with one level of ``knobs.<name>`` /
+    ``features.<name>`` flattening so a sweep point's knob settings
+    render as columns next to its objective."""
+    for k, v in sorted(payload.items()):
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            yield k, v
+        elif k in ("knobs", "features") and isinstance(v, dict):
+            for kk, vv in sorted(v.items()):
+                if isinstance(vv, (int, float)) and \
+                        not isinstance(vv, bool):
+                    yield f"{k}.{kk}", vv
+
+
+def _flat_get(payload: Dict[str, Any], key: str) -> Any:
+    if "." in key:
+        head, tail = key.split(".", 1)
+        sub = payload.get(head)
+        return sub.get(tail) if isinstance(sub, dict) else None
+    return payload.get(key)
+
+
+def diff_rows(store_path: str, kind: Optional[str], last: int = 5,
+              fields: Optional[List[str]] = None,
+              sweep: Optional[str] = None
               ) -> Tuple[List[str], List[List[Any]]]:
     """(header, rows) of the numeric-payload trajectory across the last
     ``last`` records of ``kind`` (file order = append order).  Columns
     are ``fields`` or every numeric payload key seen; the final row is
     the relative change of the newest record vs its predecessor — the
-    table the record-driven autotuner consumes."""
+    table the record-driven autotuner consumes.
+
+    With ``sweep`` set, rows are instead the ENTIRE record group whose
+    payload carries that ``sweep_id`` (any kind unless one is named —
+    autotune_sweep points and loadgen ratio-sweep serve_load entries
+    both qualify), with ``knobs.<name>`` columns flattened in — the
+    autotuner's own debugging front door (``python -m tools.obsq diff
+    --sweep <id>``)."""
     _ensure_repo_on_path()
     from singa_tpu.obs import record as obs_record
     entries = [e for e in obs_record.RunRecord(store_path).entries()
-               if e["kind"] == kind]
+               if (kind is None or e["kind"] == kind)
+               and (sweep is None
+                    or e.get("payload", {}).get("sweep_id") == sweep)]
     if not entries:
-        raise LookupError(f"no {kind!r} records in {store_path}")
-    entries = entries[-max(1, int(last)):]
+        what = (f"records with sweep_id {sweep!r}" if sweep
+                else f"{kind!r} records")
+        raise LookupError(f"no {what} in {store_path}")
+    if sweep is None:
+        entries = entries[-max(1, int(last)):]
     if fields is None:
         keys: List[str] = []
         for e in entries:
-            for k, v in sorted(e.get("payload", {}).items()):
-                if isinstance(v, (int, float)) and not isinstance(v, bool) \
-                        and k not in keys:
+            for k, _v in _flat_payload_items(e.get("payload", {})):
+                if k not in keys:
                     keys.append(k)
     else:
         keys = list(fields)
@@ -243,8 +282,12 @@ def diff_rows(store_path: str, kind: str, last: int = 5,
     rows: List[List[Any]] = []
     for e in entries:
         payload = e.get("payload", {})
-        rows.append([e["run_id"]] + [payload.get(k) for k in keys])
-    if len(rows) >= 2:
+        rows.append([e["run_id"]]
+                    + [_flat_get(payload, k) for k in keys])
+    if len(rows) >= 2 and sweep is None:
+        # a trajectory's newest-vs-previous delta is the question diff
+        # answers; a sweep's points are parallel measurements, where a
+        # neighbor delta would just compare unrelated knob settings
         delta: List[Any] = ["Δ last vs prev"]
         for k in keys:
             new, old = rows[-1][1 + keys.index(k)], \
@@ -308,8 +351,14 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     p_diff = sub.add_parser(
         "diff", help="numeric-payload trajectory across the last N "
-                     "records of one kind")
-    p_diff.add_argument("kind")
+                     "records of one kind, or one sweep group's "
+                     "points (--sweep)")
+    p_diff.add_argument("kind", nargs="?", default=None)
+    p_diff.add_argument("--sweep", default=None, metavar="SWEEP_ID",
+                        help="render every record whose payload "
+                             "carries this sweep_id (autotune_sweep "
+                             "points, loadgen ratio-sweep entries) "
+                             "with knob columns flattened in")
     p_diff.add_argument("--last", type=int, default=5)
     p_diff.add_argument("--records",
                         default=os.path.join(_REPO, "runs",
@@ -345,10 +394,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             print("obsq: record reproducible from traces")
             return 0
         if args.cmd == "diff":
+            if args.kind is None and args.sweep is None:
+                parser.error("diff needs a record kind and/or --sweep "
+                             "SWEEP_ID")
             fields = ([f.strip() for f in args.fields.split(",")
                        if f.strip()] if args.fields else None)
             header, rows = diff_rows(args.records, args.kind,
-                                     last=args.last, fields=fields)
+                                     last=args.last, fields=fields,
+                                     sweep=args.sweep)
             print(_render_table(header, rows))
             return 0
     except (OSError, ValueError, LookupError) as e:
