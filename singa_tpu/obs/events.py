@@ -97,7 +97,7 @@ class JsonlSink:
         reopen fresh — every retained line lives in a complete file."""
         self._f.close()
         os.replace(self.path, self.path + ".1")
-        self._f = open(self.path, "a")
+        self._f = open(self.path, "a")  # singalint: disable=SGL012 the sink lock exists to serialize file writers; rollover I/O under it is the design, bounded to one reopen per max_bytes of events
         self._size = 0
 
     def close(self) -> None:
@@ -115,6 +115,9 @@ def _jsonable(v):
 
 _sink: Optional[JsonlSink] = None
 _annotate = False
+#: serializes sink swaps: two concurrent configure() calls would both
+#: read the same ``old`` and one replaced sink would never be closed
+_config_lock = threading.Lock()
 
 
 def configure(sink: Optional[JsonlSink] = None, path: Optional[str] = None,
@@ -125,15 +128,20 @@ def configure(sink: Optional[JsonlSink] = None, path: Optional[str] = None,
     ``configure()`` with no arguments disables the JSONL sink (closing
     the old one) and leaves annotation untouched.  ``max_bytes``
     applies to a sink built from ``path`` (size-based rollover to
-    ``<path>.1``; ``SINGA_OBS_MAX_BYTES`` in the environment)."""
-    global _sink, _annotate
-    old = _sink
+    ``<path>.1``; ``SINGA_OBS_MAX_BYTES`` in the environment).
+
+    Safe to call while other threads emit: emitters snapshot the sink
+    reference once per event (see ``_emit``), and a swapped-out sink's
+    ``emit`` degrades to a no-op once closed."""
     if path is not None:
         sink = JsonlSink(path, max_bytes=max_bytes)
-    _sink = sink
-    if annotate is not None:
-        _annotate = bool(annotate)
-    if old is not None and old is not _sink:
+    global _sink, _annotate
+    with _config_lock:
+        old = _sink
+        _sink = sink
+        if annotate is not None:
+            _annotate = bool(annotate)
+    if old is not None and old is not sink:
         old.close()
 
 
@@ -174,7 +182,15 @@ def get_sink() -> Optional[JsonlSink]:
 
 
 def _emit(kind: str, name: str, attrs: Dict[str, Any]) -> None:
-    if _sink is None:
+    # SNAPSHOT the module global exactly once: a concurrent
+    # configure() can swap (or clear) the sink between a check and a
+    # use, and the pre-fix double read of ``_sink`` crashed the
+    # emitting thread with AttributeError — telemetry taking down the
+    # step loop it instruments (forced-interleaving regression test in
+    # tests/test_obs.py).  Emitting into the just-replaced sink is
+    # fine: its emit() is a silent no-op once closed.
+    sink = _sink
+    if sink is None:
         return
     ev = {"t": time.time(), "kind": kind, "name": name}  # singalint: disable=SGL005 event timestamps must correlate across hosts/files; durations use the monotonic clocks in span()
     # request/step attribution (ISSUE 11): every event emitted inside
@@ -184,7 +200,7 @@ def _emit(kind: str, name: str, attrs: Dict[str, Any]) -> None:
     if tid is not None and "trace" not in attrs:
         ev["trace"] = tid
     ev.update(attrs)
-    _sink.emit(ev)
+    sink.emit(ev)
 
 
 def counter(name: str, value, **attrs) -> None:

@@ -58,13 +58,26 @@ DEFAULT_CAPACITY = 256
 #: is dropped by the collector, not by an explicit lifecycle hook
 _RECORDERS: "weakref.WeakSet[FlightRecorder]" = weakref.WeakSet()
 
+#: guards _RECORDERS against mutation-during-iteration: register()
+#: runs on whatever thread builds an engine/runner while broadcast()
+#: snapshots the set from the thread a fault fires on — an unguarded
+#: ``add`` landing mid-``list(...)`` raises "Set changed size during
+#: iteration" on the BROADCASTING thread, i.e. inside faults.fire on
+#: the step path (forced-interleaving regression test in
+#: tests/test_obs.py).  Notes are delivered OUTSIDE the lock: each
+#: ring serializes its own appends, and holding the registry lock
+#: across them would couple every engine's hot path to the slowest
+#: ring.
+_registry_lock = threading.Lock()
+
 #: distinguishes dumps landing within the same second+site+pid
 _dump_seq = itertools.count()
 
 
 def register(rec: "FlightRecorder") -> "FlightRecorder":
     """Subscribe ``rec`` to fault-fire broadcasts (weakly held)."""
-    _RECORDERS.add(rec)
+    with _registry_lock:
+        _RECORDERS.add(rec)
     return rec
 
 
@@ -72,7 +85,9 @@ def broadcast(kind: str, name: str, **attrs: Any) -> None:
     """Note one event into every registered ring — called by
     ``faults.fire`` for each FIRED fault only, so the no-fault path
     never reaches here."""
-    for rec in list(_RECORDERS):
+    with _registry_lock:
+        recorders = list(_RECORDERS)
+    for rec in recorders:
         rec.note(kind, name, **attrs)
 
 

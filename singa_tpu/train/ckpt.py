@@ -202,7 +202,7 @@ class AsyncCheckpointManager:
                "size": os.path.getsize(path)}
         checkpoint.atomic_write(self.marker_path(step),
                                 lambda f: json.dump(doc, f), mode="w")
-        self.committed_count += 1  # singalint: disable=SGL004 sole writer is the 1-worker ckpt executor; readers (ckpt_count in the run record) tolerate a stale count
+        self.committed_count += 1  # singalint: disable=SGL010 sole writer is the 1-worker ckpt executor; readers (ckpt_count in the run record) tolerate a stale count
 
     def _gc(self) -> None:
         steps = self.steps()

@@ -54,7 +54,7 @@ class PreemptionHandler:
     def _handle(self, signum, frame) -> None:
         if self._requested.is_set() and signum == signal.SIGINT:
             raise KeyboardInterrupt   # second Ctrl-C: exit NOW
-        self._signum = signum
+        self._signum = signum  # singalint: disable=SGL010 signal handlers run between bytecodes ON the main thread (no parallel writer), and taking a lock here could deadlock against the interrupted holder
         self._requested.set()
 
     def install(self) -> "PreemptionHandler":

@@ -79,14 +79,29 @@ class Heartbeat:
         self._fired = False
 
     def start(self) -> "Heartbeat":
-        self._stop.clear()   # restartable after stop()
+        # each start gets a FRESH stop event, passed to its own monitor
+        # thread.  The old restartable-after-stop() design CLEARED the
+        # shared event instead, and a stop()+start() re-arm (the serve
+        # engine's recover_on_hang path does exactly this after every
+        # hang) could clear it inside the old monitor's wait() window —
+        # the old thread missed the brief set, saw a cleared event, and
+        # kept running alongside the new monitor: two watchdogs, double
+        # on_failure fires (forced-interleaving regression test in
+        # tests/test_aux.py).  With a per-generation event, the old
+        # thread's event stays set forever once stopped.  Setting the
+        # outgoing event first keeps start() safe WITHOUT an
+        # intervening stop(): a previous generation must never be
+        # orphaned holding an event nothing can set anymore.
+        self._stop.set()
+        self._stop = threading.Event()
         self._fired = False
         self._last = time.monotonic()
         # ALWAYS a daemon: the monitor exists to watch for wedged
         # threads, so it must never itself keep a dying interpreter
         # alive waiting on a join
         self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="singa-heartbeat")
+                                        name="singa-heartbeat",
+                                        args=(self._stop,))
         self._thread.start()
         return self
 
@@ -109,11 +124,13 @@ class Heartbeat:
     def fired(self) -> bool:
         return self._fired
 
-    def _run(self) -> None:
-        while not self._stop.wait(self.check_every):
+    def _run(self, stop: threading.Event) -> None:
+        # ``stop`` is THIS generation's event (never self._stop, which
+        # a re-arm may already have replaced with the next monitor's)
+        while not stop.wait(self.check_every):
             age = time.monotonic() - self._last
             if age > self.timeout:
-                self._fired = True  # singalint: disable=SGL004 monitor thread is the only writer; start() resets it before the thread exists, readers poll a latch-once bool
+                self._fired = True  # singalint: disable=SGL010 monitor thread is the only writer; start() resets it before the thread exists, readers poll a latch-once bool
                 try:
                     self.on_failure(age, self._last_step)
                 finally:

@@ -102,6 +102,50 @@ class TestFailureDetection:
     def test_device_liveness(self, cpu_dev):
         assert failure.device_liveness_check(cpu_dev, timeout=30.0)
 
+    def test_rearm_cannot_leak_a_second_monitor(self, monkeypatch):
+        """ISSUE 15 conclint fix, forced interleaving: Heartbeat.start
+        used to CLEAR the shared stop event to be restartable, so a
+        stop()+start() re-arm (the serve engine's recover_on_hang path
+        runs exactly this after every hang) landing inside the old
+        monitor's wait() window un-stopped it — the old thread missed
+        the brief set, saw a cleared event, and kept running alongside
+        the new monitor: two watchdogs, double on_failure fires.  The
+        fix gives each start() its own stop event, captured by its own
+        thread.  Hook: an Event subclass whose wait() returns only
+        AFTER the re-arm happened, reporting the event's state at that
+        moment — the exact missed-set interleave, deterministically."""
+        import threading
+        import types
+
+        rearmed = threading.Event()
+
+        class MissedSetEvent(threading.Event):
+            def wait(self, timeout=None):
+                rearmed.wait(5.0)       # block until stop()+start()
+                return super().wait(0)  # then report the CURRENT state
+
+        # shim ONLY the failure module's view of threading: Heartbeat's
+        # stop events become instrumented while Thread's own internals
+        # (Thread._started is an Event too) stay real and fast
+        shim = types.SimpleNamespace(
+            Event=MissedSetEvent, Thread=threading.Thread,
+            current_thread=threading.current_thread)
+        monkeypatch.setattr(failure, "threading", shim)
+        hb = failure.Heartbeat(timeout=30.0, check_every=0.05,
+                               on_failure=lambda age, step: None)
+        hb.start()
+        time.sleep(0.05)    # old monitor enters its wait()
+        hb.stop()           # sets its stop event...
+        hb.start()          # ...pre-fix: clears the SAME event again
+        rearmed.set()       # release every blocked wait()
+        time.sleep(0.2)
+        monitors = [t for t in threading.enumerate()
+                    if t.name == "singa-heartbeat" and t.is_alive()]
+        hb.stop()
+        assert len(monitors) == 1, (
+            f"{len(monitors)} monitor threads alive after a re-arm — "
+            f"the stopped generation kept running")
+
 
 class TestProfiler:
     def test_step_profiler_mfu(self, cpu_dev):

@@ -41,9 +41,13 @@ def lint(src, code):
 # ---------------------------------------------------------------------------
 
 def test_catalogue_covers_the_invariants():
-    assert set(RULES) >= {"SGL001", "SGL002", "SGL003", "SGL004",
+    assert set(RULES) >= {"SGL001", "SGL002", "SGL003",
                           "SGL005", "SGL006", "SGL007", "SGL008",
-                          "SGL009"}
+                          "SGL009", "SGL010", "SGL011", "SGL012",
+                          "SGL013"}
+    # SGL004 (thread-seam) is RETIRED: folded into SGL010 (conclint);
+    # the code stays reserved as a documented alias that fails loudly
+    assert "SGL004" not in RULES
     for code, cls in RULES.items():
         assert cls.code == code and cls.name and cls.description
 
@@ -262,10 +266,11 @@ class TestRecompileHazard:
 
 
 # ---------------------------------------------------------------------------
-# SGL004 thread-seam
+# SGL010 conc-shared-state (conclint; supersedes the retired SGL004 —
+# its fixtures are folded in below, re-coded)
 # ---------------------------------------------------------------------------
 
-class TestThreadSeam:
+class TestSharedState:
     def test_fires_on_unguarded_write_from_thread_target(self):
         out = lint("""
             import threading
@@ -277,11 +282,14 @@ class TestThreadSeam:
 
                 def _run(self):
                     self.count = 1
-        """, "SGL004")
-        assert codes_of(out) == ["SGL004"]
+        """, "SGL010")
+        assert codes_of(out) == ["SGL010"]
         assert "self.count" in out[0].message
 
-    def test_fires_one_call_level_deep_via_submit(self):
+    def test_fires_transitively_via_submit(self):
+        # the closure is TRANSITIVE (deeper than SGL004's one level):
+        # _commit is two self-call hops from the submit target, which
+        # is exactly the ckpt writer's real shape
         out = lint("""
             class Writer:
                 def save(self):
@@ -292,8 +300,65 @@ class TestThreadSeam:
 
                 def _commit(self):
                     self.committed = True
-        """, "SGL004")
-        assert codes_of(out) == ["SGL004"]
+        """, "SGL010")
+        assert codes_of(out) == ["SGL010"]
+        assert "self.committed" in out[0].message
+
+    def test_fires_on_unguarded_read_paired_with_locked_write(self):
+        # NEW vs SGL004: a background read outside the lock every
+        # writer takes can observe torn/stale state
+        out = lint("""
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+
+                def start(self):
+                    threading.Thread(target=self._watch).start()
+
+                def _watch(self):
+                    return self.n
+        """, "SGL010")
+        assert codes_of(out) == ["SGL010"]
+        assert "unguarded read of self.n" in out[0].message
+
+    def test_conditional_heartbeat_callback_is_a_domain(self):
+        # the ServeEngine shape SGL004 missed: on_failure wired through
+        # an IfExp — both branches are concurrency domains
+        out = lint("""
+            from singa_tpu.utils.failure import Heartbeat
+
+            class Engine:
+                def run(self, recover):
+                    self.hb = Heartbeat(
+                        timeout=5.0,
+                        on_failure=(self._hb if recover
+                                    else self._user_cb))
+
+                def _hb(self, age, step):
+                    self.hung = True
+        """, "SGL010")
+        assert codes_of(out) == ["SGL010"]
+        assert "self.hung" in out[0].message
+
+    def test_signal_handler_is_a_domain(self):
+        out = lint("""
+            import signal
+
+            class Handler:
+                def install(self):
+                    signal.signal(signal.SIGTERM, self._handle)
+
+                def _handle(self, signum, frame):
+                    self.signum = signum
+        """, "SGL010")
+        assert codes_of(out) == ["SGL010"]
 
     def test_bare_annotation_is_not_a_write(self):
         out = lint("""
@@ -306,14 +371,18 @@ class TestThreadSeam:
 
                 def _run(self):
                     self.buf: list
-        """, "SGL004")
+        """, "SGL010")
         assert out == []
 
-    def test_clean_when_lock_guarded(self):
+    def test_clean_when_lock_guarded_or_mediated_or_init_only(self):
         out = lint("""
             import threading
 
             class Worker:
+                def __init__(self, cfg):
+                    self.cfg = cfg
+                    self._flag = threading.Event()
+
                 def start(self):
                     self._t = threading.Thread(target=self._run)
                     self._t.start()
@@ -321,7 +390,9 @@ class TestThreadSeam:
                 def _run(self):
                     with self._lock:
                         self.count = 1
-        """, "SGL004")
+                    self._flag.set()          # Event-mediated
+                    return self.cfg           # init-only read
+        """, "SGL010")
         assert out == []
 
     def test_clock_is_not_a_lock(self):
@@ -337,8 +408,8 @@ class TestThreadSeam:
                 def _run(self):
                     with self._clock:
                         self.count = 1
-        """, "SGL004")
-        assert codes_of(out) == ["SGL004"]
+        """, "SGL010")
+        assert codes_of(out) == ["SGL010"]
 
     def test_fires_on_heartbeat_callback(self):
         out = lint("""
@@ -351,8 +422,201 @@ class TestThreadSeam:
 
                 def _on_hang(self, age, step):
                     self.hung = True
-        """, "SGL004")
-        assert codes_of(out) == ["SGL004"]
+        """, "SGL010")
+        assert codes_of(out) == ["SGL010"]
+
+
+# ---------------------------------------------------------------------------
+# SGL011 conc-lock-order / SGL012 blocking-under-lock / SGL013
+# wait-predicate (conclint)
+# ---------------------------------------------------------------------------
+
+class TestLockOrder:
+    CYCLE = """
+        import threading
+
+        class AB:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def fwd(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        return 1
+
+            def rev(self):
+                with self._b_lock:
+                    self._take_a()
+
+            def _take_a(self):
+                with self._a_lock:
+                    return 2
+    """
+
+    def test_fires_on_opposite_order_across_call_edges(self):
+        out = lint(self.CYCLE, "SGL011")
+        assert codes_of(out) == ["SGL011"]
+        assert "deadlock" in out[0].message
+
+    def test_clean_when_order_is_consistent(self):
+        consistent = self.CYCLE.replace(
+            "with self._b_lock:\n                    self._take_a()",
+            "self._take_a()")
+        assert consistent != self.CYCLE                # replace landed
+        out = lint(consistent, "SGL011")
+        assert out == []
+
+    def test_multi_item_with_is_an_ordered_acquisition(self):
+        # `with a, b:` acquires left to right — reversing that order in
+        # a nested form elsewhere is the same textbook deadlock
+        out = lint("""
+            import threading
+
+            class AB:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+
+                def fwd(self):
+                    with self._a_lock, self._b_lock:
+                        return 1
+
+                def rev(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            return 2
+        """, "SGL011")
+        assert codes_of(out) == ["SGL011"]
+
+
+class TestBlockingUnderLock:
+    def test_fires_one_helper_level_deep(self):
+        out = lint("""
+            import time
+
+            class Sink:
+                def emit(self):
+                    with self._lock:
+                        self._slow()
+
+                def _slow(self):
+                    time.sleep(1.0)
+        """, "SGL012")
+        assert codes_of(out) == ["SGL012"]
+        assert "time.sleep" in out[0].message
+        assert "self._slow" in out[0].message
+
+    def test_thread_join_fires_but_str_join_does_not(self):
+        out = lint("""
+            class S:
+                def run(self, parts, sep, t):
+                    with self._mu:
+                        x = ",".join(parts)
+                        y = sep.join(parts)
+                        t.join()
+                    return x + y
+        """, "SGL012")
+        assert codes_of(out) == ["SGL012"]
+        assert "t.join()" in out[0].message
+
+    def test_clean_outside_the_lock(self):
+        out = lint("""
+            import time
+
+            class S:
+                def run(self):
+                    with self._lock:
+                        self.n += 1
+                    time.sleep(0.1)
+                    open("/tmp/x").close()
+        """, "SGL012")
+        assert out == []
+
+
+class TestWaitPredicate:
+    def test_event_wait_without_timeout_fires(self):
+        out = lint("""
+            import threading
+
+            done = threading.Event()
+
+            def waiter():
+                done.wait()
+        """, "SGL013")
+        assert codes_of(out) == ["SGL013"]
+        assert "timeout" in out[0].message
+
+    def test_condition_wait_outside_while_fires(self):
+        out = lint("""
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._cv = threading.Condition()
+
+                def pop(self):
+                    with self._cv:
+                        self._cv.wait(1.0)
+        """, "SGL013")
+        assert codes_of(out) == ["SGL013"]
+        assert "while" in out[0].message
+
+    def test_clean_with_timeout_and_predicate_loop(self):
+        out = lint("""
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._stop = threading.Event()
+                    self._cv = threading.Condition()
+
+                def run(self):
+                    while not self._stop.wait(0.5):
+                        pass
+
+                def pop(self):
+                    with self._cv:
+                        while not self.items:
+                            self._cv.wait(1.0)
+        """, "SGL013")
+        assert out == []
+
+
+# ---------------------------------------------------------------------------
+# SGL004 retirement: a documented alias that fails loudly
+# ---------------------------------------------------------------------------
+
+class TestSGL004Retirement:
+    def test_old_suppression_fails_loudly_with_migration_hint(self):
+        # the dangerous outcome would be the old comment silently
+        # suppressing NOTHING while still looking authoritative
+        out = lint_source(
+            "import threading\n"
+            "class W:\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._run).start()\n"
+            "    def _run(self):\n"
+            "        self.n = 1  # singalint: disable=SGL004 latch\n")
+        assert set(codes_of(out)) == {CODE_SUPPRESSION, "SGL010"}
+        hint = [f for f in out if f.code == CODE_SUPPRESSION][0]
+        assert "retired" in hint.message and "SGL010" in hint.message
+
+    def test_migrated_suppression_silences_sgl010(self):
+        out = lint_source(
+            "import threading\n"
+            "class W:\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._run).start()\n"
+            "    def _run(self):\n"
+            "        self.n = 1  # singalint: disable=SGL010 latch-once"
+            " bool, single writer\n")
+        assert out == []
+
+    def test_select_sgl004_errors_with_hint(self, capsys):
+        with pytest.raises(SystemExit):
+            lint_main(["--select", "SGL004", "x.py"])
+        assert "SGL010" in capsys.readouterr().err
 
 
 # ---------------------------------------------------------------------------
@@ -827,12 +1091,15 @@ class TestOutputAndCli:
         out = capsys.readouterr().out
         for code in RULES:
             assert code in out
-        for mode in ("records", "ckpt", "hlo", "cost"):
+        for mode in ("records", "ckpt", "conc", "hlo", "cost"):
             assert f"\n  {mode}" in out
         for code in HLO_CODES:
             assert code in out
         for code in COST_CODES:
             assert code in out
+        # conclint: the thread-model gate code and the retired alias
+        assert "SGL014" in out
+        assert "SGL004" in out and "retired" in out
 
     def test_cli_json(self, tmp_path, capsys):
         bad = tmp_path / "bad.py"
@@ -840,6 +1107,163 @@ class TestOutputAndCli:
         assert lint_main(["--json", str(bad)]) == 1
         doc = json.loads(capsys.readouterr().out)
         assert doc["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# conclint: the committed thread-model baseline (SGL014)
+# ---------------------------------------------------------------------------
+
+class TestThreadModel:
+    ROOTED = """
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def start(self):
+        threading.Thread(target=self._run).start()
+
+    def _run(self):
+        with self._lock:
+            self.n += 1
+"""
+
+    def _tree(self, tmp_path, src=None):
+        pkg = tmp_path / "singa_tpu"
+        pkg.mkdir(exist_ok=True)
+        (pkg / "w.py").write_text(src or self.ROOTED)
+        (tmp_path / "tools").mkdir(exist_ok=True)
+        (tmp_path / "tools" / "t.py").write_text("X = 1\n")
+        return [str(pkg), str(tmp_path / "tools")]
+
+    def test_discovery_finds_roots_and_classifies_shared(self, tmp_path):
+        from tools.lint import conc
+        model = conc.discover_model(self._tree(tmp_path),
+                                    root=str(tmp_path))
+        assert model["roots"] == {"singa_tpu/w.py::Worker._run": "thread"}
+        assert model["shared"] == {
+            "singa_tpu/w.py::Worker._lock": "mediated",
+            "singa_tpu/w.py::Worker.n": "lock-guarded"}
+
+    def test_baseline_update_round_trip(self, tmp_path):
+        from tools.lint import conc
+        paths = self._tree(tmp_path)
+        base = str(tmp_path / "model.json")
+        # no baseline: the gate fails loudly, never silently passes
+        missing = conc.gate_findings(paths=paths, baseline_path=base,
+                                     root=str(tmp_path))
+        assert [f.code for f in missing] == ["SGL014"]
+        assert "no committed thread-model baseline" in missing[0].message
+        # update writes the model and prints the reviewed diff ...
+        diff = conc.update_model_baseline(paths=paths,
+                                          baseline_path=base,
+                                          root=str(tmp_path))
+        assert "+ root singa_tpu/w.py::Worker._run: thread" in diff
+        # ... after which the gate is clean, and a no-op re-update says so
+        assert conc.gate_findings(paths=paths, baseline_path=base,
+                                  root=str(tmp_path)) == []
+        assert "unchanged" in conc.update_model_baseline(
+            paths=paths, baseline_path=base, root=str(tmp_path))
+
+    def test_new_thread_root_fails_loudly(self, tmp_path):
+        from tools.lint import conc
+        paths = self._tree(tmp_path)
+        base = str(tmp_path / "model.json")
+        conc.update_model_baseline(paths=paths, baseline_path=base,
+                                   root=str(tmp_path))
+        # an UNREGISTERED Thread(target=) appears -> loud, named finding
+        (tmp_path / "singa_tpu" / "w.py").write_text(
+            self.ROOTED + """
+
+class Sneaky:
+    def go(self):
+        threading.Thread(target=self._bg).start()
+
+    def _bg(self):
+        pass
+""")
+        out = conc.gate_findings(paths=paths, baseline_path=base,
+                                 root=str(tmp_path))
+        assert [f.code for f in out] == ["SGL014"]
+        assert "NEW thread root" in out[0].message
+        assert "Sneaky._bg" in out[0].message
+        assert "--update-baselines" in out[0].message
+
+    def test_deleted_baseline_entry_fails_loudly(self, tmp_path):
+        """The acceptance shape: removing a committed root's entry (a
+        hand-edit, or a stale baseline) fails until the reviewed
+        re-baseline runs."""
+        import json as _json
+
+        from tools.lint import conc
+        paths = self._tree(tmp_path)
+        base = str(tmp_path / "model.json")
+        conc.update_model_baseline(paths=paths, baseline_path=base,
+                                   root=str(tmp_path))
+        doc = _json.loads(open(base).read())
+        doc["roots"].pop("singa_tpu/w.py::Worker._run")
+        open(base, "w").write(_json.dumps(doc))
+        out = conc.gate_findings(paths=paths, baseline_path=base,
+                                 root=str(tmp_path))
+        assert [f.code for f in out] == ["SGL014"]
+        assert "NEW thread root" in out[0].message
+        # and the reviewed update flow clears it
+        conc.update_model_baseline(paths=paths, baseline_path=base,
+                                   root=str(tmp_path))
+        assert conc.gate_findings(paths=paths, baseline_path=base,
+                                  root=str(tmp_path)) == []
+
+    def test_classification_drift_fails_loudly(self, tmp_path):
+        from tools.lint import conc
+        paths = self._tree(tmp_path)
+        base = str(tmp_path / "model.json")
+        conc.update_model_baseline(paths=paths, baseline_path=base,
+                                   root=str(tmp_path))
+        # the guard vanishes: lock-guarded -> unguarded must be loud
+        (tmp_path / "singa_tpu" / "w.py").write_text(
+            self.ROOTED.replace("        with self._lock:\n"
+                                "            self.n += 1",
+                                "        self.n += 1"))
+        out = conc.gate_findings(paths=paths, baseline_path=base,
+                                 root=str(tmp_path))
+        # two honest findings: n's classification drifted, and the now
+        # unused _lock dropped out of the cross-thread table
+        assert set(f.code for f in out) == {"SGL014"}
+        assert any("lock-guarded -> unguarded" in f.message
+                   for f in out)
+
+    def test_stale_root_in_baseline_fails_loudly(self, tmp_path):
+        from tools.lint import conc
+        paths = self._tree(tmp_path)
+        base = str(tmp_path / "model.json")
+        conc.update_model_baseline(paths=paths, baseline_path=base,
+                                   root=str(tmp_path))
+        (tmp_path / "singa_tpu" / "w.py").write_text("Y = 2\n")
+        out = conc.gate_findings(paths=paths, baseline_path=base,
+                                 root=str(tmp_path))
+        codes = [f.code for f in out]
+        assert codes and set(codes) == {"SGL014"}
+        assert any("was not discovered" in f.message for f in out)
+
+
+def test_ci_gate_picks_up_conclint_with_no_stage_renumbering():
+    """tools/ci_gate.sh stage 1 is the bare `python -m tools.lint`
+    full audit, which now includes the conc thread-model gate — so
+    conclint rides in with NO stage renumbering (ISSUE 15 satellite):
+    the script still declares exactly stages 1/7..7/7 and its stage-1
+    command is still the bare invocation."""
+    sh = open(os.path.join(REPO, "tools", "ci_gate.sh")).read()
+    for n in range(1, 8):
+        assert f"stage {n}/7" in sh, f"stage {n}/7 vanished/renumbered"
+    assert "stage 8" not in sh
+    stage1 = sh.split("stage 2/7")[0]
+    assert "python -m tools.lint || exit 10" in stage1
+    # and the bare invocation really runs the conc gate (CLI contract)
+    from tools.lint.__main__ import _AUDIT_MODES
+    assert "conc" in _AUDIT_MODES
 
 
 # ---------------------------------------------------------------------------
@@ -853,4 +1277,16 @@ def test_repo_is_clean():
     A REASON (see docs/static-analysis.md for the policy)."""
     findings = run_paths([os.path.join(REPO, "singa_tpu"),
                           os.path.join(REPO, "tools")])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_repo_thread_model_is_clean():
+    """The committed tools/lint/data/conc/model.json matches the
+    tree's discovered thread mesh exactly: every concurrency domain
+    and cross-thread attribute in HEAD has been reviewed.  A finding
+    here means: review the new/changed domain, then run
+    `python -m tools.lint --conc --update-baselines` and commit the
+    diff it prints (docs/static-analysis.md, "Concurrency audit")."""
+    from tools.lint import conc
+    findings = conc.gate_findings()
     assert findings == [], "\n".join(f.render() for f in findings)

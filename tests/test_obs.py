@@ -222,6 +222,99 @@ class TestEvents:
         assert ev["error"] == "RuntimeError"
 
 
+class TestConcurrencyRegressions:
+    """Forced-interleaving reproductions of the ISSUE 15 conclint
+    fixes (two threads + a scheduling hook each): these tests FAIL on
+    the pre-fix code — the hook steers the exact window the race
+    needs, so the reproduction is deterministic, not statistical."""
+
+    def test_sink_swap_mid_emit_does_not_crash(self, tmp_path,
+                                               monkeypatch):
+        """obs.events._emit used to read the module-global ``_sink``
+        twice (liveness check, then use); a concurrent ``configure()``
+        clearing the sink between them crashed the EMITTING thread —
+        i.e. the train/serve step loop — with AttributeError.  The fix
+        snapshots the reference once; emitting into the just-closed
+        sink is a silent no-op.  Hook: ``trace.current_trace_id`` runs
+        between the two accesses, so patching it to run the concurrent
+        configure() on another thread forces the interleave."""
+        import threading
+
+        from singa_tpu.obs import trace as obs_trace
+
+        events.configure(path=str(tmp_path / "ev.jsonl"))
+        real = obs_trace.current_trace_id
+        swapped = threading.Event()
+
+        def hook():
+            t = threading.Thread(
+                target=lambda: (events.configure(), swapped.set()))
+            t.start()
+            assert swapped.wait(5.0), "concurrent configure() wedged"
+            t.join(5.0)
+            return real()
+
+        monkeypatch.setattr(obs_trace, "current_trace_id", hook)
+        # pre-fix: AttributeError ('NoneType' object has no 'emit')
+        events.counter("conc.race", 1)
+        monkeypatch.setattr(obs_trace, "current_trace_id", real)
+        assert events.get_sink() is None    # the swap really landed
+
+    def test_flight_register_during_broadcast_is_serialized(
+            self, monkeypatch):
+        """obs.flight.broadcast used to iterate the live ``_RECORDERS``
+        WeakSet while register() (another thread building an engine)
+        could add to it — 'Set changed size during iteration' raised on
+        the BROADCASTING thread, inside faults.fire on the step path.
+        The fix snapshots the set under a registry lock that register()
+        shares.  Hook: an instrumented WeakSet whose iteration pauses
+        mid-way while the other thread attempts to register."""
+        import threading
+        import weakref
+
+        from singa_tpu.obs import flight
+
+        recs = [flight.FlightRecorder(capacity=4) for _ in range(3)]
+        mid_iter = threading.Event()
+        reg_attempted = threading.Event()
+
+        class SlowIterSet(weakref.WeakSet):
+            def __iter__(self):
+                first = True
+                for x in super().__iter__():
+                    if first:
+                        first = False
+                        mid_iter.set()
+                        # give the registering thread its window; on
+                        # the fixed code it blocks on the registry
+                        # lock, so this deliberately times out
+                        reg_attempted.wait(0.3)
+                    yield x
+
+        slow = SlowIterSet(recs)
+        monkeypatch.setattr(flight, "_RECORDERS", slow)
+        late = flight.FlightRecorder(capacity=4)
+        reg_done = threading.Event()
+
+        def do_register():
+            assert mid_iter.wait(5.0)
+            flight.register(late)       # pre-fix: lands mid-iteration
+            reg_attempted.set()
+            reg_done.set()
+
+        t = threading.Thread(target=do_register)
+        t.start()
+        # pre-fix: RuntimeError('Set changed size during iteration')
+        flight.broadcast("counter", "conc.race")
+        t.join(5.0)
+        assert reg_done.is_set(), "register() never completed"
+        for r in recs:
+            assert [e["name"] for e in r.snapshot()] == ["conc.race"]
+        # the late ring is subscribed from the next broadcast on
+        flight.broadcast("counter", "conc.race2")
+        assert [e["name"] for e in late.snapshot()] == ["conc.race2"]
+
+
 class _TinyMLP(st.model.Model):
     def __init__(self):
         super().__init__()

@@ -23,3 +23,4 @@ from .framework import (  # noqa: F401
     run_paths,
 )
 from . import rules  # noqa: F401  (importing registers every rule)
+from . import conc  # noqa: F401  (registers SGL010-SGL013, conclint)

@@ -8,8 +8,9 @@ Static (explicit paths)::
     python -m tools.lint --list-rules
 
 Full audit (no paths, no mode flags): static rules over the repo's own
-trees (``singa_tpu``, ``tools``) AND the compiled-program gates — HLO
-structure (hloaudit) plus cost/memory (hlocost), off ONE shared
+trees (``singa_tpu``, ``tools``), the concurrency thread-model gate
+(conclint, ``tools/lint/conc.py``), AND the compiled-program gates —
+HLO structure (hloaudit) plus cost/memory (hlocost), off ONE shared
 lowering::
 
     python -m tools.lint
@@ -20,10 +21,12 @@ Dynamic audits (same checks the old standalone CLIs ran)::
     python -m tools.lint --ckpt DIR [DIR ...]     # checkpoint fsck
     python -m tools.lint --hlo                    # structure + cost gates
     python -m tools.lint --hlo --update-baselines # reviewed re-baseline
+    python -m tools.lint --conc                   # thread-model gate
+    python -m tools.lint --conc --update-baselines  # reviewed re-model
 
 ``--select`` filters audit modes too (``--select hlo``,
-``--select cost``, ``--select records``, or mixed with SGL codes in
-the full audit).
+``--select cost``, ``--select conc``, ``--select records``, or mixed
+with SGL codes in the full audit).
 
 Exit codes: 0 clean, 1 findings/errors, 2 usage error.
 """
@@ -49,6 +52,10 @@ _AUDIT_MODES = {
                "docs, runs/records.jsonl) — also via --records [ROOT]",
     "ckpt": "checkpoint-directory fsck (commit markers, manifests) — "
             "via --ckpt DIR [DIR ...] only, it needs the directory",
+    "conc": "concurrency thread-model gate (conclint): diff the "
+            "discovered thread roots + cross-thread attribute table "
+            "against tools/lint/data/conc/model.json — also via "
+            "--conc (re-baseline with --conc --update-baselines)",
     "hlo": "compiled-program structural gate: lower the flagship train/"
            "prefill/decode programs and diff fusions, collectives, "
            "donation vs tools/lint/data/hlo/ — also via --hlo (which "
@@ -64,7 +71,9 @@ _DEFAULT_TREES = ("singa_tpu", "tools")
 
 
 def _list_rules() -> str:
+    from .conc import CONC_GATE_CODES
     from .cost import COST_CODES
+    from .framework import RETIRED_CODES
     from .hlo import HLO_CODES
     lines = ["singalint rules:"]
     for code, cls in RULES.items():
@@ -72,6 +81,15 @@ def _list_rules() -> str:
     lines.append("  SGL000 suppression-hygiene  a '# singalint: "
                  "disable=CODE' without a reason, or naming an unknown "
                  "code, is itself a finding and cannot be suppressed")
+    for code, successor in sorted(RETIRED_CODES.items()):
+        lines.append(f"  {code}  (retired)          superseded by "
+                     f"{successor}; a disable={code} suppression fails "
+                     f"loudly with a migration hint")
+    lines.append("conc gate finding codes (the committed thread-model "
+                 "baseline, tools/lint/conc.py; re-baseline via "
+                 "--conc --update-baselines):")
+    for code, (name, desc) in CONC_GATE_CODES.items():
+        lines.append(f"  {code}  {name:<21} {desc}")
     lines.append("audit modes (run via their flag, or --select MODE):")
     for mode, desc in _AUDIT_MODES.items():
         lines.append(f"  {mode:<7} {desc}")
@@ -117,21 +135,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="run the compiled-program gates (structure "
                              "AND cost, off one shared lowering) against "
                              "tools/lint/data/hlo/ baselines")
+    parser.add_argument("--conc", action="store_true",
+                        help="run the concurrency thread-model gate "
+                             "(conclint) against "
+                             "tools/lint/data/conc/model.json")
     parser.add_argument("--update-baselines", action="store_true",
-                        help="re-lower the flagship programs and "
-                             "rewrite the HLO structure + cost "
-                             "baselines, printing a human-readable "
-                             "metric diff (implies --hlo)")
+                        help="rewrite the committed baselines, printing "
+                             "a human-readable diff to review: with "
+                             "--conc the thread model; otherwise the "
+                             "HLO structure + cost baselines (implies "
+                             "--hlo)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
         print(_list_rules())
         return 0
-    if args.update_baselines:
+    if args.update_baselines and not args.conc:
         args.hlo = True
     mode_flags = [f for f, on in (("--records", args.records is not None),
                                   ("--ckpt", args.ckpt is not None),
-                                  ("--hlo", args.hlo)) if on]
+                                  ("--hlo", args.hlo),
+                                  ("--conc", args.conc)) if on]
     if len(mode_flags) > 1:
         parser.error(f"{' and '.join(mode_flags)} are separate audit "
                      f"modes")
@@ -149,8 +173,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         unknown = [c for c in raw if c not in RULES
                    and c not in _AUDIT_MODES]
         if unknown:
+            from .framework import RETIRED_CODES
+            retired = [f"{c} was retired — use {RETIRED_CODES[c]}"
+                       for c in unknown if c in RETIRED_CODES]
             parser.error(f"unknown rule code(s)/mode(s): "
-                         f"{', '.join(unknown)} (see --list-rules)")
+                         f"{', '.join(unknown)} (see --list-rules"
+                         + (f"; {'; '.join(retired)}" if retired else "")
+                         + ")")
         if "ckpt" in selected_modes:
             parser.error("the ckpt audit needs its directories — run "
                          "it as --ckpt DIR [DIR ...]")
@@ -164,6 +193,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         return audit.records_main(root)
     if args.ckpt is not None:
         return audit.ckpt_main(args.ckpt)
+    if args.conc:
+        from . import conc
+        if args.update_baselines:
+            print(conc.update_model_baseline())
+            print(f"conclint: thread-model baseline updated at "
+                  f"{conc.MODEL_PATH} — review the diff above")
+            return 0
+        findings = conc.gate_findings()
+        print(render_json(findings) if args.json
+              else render_human(findings).replace("singalint:",
+                                                  "conclint:"))
+        return 1 if findings else 0
     if args.hlo:
         from .hlo import hlo_main
         try:
@@ -174,11 +215,14 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if not args.paths:
         # the full audit: static rules over the repo trees + the
+        # concurrency thread-model gate (conclint) + the
         # compiled-program gates (or the --select'ed subset) — the
-        # structure and cost gates always share ONE lowering pass
+        # structure and cost gates always share ONE lowering pass,
+        # and the conc gate reuses the static pass's parse cache
         run_static = codes is None or bool(codes)
         run_hlo = not args.select or "hlo" in selected_modes
         run_cost = not args.select or "cost" in selected_modes
+        run_conc = not args.select or "conc" in selected_modes
         run_records = "records" in selected_modes
         rc = 0
         findings = []
@@ -189,6 +233,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                 findings = run_paths(trees, codes)
             except ValueError as e:
                 parser.error(str(e))
+        if run_conc:
+            from . import conc
+            findings = sorted(
+                findings + conc.gate_findings(),
+                key=lambda f: (f.path, f.line, f.col, f.code))
+        if run_static or run_conc:
             # with --json AND a gate half, the static findings merge
             # into the gate's single document — stdout must stay ONE
             # parseable JSON object

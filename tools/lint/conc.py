@@ -1,0 +1,868 @@
+"""conclint — the whole-program concurrency audit (ISSUE 15).
+
+The stack serves and trains through a real host-side thread mesh —
+Heartbeat monitors, the async checkpoint writer, ``ServeEngine``
+recovery via ``threading.Event``, the flight recorder's broadcast
+registry, signal handlers in ``train/preempt.py`` — but until this
+module the only static guard was SGL004's shallow "unguarded ``self.*``
+write in a thread-target method" check.  conclint turns the invariants
+the chaos tests probe dynamically into a static gate, the same
+"committed baseline + named finding + reviewed diff" shape hloaudit
+gave the compiled programs:
+
+1. **thread-root discovery** — an AST pass that registers every
+   concurrency domain: ``threading.Thread(target=...)``, Heartbeat
+   ``on_failure=`` callbacks (including conditional ``a if c else b``
+   forms), ``executor.submit(...)`` targets, ``signal.signal(...)``
+   handlers, and the ``obs.trace.capture()``/``attach()`` hand-off
+   seams.  Reuses the per-parse module cache (PR 5) and the framework's
+   parse cache, so the bare repo-wide run parses each file once.
+2. **a shared-state classifier** (rule **SGL010**, superseding SGL004):
+   for every class that spawns a concurrency domain, each ``self.*``
+   attribute its background-reachable methods touch is classified
+   *lock-guarded* (SGL004's whole-segment guard recognizer),
+   *mediated* (the attribute is itself an Event/Condition/Lock/queue),
+   *init-only* (written nowhere but ``__init__`` — immutable after
+   construction), or *unguarded*.  Unguarded background WRITES are
+   findings (the SGL004 behavior), and — new — unguarded background
+   READS of an attribute that has a lock-guarded access elsewhere in
+   the class are findings too: a read outside the lock that every
+   writer takes can observe torn or stale state.
+3. **a lock-order graph** across call edges with cycle detection
+   (**SGL011** deadlock), **SGL012** blocking-under-lock
+   (``time.sleep``, ``jax.device_get``/``block_until_ready``, file
+   ``open``, ``os.fsync``, ``.join()``/``.result()`` while a lock is
+   held — one helper level deep), and **SGL013**
+   ``Event.wait``/``Condition.wait`` without a timeout or enclosing
+   predicate loop.
+4. **a committed thread-model baseline**
+   (``tools/lint/data/conc/model.json``): the discovered roots +
+   shared-state table.  The gate (**SGL014**) diffs the tree's model
+   against the committed one, so a NEW thread root or a newly
+   cross-thread attribute becomes a loud, human-reviewed diff — run
+   ``python -m tools.lint --conc --update-baselines`` and review what
+   it prints — instead of silent drift.
+
+Scope limits (same contract as the other rules, documented in
+docs/static-analysis.md): analysis is module-local and name-based.  The
+guard recognizer matches whole name segments (``self._lock``,
+``state_lock``; ``self._clock`` does not guard); mediation is
+recognized by ``self.x = threading.Event()``-shaped assignments; a lock
+passed in from outside the class, dynamic dispatch, and cross-module
+call chains are invisible by design — the forced-interleaving stress
+tests cover the runtime half.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .framework import Finding, Rule, register, iter_python_files, \
+    parse_file
+from .rules import (_class_of, _is_guard_name, _lock_guarded, _methods,
+                    _module_cache, _self_method, build_parents,
+                    dotted_name, import_map, module_nodes)
+
+__all__ = ["discover_model", "gate_findings", "update_model_baseline",
+           "MODEL_PATH", "CONC_SCHEMA", "CONC_GATE_CODES",
+           "DEFAULT_TREES"]
+
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+#: the committed thread-model baseline — the reviewed record of every
+#: concurrency domain and cross-thread attribute in the audited trees
+MODEL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "data", "conc", "model.json")
+
+#: model format version — bump on incompatible shape changes; a
+#: baseline with another version fails the gate instead of diffing
+#: garbage (same contract as the HLO summary schema)
+CONC_SCHEMA = 1
+
+#: the trees the thread model covers — the same set the bare full
+#: audit lints (tools/lint/__main__._DEFAULT_TREES)
+DEFAULT_TREES = ("singa_tpu", "tools")
+
+#: the baseline gate's finding code, enumerated by --list-rules next to
+#: the HLO/COST families (it is a gate code, not a per-module rule)
+CONC_GATE_CODES = {
+    "SGL014": ("thread-model", "the discovered thread roots and "
+               "cross-thread attribute table match the committed "
+               "baseline tools/lint/data/conc/model.json — a new "
+               "concurrency domain or newly shared attribute fails "
+               "loudly until '--conc --update-baselines' is run and "
+               "the diff reviewed"),
+}
+
+#: synchronization primitives whose attribute assignment marks an
+#: attribute as *mediated*: raw reads/method calls on it are the safe
+#: cross-thread protocol, not a race
+_SYNC_CTORS = frozenset({
+    "Event", "Condition", "Lock", "RLock", "Semaphore",
+    "BoundedSemaphore", "Barrier", "Queue", "SimpleQueue", "LifoQueue",
+    "PriorityQueue", "deque",
+})
+
+#: calls that block the calling thread (SGL012's set): holding a lock
+#: across one stalls every contending thread for the full duration
+_BLOCKING_CALLS = {
+    "time.sleep": "time.sleep()",
+    "jax.device_get": "jax.device_get() (device->host transfer)",
+    "jax.block_until_ready": "jax.block_until_ready()",
+    "os.fsync": "os.fsync()",
+    "open": "open() (file I/O)",
+}
+
+
+# ---------------------------------------------------------------------------
+# shared per-class concurrency analysis (cached on the parsed module)
+# ---------------------------------------------------------------------------
+
+def _callback_targets(expr: ast.AST) -> List[str]:
+    """``self.<m>`` method names an expression may call back into —
+    follows conditional forms (``self._a if flag else self._b``) and
+    boolean fallbacks (``self._cb or default``), because that is how
+    ServeEngine wires its Heartbeat callback."""
+    out: List[str] = []
+    m = _self_method(expr)
+    if m:
+        out.append(m)
+    elif isinstance(expr, ast.IfExp):
+        out.extend(_callback_targets(expr.body))
+        out.extend(_callback_targets(expr.orelse))
+    elif isinstance(expr, ast.BoolOp):
+        for v in expr.values:
+            out.extend(_callback_targets(v))
+    return out
+
+
+def _local_def_name(expr: ast.AST,
+                    defs: Dict[str, List[ast.FunctionDef]]) -> Optional[str]:
+    """Bare name resolving to a function defined in this module (the
+    ``Thread(target=probe)`` local-closure form) — a plain variable
+    (e.g. a prompt array passed to ``engine.submit``) is NOT a root."""
+    if isinstance(expr, ast.Name) and expr.id in defs:
+        return expr.id
+    return None
+
+
+def _bg_entries(cls: ast.ClassDef,
+                imports: Dict[str, str]) -> Dict[str, str]:
+    """method name -> how it reaches a concurrency domain."""
+    bg: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        full = _resolve(node.func, imports)
+        fname = dotted_name(node.func) or ""
+        if full in ("threading.Thread", "Thread") or \
+                full.endswith(".Thread"):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    for m in _callback_targets(kw.value):
+                        bg.setdefault(m, "threading.Thread target")
+        elif fname.endswith(".submit") and node.args:
+            for m in _callback_targets(node.args[0]):
+                bg.setdefault(m, "executor.submit target")
+        elif full.rsplit(".", 1)[-1] == "Heartbeat":
+            for kw in node.keywords:
+                if kw.arg == "on_failure":
+                    for m in _callback_targets(kw.value):
+                        bg.setdefault(m, "Heartbeat on_failure callback")
+        elif full == "signal.signal" and len(node.args) >= 2:
+            for m in _callback_targets(node.args[1]):
+                bg.setdefault(m, "signal handler")
+    return bg
+
+
+def _resolve(node: ast.AST, imports: Dict[str, str]) -> str:
+    from .rules import resolve
+    return resolve(node, imports) or ""
+
+
+def _reachable_closure(methods: Dict[str, ast.FunctionDef],
+                       bg: Dict[str, str]) -> Dict[str, str]:
+    """Transitive closure of ``self.<m>()`` calls from the background
+    entry points — deeper than SGL001/SGL008's one level, because a
+    writer thread's work is routinely two hops from its submit target
+    (``_write_traced -> _write -> _commit`` in train/ckpt.py)."""
+    reach: Dict[str, str] = {m: how for m, how in bg.items()
+                             if m in methods}
+    frontier = list(reach)
+    while frontier:
+        m = frontier.pop()
+        for node in ast.walk(methods[m]):
+            if isinstance(node, ast.Call):
+                h = _self_method(node.func)
+                if h and h in methods and h not in reach:
+                    reach[h] = f"called from {m}() ({reach[m]})" \
+                        if "called from" not in reach[m] \
+                        else reach[m]
+                    frontier.append(h)
+    return reach
+
+
+def _mediated_attrs(cls: ast.ClassDef,
+                    imports: Dict[str, str]) -> Set[str]:
+    """Attributes assigned a synchronization primitive anywhere in the
+    class (``self._stop = threading.Event()``)."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        ctor = _resolve(node.value.func, imports)
+        if ctor.rsplit(".", 1)[-1] not in _SYNC_CTORS:
+            continue
+        for t in node.targets:
+            d = dotted_name(t)
+            if d and d.startswith("self.") and d.count(".") == 1:
+                out.add(d.split(".", 1)[1])
+    return out
+
+
+def _attr_accesses(body: ast.AST) -> List[Tuple[ast.AST, str, bool]]:
+    """(node, attr, is_write) for every plain ``self.<attr>`` touched
+    in ``body`` — method calls (``self.helper()``) are excluded by the
+    caller via the class's method table.  A bare ``self.x: T``
+    annotation stores nothing and is neither read nor write."""
+    bare_ann: Set[int] = {
+        id(n.target) for n in ast.walk(body)
+        if isinstance(n, ast.AnnAssign) and n.value is None}
+    out: List[Tuple[ast.AST, str, bool]] = []
+    for node in ast.walk(body):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and id(node) not in bare_ann:
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            out.append((node, node.attr, is_write))
+    return out
+
+
+def _class_conc(tree: ast.Module, cls: ast.ClassDef,
+                imports: Dict[str, str],
+                parents: Dict[ast.AST, ast.AST]) -> Dict:
+    """The per-class concurrency facts every conc rule (and the model
+    discovery) shares — computed once per parse via the module cache."""
+    cache = _module_cache(tree).setdefault("conc_classes", {})
+    if id(cls) in cache:
+        return cache[id(cls)]
+    methods = _methods(cls)
+    bg = _bg_entries(cls, imports)
+    reach = _reachable_closure(methods, bg)
+    mediated = _mediated_attrs(cls, imports)
+    init = methods.get("__init__")
+    init_nodes: Set[int] = {id(n) for n in ast.walk(init)} \
+        if init is not None else set()
+
+    # every access in every method: attr -> facts
+    written_outside_init: Set[str] = set()
+    guarded_anywhere: Set[str] = set()
+    for mname, body in methods.items():
+        for node, attr, is_write in _attr_accesses(body):
+            if attr in methods:
+                continue
+            if is_write and id(node) not in init_nodes:
+                written_outside_init.add(attr)
+            if _lock_guarded(node, parents, body):
+                guarded_anywhere.add(attr)
+
+    info = {"methods": methods, "bg": bg, "reach": reach,
+            "mediated": mediated,
+            "written_outside_init": written_outside_init,
+            "guarded_anywhere": guarded_anywhere}
+    cache[id(cls)] = info
+    return info
+
+
+# ---------------------------------------------------------------------------
+# SGL010 conc-shared-state (supersedes SGL004 thread-seam)
+# ---------------------------------------------------------------------------
+
+@register
+class SharedStateRule(Rule):
+    code = "SGL010"
+    name = "conc-shared-state"
+    description = ("attributes shared with a concurrency domain "
+                   "(Thread target, executor.submit, Heartbeat "
+                   "on_failure, signal handler — transitive self.* "
+                   "call closure) must be lock-guarded or "
+                   "Event/queue-mediated: unguarded background writes, "
+                   "and unguarded background reads of attributes with "
+                   "lock-guarded accesses elsewhere, are findings "
+                   "(supersedes the retired SGL004)")
+
+    def check(self, tree: ast.Module, src: str,
+              path: str) -> Iterable[Finding]:
+        imports = import_map(tree)
+        parents = build_parents(tree)
+        for cls in [n for n in module_nodes(tree)
+                    if isinstance(n, ast.ClassDef)]:
+            info = _class_conc(tree, cls, imports, parents)
+            if not info["reach"]:
+                continue
+            methods = info["methods"]
+            for m, how in info["reach"].items():
+                body = methods[m]
+                reported: Set[Tuple[int, int]] = set()
+                for node, attr, is_write in _attr_accesses(body):
+                    if attr in methods or attr in info["mediated"]:
+                        continue
+                    if _lock_guarded(node, parents, body):
+                        continue
+                    key = (node.lineno, node.col_offset)
+                    if key in reported:
+                        continue
+                    if is_write:
+                        reported.add(key)
+                        yield self.finding(
+                            path, node,
+                            f"write to self.{attr} in "
+                            f"{cls.name}.{m}(), which runs "
+                            f"concurrently with the main thread "
+                            f"({how}), is not lock-guarded — guard "
+                            f"it, mediate it through an Event/queue, "
+                            f"or suppress with the reason it is safe")
+                    elif attr in info["guarded_anywhere"]:
+                        reported.add(key)
+                        yield self.finding(
+                            path, node,
+                            f"unguarded read of self.{attr} in "
+                            f"{cls.name}.{m}() ({how}): other "
+                            f"accesses of self.{attr} in this class "
+                            f"take a lock, so this read can observe "
+                            f"torn or stale state — take the same "
+                            f"lock, or suppress with why the race is "
+                            f"benign")
+
+
+# ---------------------------------------------------------------------------
+# SGL011 lock-order — cycle detection over the acquisition graph
+# ---------------------------------------------------------------------------
+
+def _lock_id(expr: ast.AST, cls: Optional[ast.ClassDef]) -> Optional[str]:
+    """Canonical id of a guard-named context expression: ``self._lock``
+    inside class C becomes ``C._lock`` so acquisitions in different
+    methods of one class correlate; module-level names stay as-is."""
+    d = dotted_name(expr)
+    if not d or not _is_guard_name(d):
+        return None
+    if d.startswith("self.") and cls is not None:
+        return f"{cls.name}.{d[len('self.'):]}"
+    return d
+
+
+def _with_guards(node: ast.With,
+                 cls: Optional[ast.ClassDef]) -> List[str]:
+    return [lid for item in node.items
+            for lid in [_lock_id(item.context_expr, cls)]
+            if lid is not None]
+
+
+def _helper_bodies(call: ast.Call, methods: Dict[str, ast.FunctionDef],
+                   defs: Dict[str, List[ast.FunctionDef]]
+                   ) -> List[ast.FunctionDef]:
+    """One level of callee bodies for a call made while a lock is held:
+    same-class ``self.helper()`` and locally-defined bare-name
+    functions."""
+    name = dotted_name(call.func)
+    if name is None:
+        return []
+    if name.startswith("self.") and name.count(".") == 1:
+        h = methods.get(name.split(".", 1)[1])
+        return [h] if h is not None else []
+    if "." not in name and name in defs:
+        return [defs[name][0]]
+    return []
+
+
+@register
+class LockOrderRule(Rule):
+    code = "SGL011"
+    name = "conc-lock-order"
+    description = ("lock acquisition order must be acyclic across the "
+                   "module's call edges (one helper level): thread A "
+                   "holding L1 wanting L2 while thread B holds L2 "
+                   "wanting L1 is a deadlock, not a slowdown")
+
+    def check(self, tree: ast.Module, src: str,
+              path: str) -> Iterable[Finding]:
+        from .rules import _collect_defs
+        parents = build_parents(tree)
+        defs = _collect_defs(tree)
+        # edges: held lock -> acquired-while-held lock, with a witness
+        edges: Dict[Tuple[str, str], ast.AST] = {}
+
+        def note_inner(outer: List[str], body: ast.AST,
+                       cls: Optional[ast.ClassDef],
+                       follow_helpers: bool) -> None:
+            for sub in ast.walk(body):
+                if isinstance(sub, ast.With):
+                    for inner in _with_guards(sub, cls):
+                        for o in outer:
+                            if o != inner:
+                                edges.setdefault((o, inner), sub)
+                elif follow_helpers and isinstance(sub, ast.Call):
+                    methods = _methods(cls) if cls is not None else {}
+                    for h in _helper_bodies(sub, methods, defs):
+                        note_inner(outer, h, _class_of(h, parents),
+                                   follow_helpers=False)
+
+        for node in module_nodes(tree):
+            if not isinstance(node, ast.With):
+                continue
+            cls = _class_of(node, parents)
+            held = _with_guards(node, cls)
+            if not held:
+                continue
+            # a multi-item `with a, b:` acquires left to right — those
+            # ARE ordered acquisitions, same as textual nesting
+            for i, outer in enumerate(held):
+                for inner in held[i + 1:]:
+                    if outer != inner:
+                        edges.setdefault((outer, inner), node)
+            for stmt in node.body:
+                note_inner(held, stmt, cls, follow_helpers=True)
+
+        # cycle detection (DFS) over the module-wide acquisition graph
+        graph: Dict[str, List[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, []).append(b)
+        reported: Set[Tuple[str, str]] = set()
+        for start in sorted(graph):
+            stack = [(start, [start])]
+            while stack:
+                cur, chain = stack.pop()
+                for nxt in sorted(graph.get(cur, [])):
+                    if nxt == start:
+                        key = tuple(sorted((start, cur)))
+                        if key in reported:
+                            continue
+                        reported.add(key)
+                        witness = edges[(cur, start)]
+                        cycle = " -> ".join(chain + [start])
+                        yield self.finding(
+                            path, witness,
+                            f"lock-order cycle: {cycle} — two threads "
+                            f"taking these locks in opposite order "
+                            f"deadlock; pick one global order and "
+                            f"stick to it")
+                    elif nxt not in chain:
+                        stack.append((nxt, chain + [nxt]))
+
+
+# ---------------------------------------------------------------------------
+# SGL012 blocking-under-lock
+# ---------------------------------------------------------------------------
+
+@register
+class BlockingUnderLockRule(Rule):
+    code = "SGL012"
+    name = "conc-blocking-under-lock"
+    description = ("no blocking call (time.sleep, jax.device_get/"
+                   "block_until_ready, open/os.fsync file I/O, "
+                   ".join()/.result() waits) while holding a lock — "
+                   "one helper level deep; every contending thread "
+                   "stalls for the full duration — or suppress with "
+                   "why the stall is the design")
+
+    def _blocking(self, node: ast.Call,
+                  imports: Dict[str, str]) -> Optional[str]:
+        from .rules import resolve
+        full = resolve(node.func, imports) or ""
+        if full in _BLOCKING_CALLS:
+            return _BLOCKING_CALLS[full]
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("join", "result") and not node.args:
+            # zero positional args: thread.join(timeout=...) /
+            # future.result() — a positional arg means str.join(parts)
+            if dotted_name(node.func) is not None:
+                return f"{dotted_name(node.func)}() " \
+                       f"({'thread join' if node.func.attr == 'join' else 'future wait'})"
+        return None
+
+    def check(self, tree: ast.Module, src: str,
+              path: str) -> Iterable[Finding]:
+        from .rules import _collect_defs
+        imports = import_map(tree)
+        parents = build_parents(tree)
+        defs = _collect_defs(tree)
+        reported: Set[Tuple[int, int]] = set()
+
+        def scan(body: ast.AST, lock: str, cls, via: Optional[str],
+                 follow: bool):
+            for sub in ast.walk(body):
+                if not isinstance(sub, ast.Call):
+                    continue
+                shown = self._blocking(sub, imports)
+                if shown is not None:
+                    key = (sub.lineno, sub.col_offset)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    chain = f" (reached via {via}())" if via else ""
+                    yield self.finding(
+                        path, sub,
+                        f"blocking call {shown} while holding "
+                        f"{lock}{chain}: every thread contending the "
+                        f"lock stalls for the full duration — move it "
+                        f"outside the guarded region, or suppress "
+                        f"with why the stall is the design")
+                elif follow:
+                    methods = _methods(cls) if cls is not None else {}
+                    for h in _helper_bodies(sub, methods, defs):
+                        hname = dotted_name(sub.func)
+                        yield from scan(h, lock, _class_of(h, parents),
+                                        hname, follow=False)
+
+        for node in module_nodes(tree):
+            if not isinstance(node, ast.With):
+                continue
+            cls = _class_of(node, parents)
+            held = _with_guards(node, cls)
+            if not held:
+                continue
+            for stmt in node.body:
+                yield from scan(stmt, held[0], cls, None, follow=True)
+
+
+# ---------------------------------------------------------------------------
+# SGL013 wait-predicate
+# ---------------------------------------------------------------------------
+
+def _sync_vars(tree: ast.Module, imports: Dict[str, str]
+               ) -> Dict[str, str]:
+    """name (``self.x`` or bare local/module name) -> primitive kind
+    ('Event' or 'Condition') for every ``= threading.Event()``-shaped
+    assignment in the module."""
+    from .rules import resolve
+    out: Dict[str, str] = {}
+    for node in module_nodes(tree):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        ctor = (resolve(node.value.func, imports) or "").rsplit(".", 1)[-1]
+        if ctor not in ("Event", "Condition"):
+            continue
+        for t in node.targets:
+            d = dotted_name(t)
+            if d:
+                out[d] = ctor
+    return out
+
+
+@register
+class WaitPredicateRule(Rule):
+    code = "SGL013"
+    name = "conc-wait-predicate"
+    description = ("Event.wait() must carry a timeout (a dead setter "
+                   "wedges the waiter forever), and Condition.wait() "
+                   "must sit inside a while predicate loop (wakeups "
+                   "are spurious and racy by spec)")
+
+    def check(self, tree: ast.Module, src: str,
+              path: str) -> Iterable[Finding]:
+        imports = import_map(tree)
+        parents = build_parents(tree)
+        sync = _sync_vars(tree, imports)
+        if not sync:
+            return
+        for node in module_nodes(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "wait"):
+                continue
+            recv = dotted_name(node.func.value)
+            kind = sync.get(recv or "")
+            if kind is None:
+                continue
+            if kind == "Event":
+                has_timeout = bool(node.args) or any(
+                    kw.arg == "timeout" for kw in node.keywords)
+                if not has_timeout:
+                    yield self.finding(
+                        path, node,
+                        f"{recv}.wait() without a timeout: if the "
+                        f"setter thread dies (the exact failure this "
+                        f"stack's watchdogs exist for) the waiter "
+                        f"wedges forever — pass a timeout and "
+                        f"re-check, or suppress with why the setter "
+                        f"cannot die")
+            else:  # Condition
+                cur = parents.get(node)
+                in_while = False
+                while cur is not None and not isinstance(
+                        cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Module)):
+                    if isinstance(cur, ast.While):
+                        in_while = True
+                        break
+                    cur = parents.get(cur)
+                if not in_while:
+                    yield self.finding(
+                        path, node,
+                        f"{recv}.wait() outside a while predicate "
+                        f"loop: condition wakeups are spurious by "
+                        f"spec — wrap it in 'while not <predicate>: "
+                        f"cond.wait(...)'")
+
+
+# ---------------------------------------------------------------------------
+# thread-model discovery (the baseline's content)
+# ---------------------------------------------------------------------------
+
+def _scope_name(node: ast.AST, parents: Dict[ast.AST, ast.AST]) -> str:
+    """Dotted enclosing-scope name (``Class.method`` / ``func`` /
+    ``<module>``) — the stable half of a root's key."""
+    chain: List[str] = []
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            chain.append(cur.name)
+        cur = parents.get(cur)
+    return ".".join(reversed(chain)) or "<module>"
+
+
+def _module_roots(tree: ast.Module, relpath: str) -> Dict[str, str]:
+    """root key -> kind for one parsed module.
+
+    Keys are ``<relpath>::<scope>.<target>`` — file + enclosing scope +
+    the callable that runs on (or hands context to) the other domain —
+    deliberately line-free so the baseline survives unrelated edits."""
+    from .rules import _collect_defs, resolve
+    imports = import_map(tree)
+    parents = build_parents(tree)
+    defs = _collect_defs(tree)
+    roots: Dict[str, str] = {}
+
+    def add(node: ast.AST, target: str, kind: str) -> None:
+        cls = _class_of(node, parents)
+        scope = cls.name if cls is not None else \
+            _scope_name(node, parents)
+        roots[f"{relpath}::{scope}.{target}"] = kind
+
+    def add_targets(node: ast.AST, expr: ast.AST, kind: str) -> None:
+        for m in _callback_targets(expr):
+            add(node, m, kind)
+        local = _local_def_name(expr, defs)
+        if local is not None:
+            add(node, local, kind)
+
+    for node in module_nodes(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        full = resolve(node.func, imports) or ""
+        fname = dotted_name(node.func) or ""
+        if full in ("threading.Thread", "Thread") or \
+                full.endswith(".Thread"):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    add_targets(node, kw.value, "thread")
+        elif fname.endswith(".submit") and node.args:
+            add_targets(node, node.args[0], "executor")
+        elif full.rsplit(".", 1)[-1] == "Heartbeat":
+            for kw in node.keywords:
+                if kw.arg == "on_failure":
+                    add_targets(node, kw.value, "heartbeat")
+        elif full == "signal.signal" and len(node.args) >= 2:
+            add_targets(node, node.args[1], "signal")
+        elif full.endswith("trace.capture"):
+            add(node, "<capture>", "trace-capture")
+        elif full.endswith("trace.attach"):
+            add(node, "<attach>", "trace-attach")
+    return roots
+
+
+def _module_shared(tree: ast.Module, relpath: str) -> Dict[str, str]:
+    """shared-attribute key -> classification for one parsed module:
+    every ``self.*`` attribute touched by a background-reachable method
+    of a class that spawns a concurrency domain."""
+    imports = import_map(tree)
+    parents = build_parents(tree)
+    shared: Dict[str, str] = {}
+    for cls in [n for n in module_nodes(tree)
+                if isinstance(n, ast.ClassDef)]:
+        info = _class_conc(tree, cls, imports, parents)
+        if not info["reach"]:
+            continue
+        methods = info["methods"]
+        attrs: Dict[str, List[Tuple[ast.AST, bool, str]]] = {}
+        for m in info["reach"]:
+            body = methods[m]
+            for node, attr, is_write in _attr_accesses(body):
+                if attr in methods:
+                    continue
+                attrs.setdefault(attr, []).append(
+                    (node, is_write,
+                     "guarded" if _lock_guarded(node, parents, body)
+                     else "bare"))
+        for attr, accesses in attrs.items():
+            if attr in info["mediated"]:
+                cl = "mediated"
+            elif attr not in info["written_outside_init"] and \
+                    not any(w for _, w, _ in accesses):
+                cl = "init-only"
+            elif all(g == "guarded" for _, _, g in accesses) and \
+                    attr in info["guarded_anywhere"]:
+                cl = "lock-guarded"
+            else:
+                cl = "unguarded"
+            shared[f"{relpath}::{cls.name}.{attr}"] = cl
+    return shared
+
+
+def discover_model(paths: Optional[Iterable[str]] = None,
+                   root: Optional[str] = None) -> Dict:
+    """The tree's thread model: every concurrency root and every
+    cross-thread class attribute with its guard classification.  Uses
+    the framework parse cache, so in a bare full audit (where the
+    static rules already parsed everything) discovery re-parses
+    nothing."""
+    root = root or _REPO_ROOT
+    if paths is None:
+        paths = [os.path.join(root, t) for t in DEFAULT_TREES]
+    roots: Dict[str, str] = {}
+    shared: Dict[str, str] = {}
+    for path in iter_python_files(paths):
+        parsed = parse_file(path)
+        if parsed is None:
+            continue
+        tree, _src = parsed
+        rel = os.path.relpath(path, start=root).replace(os.sep, "/")
+        roots.update(_module_roots(tree, rel))
+        shared.update(_module_shared(tree, rel))
+    return {"schema": CONC_SCHEMA,
+            "roots": dict(sorted(roots.items())),
+            "shared": dict(sorted(shared.items()))}
+
+
+# ---------------------------------------------------------------------------
+# the baseline gate (SGL014) + the reviewed-update flow
+# ---------------------------------------------------------------------------
+
+def _load_baseline(path: str) -> Tuple[Optional[Dict], Optional[str]]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f), None
+    except FileNotFoundError:
+        return None, "missing"
+    except (OSError, json.JSONDecodeError) as e:
+        return None, str(e)
+
+
+def _root_file_line(key: str) -> Tuple[str, int]:
+    """Finding anchor for a model key (``<relpath>::...``): the source
+    file when it still exists, line 1 (keys are deliberately
+    line-free)."""
+    rel = key.split("::", 1)[0]
+    path = os.path.join(_REPO_ROOT, rel)
+    return (path if os.path.exists(path) else rel), 1
+
+
+def gate_findings(model: Optional[Dict] = None,
+                  baseline_path: Optional[str] = None,
+                  paths: Optional[Iterable[str]] = None,
+                  root: Optional[str] = None) -> List[Finding]:
+    """Diff the discovered thread model against the committed baseline;
+    [] = the mesh is exactly what was last reviewed."""
+    baseline_path = baseline_path or MODEL_PATH
+    if model is None:
+        model = discover_model(paths, root=root)
+    base, err = _load_baseline(baseline_path)
+    hint = ("run 'python -m tools.lint --conc --update-baselines' and "
+            "review the diff it prints")
+    if base is None:
+        what = "no committed thread-model baseline" if err == "missing" \
+            else f"unreadable thread-model baseline ({err})"
+        return [Finding(baseline_path, 1, 0, "SGL014",
+                        f"{what} — every concurrency domain must be a "
+                        f"reviewed baseline entry; {hint}")]
+    if base.get("schema") != model.get("schema"):
+        return [Finding(baseline_path, 1, 0, "SGL014",
+                        f"thread-model baseline schema "
+                        f"{base.get('schema')!r} does not match the "
+                        f"auditor's {model.get('schema')!r} — {hint}")]
+    findings: List[Finding] = []
+    broots, mroots = base.get("roots", {}), model["roots"]
+    for key in sorted(set(mroots) - set(broots)):
+        f, line = _root_file_line(key)
+        findings.append(Finding(
+            f, line, 0, "SGL014",
+            f"NEW thread root {key} ({mroots[key]}) is not in the "
+            f"committed thread model — a new concurrency domain needs "
+            f"human review: check its shared state, then {hint}"))
+    for key in sorted(set(broots) - set(mroots)):
+        findings.append(Finding(
+            baseline_path, 1, 0, "SGL014",
+            f"thread root {key} ({broots[key]}) is in the committed "
+            f"model but was not discovered — removed or renamed root "
+            f"(or a discovery regression); {hint}"))
+    for key in sorted(set(broots) & set(mroots)):
+        if broots[key] != mroots[key]:
+            f, line = _root_file_line(key)
+            findings.append(Finding(
+                f, line, 0, "SGL014",
+                f"thread root {key} changed kind: "
+                f"{broots[key]} -> {mroots[key]}; {hint}"))
+    bshared, mshared = base.get("shared", {}), model["shared"]
+    for key in sorted(set(mshared) - set(bshared)):
+        f, line = _root_file_line(key)
+        findings.append(Finding(
+            f, line, 0, "SGL014",
+            f"attribute {key} became cross-thread "
+            f"({mshared[key]}) and is not in the committed "
+            f"shared-state table — review its guarding, then {hint}"))
+    for key in sorted(set(bshared) - set(mshared)):
+        findings.append(Finding(
+            baseline_path, 1, 0, "SGL014",
+            f"shared attribute {key} ({bshared[key]}) is in the "
+            f"committed table but no longer cross-thread; {hint}"))
+    for key in sorted(set(bshared) & set(mshared)):
+        if bshared[key] != mshared[key]:
+            f, line = _root_file_line(key)
+            findings.append(Finding(
+                f, line, 0, "SGL014",
+                f"shared attribute {key} changed classification: "
+                f"{bshared[key]} -> {mshared[key]} — a guard "
+                f"appearing or vanishing is exactly what needs "
+                f"review; {hint}"))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.message))
+
+
+def update_model_baseline(model: Optional[Dict] = None,
+                          baseline_path: Optional[str] = None,
+                          paths: Optional[Iterable[str]] = None,
+                          root: Optional[str] = None) -> str:
+    """Write the discovered model as the new committed baseline and
+    return the human-readable diff — the reviewed artifact of an
+    intentional concurrency change (same flow as the HLO baselines)."""
+    baseline_path = baseline_path or MODEL_PATH
+    if model is None:
+        model = discover_model(paths, root=root)
+    base, _err = _load_baseline(baseline_path)
+    base = base or {"roots": {}, "shared": {}}
+    lines: List[str] = []
+    for label, bsec, msec in (("root", base.get("roots", {}),
+                               model["roots"]),
+                              ("shared", base.get("shared", {}),
+                               model["shared"])):
+        for key in sorted(set(msec) - set(bsec)):
+            lines.append(f"+ {label} {key}: {msec[key]}")
+        for key in sorted(set(bsec) - set(msec)):
+            lines.append(f"- {label} {key}: {bsec[key]}")
+        for key in sorted(set(bsec) & set(msec)):
+            if bsec[key] != msec[key]:
+                lines.append(f"~ {label} {key}: {bsec[key]} -> "
+                             f"{msec[key]}")
+    if not lines:
+        lines.append("thread model unchanged")
+    os.makedirs(os.path.dirname(baseline_path), exist_ok=True)
+    with open(baseline_path, "w", encoding="utf-8") as f:
+        json.dump(model, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return "\n".join(lines)

@@ -30,12 +30,21 @@ from typing import Dict, Iterable, List, Optional, Tuple, Type
 
 __all__ = ["Finding", "Rule", "RULES", "register", "lint_source",
            "lint_file", "iter_python_files", "run_paths", "render_human",
-           "render_json", "SUPPRESS_RE", "CODE_SUPPRESSION"]
+           "render_json", "parse_file", "SUPPRESS_RE",
+           "CODE_SUPPRESSION", "RETIRED_CODES"]
 
 #: the hygiene pseudo-rule: malformed suppressions (missing reason,
 #: unknown code) are findings under this code and cannot themselves be
 #: suppressed
 CODE_SUPPRESSION = "SGL000"
+
+#: retired rule codes and their successors.  A suppression naming a
+#: retired code FAILS LOUDLY with a migration hint (SGL000) instead of
+#: silently deactivating — the dangerous outcome would be an old
+#: ``disable=SGL004`` comment still looking authoritative while
+#: suppressing nothing.  SGL004 (thread-seam) was folded into SGL010
+#: (conc-shared-state, tools/lint/conc.py) in ISSUE 15.
+RETIRED_CODES: Dict[str, str] = {"SGL004": "SGL010"}
 
 SUPPRESS_RE = re.compile(
     r"#\s*singalint:\s*disable=([A-Za-z0-9_,]+)[ \t]*(.*?)\s*$")
@@ -118,6 +127,16 @@ def _suppressions(src: str, path: str) -> Tuple[Dict[int, set], List[Finding]]:
                 f"is sound>'"))
             continue
         for code in codes:
+            if code in RETIRED_CODES:
+                bad.append(Finding(
+                    path, lineno, tok.start[1], CODE_SUPPRESSION,
+                    f"suppression names retired rule code {code!r}, "
+                    f"which was superseded by {RETIRED_CODES[code]} "
+                    f"(conclint, tools/lint/conc.py) — update the "
+                    f"comment to 'disable={RETIRED_CODES[code]}' so "
+                    f"it keeps silencing the finding instead of "
+                    f"silently deactivating"))
+                continue
             if code == CODE_SUPPRESSION or code not in RULES:
                 bad.append(Finding(
                     path, lineno, tok.start[1], CODE_SUPPRESSION,
@@ -129,14 +148,19 @@ def _suppressions(src: str, path: str) -> Tuple[Dict[int, set], List[Finding]]:
 
 
 def lint_source(src: str, path: str = "<string>",
-                codes: Optional[Iterable[str]] = None) -> List[Finding]:
+                codes: Optional[Iterable[str]] = None,
+                tree: Optional[ast.Module] = None) -> List[Finding]:
     """Run every registered rule (or just ``codes``) over one source
-    text; returns findings with suppressions already applied."""
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [Finding(path, e.lineno or 1, e.offset or 0, "SGL999",
-                        f"syntax error: {e.msg}")]
+    text; returns findings with suppressions already applied.  An
+    already-parsed ``tree`` (the parse cache) skips the re-parse AND
+    keeps its per-parse module cache warm across rules and the conc
+    thread-model discovery."""
+    if tree is None:
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            return [Finding(path, e.lineno or 1, e.offset or 0, "SGL999",
+                            f"syntax error: {e.msg}")]
     suppressed, findings = _suppressions(src, path)
     wanted = set(codes) if codes is not None else set(RULES)
     for code, cls in RULES.items():
@@ -149,14 +173,49 @@ def lint_source(src: str, path: str = "<string>",
     return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.code))
 
 
-def lint_file(path: str,
-              codes: Optional[Iterable[str]] = None) -> List[Finding]:
+#: path -> (mtime_ns, size, tree, src).  One process-wide parse per
+#: file version: the bare full audit lints every tree file AND runs
+#: the conc thread-model discovery over the same set — without the
+#: cache that is two full parses of the repo (the PR 5 per-parse
+#: ``_module_cache`` only de-duplicates work WITHIN one parse).
+#: Keyed by (mtime_ns, size) so an edited file re-parses; the audited
+#: trees are ~130 small files, so holding their trees is cheap.
+_PARSE_CACHE: Dict[str, Tuple[int, int, ast.Module, str]] = {}
+
+
+def parse_file(path: str) -> Optional[Tuple[ast.Module, str]]:
+    """(tree, src) for ``path`` through the process-wide parse cache;
+    None for unreadable or syntactically-broken files (the lint path
+    reports those as SGL999 findings via :func:`lint_file`)."""
     try:
+        st = os.stat(path)
+        key = (st.st_mtime_ns, st.st_size)
+        hit = _PARSE_CACHE.get(path)
+        if hit is not None and (hit[0], hit[1]) == key:
+            return hit[2], hit[3]
         with open(path, encoding="utf-8") as f:
             src = f.read()
-    except (OSError, UnicodeDecodeError) as e:
-        return [Finding(path, 1, 0, "SGL999", f"unreadable: {e}")]
-    return lint_source(src, path, codes)
+        tree = ast.parse(src, filename=path)
+    except (OSError, UnicodeDecodeError, SyntaxError):
+        return None
+    _PARSE_CACHE[path] = (key[0], key[1], tree, src)
+    return tree, src
+
+
+def lint_file(path: str,
+              codes: Optional[Iterable[str]] = None) -> List[Finding]:
+    parsed = parse_file(path)
+    if parsed is None:
+        # fall through to the uncached path for the precise finding
+        # (SGL999 with the syntax-error position / unreadable reason)
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            return [Finding(path, 1, 0, "SGL999", f"unreadable: {e}")]
+        return lint_source(src, path, codes)
+    tree, src = parsed
+    return lint_source(src, path, codes, tree=tree)
 
 
 def iter_python_files(paths: Iterable[str]) -> List[str]:

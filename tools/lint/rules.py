@@ -5,7 +5,8 @@
 | SGL001 | jit-purity       | no host side effects reachable inside jax.jit  |
 | SGL002 | donation-safety  | donated jit arguments are dead after the call  |
 | SGL003 | recompile-hazard | no jax.jit in loops / .shape branching in jit  |
-| SGL004 | thread-seam      | background-thread self-writes are lock-guarded |
+| SGL004 | (retired)        | thread-seam — folded into SGL010 (conc.py);    |
+|        |                  | old disable=SGL004 suppressions fail loudly    |
 | SGL005 | wall-clock       | time.time() is banned (monotonic-only rule)    |
 | SGL006 | obs-kind         | record kinds are members of obs.schema._KINDS  |
 | SGL007 | fault-site       | faults.fire/corrupt sites exist in the registry|
@@ -535,7 +536,12 @@ class RecompileHazardRule(Rule):
 
 
 # ---------------------------------------------------------------------------
-# SGL004 thread-seam
+# thread-seam helpers (shared with tools/lint/conc.py — the SGL004 rule
+# itself is RETIRED: its check was subsumed by SGL010 conc-shared-state,
+# which also covers executor/signal domains, a transitive self.* call
+# closure, and unguarded reads paired with locked writes.  The guard
+# recognizer below is the ONE implementation both eras share, so the
+# recognition semantics could not drift across the migration.)
 # ---------------------------------------------------------------------------
 
 def _self_method(node: ast.AST) -> Optional[str]:
@@ -568,90 +574,6 @@ def _lock_guarded(node: ast.AST, parents: Dict[ast.AST, ast.AST],
                     return True
         cur = parents.get(cur)
     return False
-
-
-@register
-class ThreadSeamRule(Rule):
-    code = "SGL004"
-    name = "thread-seam"
-    description = ("attribute writes on self from methods that run on a "
-                   "background thread (Thread target, executor.submit, "
-                   "Heartbeat on_failure) must be lock-guarded or "
-                   "suppressed with a reason")
-
-    def check(self, tree: ast.Module, src: str,
-              path: str) -> Iterable[Finding]:
-        imports = import_map(tree)
-        parents = build_parents(tree)
-        for cls in [n for n in module_nodes(tree)
-                    if isinstance(n, ast.ClassDef)]:
-            methods = _methods(cls)
-            bg: Dict[str, str] = {}        # method name -> how it got there
-            for node in ast.walk(cls):
-                if not isinstance(node, ast.Call):
-                    continue
-                full = resolve(node.func, imports) or ""
-                fname = dotted_name(node.func) or ""
-                if full in ("threading.Thread", "Thread") or \
-                        full.endswith(".Thread"):
-                    for kw in node.keywords:
-                        if kw.arg == "target":
-                            m = _self_method(kw.value)
-                            if m:
-                                bg[m] = "threading.Thread target"
-                elif fname.endswith(".submit") and node.args:
-                    m = _self_method(node.args[0])
-                    if m:
-                        bg[m] = "executor.submit target"
-                elif full.rsplit(".", 1)[-1] == "Heartbeat":
-                    for kw in node.keywords:
-                        if kw.arg == "on_failure":
-                            m = _self_method(kw.value)
-                            if m:
-                                bg[m] = "Heartbeat on_failure callback"
-            if not bg:
-                continue
-            # one level of self.helper() calls made from bg methods
-            reach: Dict[str, str] = dict(bg)
-            for m, how in list(bg.items()):
-                body = methods.get(m)
-                if body is None:
-                    continue
-                for node in ast.walk(body):
-                    if isinstance(node, ast.Call):
-                        h = _self_method(node.func)
-                        if h and h in methods and h not in reach:
-                            reach[h] = f"called from {m}() ({how})"
-            for m, how in reach.items():
-                body = methods.get(m)
-                if body is None:
-                    continue
-                for node in ast.walk(body):
-                    targets: List[ast.AST] = []
-                    if isinstance(node, ast.Assign):
-                        targets = list(node.targets)
-                    elif isinstance(node, ast.AugAssign):
-                        targets = [node.target]
-                    elif isinstance(node, ast.AnnAssign) and \
-                            node.value is not None:
-                        # a bare `self.x: T` annotation stores nothing
-                        targets = [node.target]
-                    for t in targets:
-                        elts = t.elts if isinstance(
-                            t, (ast.Tuple, ast.List)) else [t]
-                        for e in elts:
-                            d = dotted_name(e)
-                            if not d or not d.startswith("self."):
-                                continue
-                            if _lock_guarded(node, parents, body):
-                                continue
-                            yield self.finding(
-                                path, node,
-                                f"write to {d} in {cls.name}.{m}(), "
-                                f"which runs on a background thread "
-                                f"({how}), is not lock-guarded — guard "
-                                f"it or suppress with the reason it is "
-                                f"safe")
 
 
 # ---------------------------------------------------------------------------
