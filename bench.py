@@ -423,7 +423,8 @@ def _serve_knobs(model, platform: str, defaults: dict) -> dict:
             "block_size": int(knobs["block_size"])}
 
 
-def bench_serve(dev, on_tpu: bool, record: bool = True) -> None:
+def bench_serve(dev, on_tpu: bool, record: bool = True,
+                perf_attr: str | None = None) -> None:
     """serve_throughput: a mixed prompt-length request stream through
     the continuous-batching ServeEngine vs the same stream served as
     sequential GenerateMixin.generate calls (ISSUE 2 acceptance: >=1.5x
@@ -458,10 +459,18 @@ def bench_serve(dev, on_tpu: bool, record: bool = True) -> None:
 
     Appends a validated `serve_throughput` entry to the obs run-record
     store (CPU runs as smoke entries, same rule as the training bench).
+
+    ISSUE 16 adds runtime attribution: a per-program ledger
+    (``obs.attr``) is installed around the two timed engine windows
+    (plain + speculative), its snapshot is joined against the analytic
+    cost model of the live engine's OWN lowered programs, and the
+    result is dumped to ``perf_attr`` (a path) and/or appended as a
+    ``perf_attr`` record — the trajectory ``tools.lint --perf`` gates.
     """
     import numpy as np
 
     from singa_tpu import models, tensor
+    from singa_tpu.obs import attr as obs_attr
     from singa_tpu.serve import ServeEngine
     from singa_tpu.serve.metrics import ServeMetrics
 
@@ -511,10 +520,15 @@ def bench_serve(dev, on_tpu: bool, record: bool = True) -> None:
     eng.submit(prompts[0], max_new_tokens=n_new)
     eng.run_until_idle()
     eng.metrics = ServeMetrics()
+    # runtime-attribution ledger (ISSUE 16): covers exactly the two
+    # timed windows below, so attributed_frac is meaningful against
+    # window_s = t_eng + t_spec (warmup dispatches excluded)
+    led = obs_attr.install()
     t0 = time.perf_counter()
     handles = [eng.submit(p, max_new_tokens=n_new) for p in prompts]
     eng.run_until_idle()
     t_eng = time.perf_counter() - t0
+    obs_attr.uninstall()
 
     mismatched = sum(
         not np.array_equal(ref, np.asarray(h.tokens))
@@ -539,11 +553,13 @@ def bench_serve(dev, on_tpu: bool, record: bool = True) -> None:
     seng.submit(prompts[0], max_new_tokens=n_new)
     seng.run_until_idle()
     seng.metrics = ServeMetrics()
+    obs_attr.install(led)       # same ledger: one attribution window
     t0 = time.perf_counter()
     spec_handles = [seng.submit(p, max_new_tokens=n_new)
                     for p in prompts]
     seng.run_until_idle()
     t_spec = time.perf_counter() - t0
+    obs_attr.uninstall()
     mismatched += sum(
         not np.array_equal(ref, np.asarray(h.tokens))
         for ref, h in zip(refs, spec_handles))
@@ -641,6 +657,49 @@ def bench_serve(dev, on_tpu: bool, record: bool = True) -> None:
     if record:
         _record_serve(payload, "tpu" if on_tpu else "cpu",
                       getattr(dev, "device_kind", "") or dev.platform)
+    _emit_perf_attr(led, seng, t_eng + t_spec, perf_attr,
+                    record=record, on_tpu=on_tpu,
+                    device_kind=getattr(dev, "device_kind", "")
+                    or dev.platform)
+
+
+def _emit_perf_attr(led, seng, window_s: float, dump_path: str | None,
+                    *, record: bool, on_tpu: bool,
+                    device_kind: str) -> None:
+    """Join the serve bench's attribution ledger against the analytic
+    cost model of the SPEC engine's own lowered programs (the superset:
+    prefill_chunk/decode/verify at exactly the serving shapes), dump the
+    payload to ``dump_path`` when given (the CI gate feeds it to
+    ``tools.lint --perf``), and append a ``perf_attr`` record when
+    ``record``.  Never fatal — attribution must not kill the bench."""
+    try:
+        from singa_tpu.obs import attr as obs_attr
+        from tools.lint.perf import engine_features
+
+        payload = obs_attr.attribution_payload(
+            led.snapshot(), engine_features(seng), window_s)
+        if dump_path:
+            with open(dump_path, "w", encoding="utf-8") as f:
+                json.dump(payload, f, indent=1, sort_keys=True)
+            print(f"# perf_attr payload written to {dump_path}",
+                  file=sys.stderr)
+        if record:
+            from singa_tpu.obs import record as obs_record
+            entry = obs_record.new_entry(
+                "perf_attr", "tpu" if on_tpu else "cpu", not on_tpu,
+                device_kind, run_id=obs_record.new_run_id("perfattr"),
+                payload=payload)
+            store = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                obs_record.DEFAULT_STORE)
+            obs_record.RunRecord(store).append(entry)
+            print(f"# perf_attr entry appended to {store} "
+                  f"({len(payload['programs'])} programs, "
+                  f"attributed {payload['attributed_frac']:.0%} of "
+                  f"{window_s:.2f} s)", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001
+        print(f"# perf_attr emission failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
 
 
 def _record_serve(payload: dict, platform: str, device_kind: str) -> None:
@@ -1158,7 +1217,9 @@ def _serve_only_main() -> None:
     the current backend (CPU unless a TPU resolved) — the quick check of
     the ISSUE-2 acceptance numbers without the full orchestrator.
     `--no-record` skips the store append (the CI gate's table-resolved
-    smoke must not dirty the committed store on every run)."""
+    smoke must not dirty the committed store on every run);
+    `--perf-attr PATH` additionally dumps the runtime-attribution
+    payload (ISSUE 16) to PATH for `tools.lint --perf`."""
     import jax
 
     dev = jax.devices()[0]
@@ -1168,7 +1229,14 @@ def _serve_only_main() -> None:
     parallel.set_mesh(None)
     device.set_default_device(device.create_tpu_device() if on_tpu
                               else device.create_cpu_device())
-    bench_serve(dev, on_tpu, record="--no-record" not in sys.argv)
+    perf_attr = None
+    if "--perf-attr" in sys.argv:
+        idx = sys.argv.index("--perf-attr")
+        if idx + 1 >= len(sys.argv):
+            raise SystemExit("bench.py: --perf-attr needs a PATH")
+        perf_attr = sys.argv[idx + 1]
+    bench_serve(dev, on_tpu, record="--no-record" not in sys.argv,
+                perf_attr=perf_attr)
 
 
 if __name__ == "__main__":

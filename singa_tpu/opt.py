@@ -665,17 +665,36 @@ class DistOpt(Optimizer):
         Telemetry: an ``opt.grad_sync`` span (trace-time when called
         under the compiled step), the communicator's per-op payload
         counters, and the ``comm.wire_bytes.compressed`` /
-        ``.f32_equiv`` counter pair (obs.events)."""
+        ``.f32_equiv`` counter pair (obs.events).  With a runtime-
+        attribution ledger installed (obs.attr) the EAGER path is
+        additionally timed under the ``grad_sync`` key — only when the
+        gradients are concrete: under a compiled step this function
+        runs at trace time, where a wall clock would measure tracing,
+        not the collective (the in-graph sync is then attributed to
+        the enclosing ``train_step_dp2*`` dispatch instead)."""
+        import time
+
+        from .obs import attr as obs_attr
         from .obs import events as obs_events
         from .parallel import communicator as comm
+        led = obs_attr.get()
+        if led is not None and any(
+                isinstance(g, jax.core.Tracer) for g in grads.values()):
+            led = None
         with obs_events.span("opt.grad_sync", axis=self.data_axis,
                              tensors=len(grads),
                              compression=self.compression or "none"):
+            t0 = time.perf_counter() if led is not None else 0.0
             if self.compression == "int8_ring":
-                return self._reduce_int8_ring(grads)
-            return comm.allreduce_grads(grads, axis=self.data_axis,
-                                        compress_dtype=self.compress_dtype,
-                                        topk_ratio=self.topk_ratio)
+                out = self._reduce_int8_ring(grads)
+            else:
+                out = comm.allreduce_grads(
+                    grads, axis=self.data_axis,
+                    compress_dtype=self.compress_dtype,
+                    topk_ratio=self.topk_ratio)
+            if led is not None:
+                led.note("grad_sync", time.perf_counter() - t0)
+            return out
 
     def _reduce_int8_ring(self, grads: Dict[str, jnp.ndarray]
                           ) -> Dict[str, jnp.ndarray]:

@@ -1,0 +1,638 @@
+"""Runtime attribution (ISSUE 16): the per-program perf ledger, the
+measured-vs-modeled join, the ``perf_attr`` record schema, and the
+PERF00x sentinel gate — tier-1 lean.
+
+The acceptance invariants under test:
+  * every instrumented dispatch seam (serve prefill/decode, the
+    compiled train step) lands in an installed ledger under its
+    flagship program key, and with NO ledger installed the seams cost
+    one global read — no clock call (counted through a proxy), no
+    event;
+  * the achieved-roofline fractions in a committed ``perf_attr``
+    record re-derive BIT-EQUAL from the record's own frozen numbers
+    (pure arithmetic — no live measurement in the join);
+  * a seeded regression (one program's dispatch seam slowed) flips the
+    sentinel's ranking/ratio invariants into named PERF00x findings
+    and a non-zero ``tools.lint --perf`` exit, and ``--update-
+    baselines`` round-trips the same payload back to clean;
+  * ``obsq attr`` renders the table and ``obsq diff --assert-last``
+    tripwires a record trajectory (trivially green with <2 records).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from singa_tpu import autograd, layer, model, models, opt, tensor
+from singa_tpu.obs import attr as obs_attr
+from singa_tpu.obs import events, schema
+from singa_tpu.obs import record as obs_record
+from singa_tpu.obs.events import _Hist
+from singa_tpu.serve import ServeEngine
+
+_REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+@pytest.fixture(autouse=True)
+def _no_ledger_leak():
+    """A test that dies with a ledger installed must not attribute the
+    rest of the suite's dispatches."""
+    yield
+    obs_attr.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# Ledger mechanics (no jax)
+# ---------------------------------------------------------------------------
+
+class TestLedger:
+    def test_note_accumulates_exact_and_matches_hist(self):
+        led = obs_attr.Ledger()
+        obs = [0.003, 0.001, 0.004, 0.002, 0.010]
+        for v in obs:
+            led.note("decode", v)
+        ref = _Hist()
+        for v in obs:
+            ref.observe(v)
+        want = ref.summary()
+        snap = led.snapshot()["decode"]
+        assert snap["count"] == 5
+        assert snap["total_s"] == pytest.approx(sum(obs))
+        assert snap["min_s"] == min(obs)
+        assert snap["max_s"] == max(obs)
+        # percentiles come from the SAME estimator the event layer
+        # uses — identical observation order, identical summary
+        assert snap["p50_s"] == want["p50"]
+        assert snap["p99_s"] == want["p99"]
+
+    def test_snapshot_empty_and_reset(self):
+        led = obs_attr.Ledger()
+        assert led.snapshot() == {}
+        led.note("x", 0.5)
+        assert "x" in led.snapshot()
+        led.reset()
+        assert led.snapshot() == {}
+        assert led.installed_at is not None
+
+    def test_install_uninstall_roundtrip(self):
+        assert obs_attr.get() is None
+        led = obs_attr.install()
+        assert obs_attr.get() is led
+        assert led.installed_at is not None
+        # module-level note forwards to the installed ledger
+        obs_attr.note("p", 0.25)
+        assert led.snapshot()["p"]["count"] == 1
+        assert obs_attr.uninstall() is led
+        assert obs_attr.get() is None
+        obs_attr.note("p", 0.25)          # no-op without a ledger
+        assert led.snapshot()["p"]["count"] == 1
+
+    def test_reinstalling_existing_ledger_keeps_state(self):
+        led = obs_attr.install()
+        led.note("a", 1.0)
+        obs_attr.uninstall()
+        assert obs_attr.install(led) is led
+        led.note("a", 1.0)
+        assert led.snapshot()["a"]["count"] == 2
+
+
+# ---------------------------------------------------------------------------
+# the measured-vs-modeled join + the perf_attr schema (no jax)
+# ---------------------------------------------------------------------------
+
+def _mk_snapshot(**totals):
+    return {name: {"count": 10, "total_s": t, "min_s": t / 20,
+                   "max_s": t / 5, "p50_s": t / 10, "p99_s": t / 5}
+            for name, t in totals.items()}
+
+
+class TestAttributionPayload:
+    def test_join_drops_unmodeled_programs(self):
+        snap = _mk_snapshot(decode=0.2, train_eval_step=0.4)
+        feats = {"decode": {"flops": 1e9, "hbm_bytes": 1e8}}
+        p = obs_attr.attribution_payload(snap, feats, window_s=1.0)
+        assert list(p["programs"]) == ["decode"]
+        # attributed_s sums INCLUDED programs only
+        assert p["attributed_s"] == pytest.approx(0.2)
+        assert p["attributed_frac"] == pytest.approx(0.2)
+
+    def test_achieved_fraction_arithmetic(self):
+        # mean dispatch 0.02 s; modeled minimum is the slower of the
+        # compute leg (1e10/1e12 = 0.01 s) and memory leg
+        # (1e9/1e11 = 0.01 s) -> frac 0.5 at the nominal box
+        snap = {"decode": {"count": 10, "total_s": 0.2, "min_s": 0.01,
+                           "max_s": 0.03, "p50_s": 0.02, "p99_s": 0.03}}
+        feats = {"decode": {"flops": 1e10, "hbm_bytes": 1e9}}
+        p = obs_attr.attribution_payload(snap, feats, window_s=0.4)
+        row = p["programs"]["decode"]
+        assert row["achieved_flops_frac"] == pytest.approx(0.5)
+        assert row["achieved_flops_per_s"] == pytest.approx(5e11)
+        assert row["achieved_hbm_gbps"] == pytest.approx(50.0)
+        assert p["attributed_frac"] == pytest.approx(0.5)
+        schema.validate_perf_attr_payload(p)
+
+    def test_schema_accepts_valid_and_rejects_broken(self):
+        snap = _mk_snapshot(decode=0.1)
+        feats = {"decode": {"flops": 1e9, "hbm_bytes": 1e8}}
+        good = obs_attr.attribution_payload(snap, feats, 1.0)
+        schema.validate_perf_attr_payload(good)
+
+        with pytest.raises(schema.SchemaError, match="programs"):
+            schema.validate_perf_attr_payload(
+                {"window_s": 1.0, "attributed_s": 0.1,
+                 "attributed_frac": 0.1, "programs": {}})
+        bad = json.loads(json.dumps(good))
+        del bad["programs"]["decode"]["p99_s"]
+        with pytest.raises(schema.SchemaError, match="p99_s"):
+            schema.validate_perf_attr_payload(bad)
+        bad = json.loads(json.dumps(good))
+        bad["attributed_frac"] = "lots"
+        with pytest.raises(schema.SchemaError, match="attributed_frac"):
+            schema.validate_perf_attr_payload(bad)
+
+    def test_record_entry_roundtrip(self, tmp_path):
+        snap = _mk_snapshot(decode=0.1, prefill_chunk=0.3)
+        feats = {"decode": {"flops": 1e9, "hbm_bytes": 1e8},
+                 "prefill_chunk": {"flops": 2e9, "hbm_bytes": 2e8}}
+        payload = obs_attr.attribution_payload(snap, feats, 1.0)
+        entry = obs_record.new_entry("perf_attr", "cpu", True, "cpu",
+                                     run_id="perfattr-test-1",
+                                     payload=payload)
+        store = str(tmp_path / "records.jsonl")
+        obs_record.RunRecord(store).append(entry)
+        assert obs_record.RunRecord(store).validate() == []
+
+    def test_committed_records_rederive_bit_equal(self):
+        """Acceptance: the achieved-roofline fractions in every
+        COMMITTED perf_attr record re-derive bit-equal from the frozen
+        count/total/modeled numbers alone — the join is pure
+        arithmetic, so the record is self-verifying forever."""
+        store = os.path.join(_REPO, "runs", "records.jsonl")
+        entries = [e for e in obs_record.RunRecord(store).entries()
+                   if e["kind"] == "perf_attr"]
+        assert entries, "no committed perf_attr record to verify"
+        for e in entries:
+            for name, row in e["payload"]["programs"].items():
+                redo = obs_attr._achieved(
+                    row, {"flops": row["modeled_flops"],
+                          "hbm_bytes": row["modeled_hbm_bytes"]})
+                for k, v in redo.items():
+                    assert row[k] == v, (e["run_id"], name, k)
+
+
+# ---------------------------------------------------------------------------
+# the dispatch seams (live engine / compiled train step)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def llama():
+    tensor.set_seed(0)
+    m = models.Llama(models.LlamaConfig.tiny())
+    m.eval()
+    m.compile([tensor.from_numpy(np.zeros((1, 4), np.int32))],
+              is_train=False, use_graph=False)
+    return m
+
+
+@pytest.fixture(scope="module")
+def engine(llama):
+    return ServeEngine(llama, num_slots=4, max_len=32, block_size=8)
+
+
+def _prompts(lens, vocab=256, seed=7):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, vocab, (n,)).astype(np.int32) for n in lens]
+
+
+class _CountingTime:
+    """``time`` proxy counting perf_counter calls (delegating
+    everything, including the returned clock value)."""
+
+    def __init__(self):
+        self.perf_calls = 0
+
+    def perf_counter(self):
+        self.perf_calls += 1
+        return time.perf_counter()
+
+    def __getattr__(self, name):
+        return getattr(time, name)
+
+
+class TestDispatchSeams:
+    def test_serve_seams_note_flagship_keys(self, engine):
+        led = obs_attr.install()
+        hs = [engine.submit(p, max_new_tokens=4)
+              for p in _prompts([4, 6])]
+        engine.run_until_idle()
+        obs_attr.uninstall()
+        assert all(h.done for h in hs)
+        snap = led.snapshot()
+        # the ledger keys are the FLAGSHIP names, not the fault sites
+        assert snap["prefill_chunk"]["count"] == 2
+        assert snap["decode"]["count"] >= 3
+        assert "serve.prefill" not in snap
+        for row in snap.values():
+            assert row["total_s"] > 0
+            assert row["min_s"] <= row["p50_s"] <= row["max_s"]
+
+    def test_off_path_never_touches_the_clock(self, engine,
+                                              monkeypatch):
+        """Overhead honesty: run the SAME workload with the ledger off
+        and on, counting ``time.perf_counter`` calls through a proxy in
+        the engine's namespace.  The on-run must cost exactly two extra
+        clock reads per noted dispatch; the off-run's count is the
+        engine's own baseline (step timing etc.), proving the seam adds
+        zero clock traffic when off."""
+        from singa_tpu.serve import engine as engine_mod
+
+        def run():
+            hs = [engine.submit(p, max_new_tokens=4)
+                  for p in _prompts([4, 6])]
+            engine.run_until_idle()
+            assert all(h.done for h in hs)
+
+        proxy = _CountingTime()
+        monkeypatch.setattr(engine_mod, "time", proxy)
+        run()                                   # ledger off
+        off_calls = proxy.perf_calls
+
+        led = obs_attr.install()
+        proxy.perf_calls = 0
+        run()                                   # ledger on, same work
+        obs_attr.uninstall()
+        on_calls = proxy.perf_calls
+        noted = sum(r["count"] for r in led.snapshot().values())
+        assert noted > 0
+        assert on_calls == off_calls + 2 * noted
+
+    def test_off_path_emits_no_events(self, engine, tmp_path):
+        """No sink surprise either: a ledger-off run under a live event
+        sink emits nothing attr-shaped — the ledger is pull-only
+        (snapshot), never an event producer."""
+        path = str(tmp_path / "ev.jsonl")
+        events.configure(path=path)
+        try:
+            hs = [engine.submit(p, max_new_tokens=3)
+                  for p in _prompts([4])]
+            engine.run_until_idle()
+        finally:
+            events.configure()
+        assert all(h.done for h in hs)
+        assert all("attr" not in json.loads(ln).get("name", "")
+                   for ln in open(path))
+
+    def test_train_step_seam_notes_train_step(self):
+        """The compiled train step's dispatch lands under the flagship
+        ``train_step`` key (plain optimizer — the DistOpt variants map
+        via _attr_program, unit-tested below)."""
+
+        class MLP(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.fc = layer.Linear(4)
+
+            def forward(self, x):
+                return self.fc(x)
+
+            def train_one_batch(self, x, y):
+                out = self.forward(x)
+                loss = autograd.softmax_cross_entropy(out, y)
+                self.optimizer(loss)
+                return out, loss
+
+        tensor.set_seed(3)
+        m = MLP()
+        m.set_optimizer(opt.SGD(lr=0.1))
+        tx = tensor.from_numpy(
+            np.random.RandomState(0).randn(8, 6).astype(np.float32))
+        ty = tensor.from_numpy(np.zeros((8,), np.int32))
+        m.compile([tx], is_train=True, use_graph=True)
+        m.train_step(tx, ty)            # warm compile outside ledger
+        led = obs_attr.install()
+        m.train_step(tx, ty)
+        m.train_step(tx, ty)
+        obs_attr.uninstall()
+        snap = led.snapshot()
+        assert snap["train_step"]["count"] == 2
+
+    def test_attr_program_key_mapping(self):
+        """The executor->flagship key map, without compiling anything:
+        plain train -> train_step, DistOpt -> train_step_dp2, int8 ring
+        -> train_step_dp2_int8, eval -> <tag>_step (unmodeled)."""
+        from singa_tpu.model import _StepExecutor
+        from singa_tpu.opt import DistOpt
+
+        class Fake:
+            _attr_program = _StepExecutor._attr_program
+            _attr_key = None
+
+            def __init__(self, is_train, tag, optimizer):
+                self.is_train, self.tag, self.opt = \
+                    is_train, tag, optimizer
+
+        class FakeDist(DistOpt):
+            def __init__(self, compression=None):
+                self.compression = compression
+
+        assert Fake(True, "train", opt.SGD(lr=0.1)) \
+            ._attr_program() == "train_step"
+        assert Fake(True, "train", FakeDist()) \
+            ._attr_program() == "train_step_dp2"
+        assert Fake(True, "train", FakeDist("int8_ring")) \
+            ._attr_program() == "train_step_dp2_int8"
+        assert Fake(False, "eval", None)._attr_program() == "eval_step"
+
+
+# ---------------------------------------------------------------------------
+# the PERF00x sentinel gate
+# ---------------------------------------------------------------------------
+
+def _payload(programs, window_s=1.0):
+    """A valid perf_attr payload from {name: (count, total_s, p50_s,
+    frac)} tuples."""
+    rows = {}
+    attributed = 0.0
+    for name, (count, total, p50, frac) in programs.items():
+        rows[name] = {"count": count, "total_s": total, "min_s": p50 / 2,
+                      "max_s": p50 * 2, "p50_s": p50, "p99_s": p50 * 2,
+                      "modeled_flops": 1e9, "modeled_hbm_bytes": 1e8,
+                      "achieved_flops_per_s": 1.0,
+                      "achieved_hbm_gbps": 1.0,
+                      "achieved_flops_frac": frac}
+        attributed += total
+    return {"window_s": window_s, "attributed_s": attributed,
+            "attributed_frac": attributed / window_s, "programs": rows}
+
+
+_BASE = {"prefill_chunk": (40, 0.4, 0.010, 0.4),
+         "decode": (100, 0.2, 0.002, 0.5),
+         "verify": (10, 0.15, 0.015, 0.6)}
+
+
+class TestPerfGate:
+    def _sentinel(self, tmp_path, payload=None):
+        from tools.lint import perf
+        path = str(tmp_path / "sentinel.json")
+        perf.update_baseline(payload or _payload(_BASE), path)
+        return path
+
+    def test_clean_against_own_sentinel(self, tmp_path):
+        from tools.lint import perf
+        path = self._sentinel(tmp_path)
+        assert perf.gate_findings(_payload(_BASE), path) == []
+
+    def test_missing_sentinel_is_perf001(self, tmp_path):
+        from tools.lint import perf
+        out = perf.gate_findings(_payload(_BASE),
+                                 str(tmp_path / "nope.json"))
+        assert [f.code for f in out] == ["PERF001"]
+
+    def test_non_flagship_key_is_perf001(self, tmp_path):
+        from tools.lint import perf
+        path = self._sentinel(tmp_path)
+        bad = _payload(dict(_BASE, mystery_step=(1, 0.1, 0.1, 0.5)))
+        out = perf.gate_findings(bad, path)
+        assert [f.code for f in out] == ["PERF001"]
+        assert "mystery_step" in out[0].message
+
+    def test_lost_seam_is_perf002(self, tmp_path):
+        from tools.lint import perf
+        path = self._sentinel(tmp_path)
+        # decode's attribution vanishes: attributed_frac 0.75 -> 0.25,
+        # below 0.5x the committed value
+        lost = _payload({k: v for k, v in _BASE.items()
+                         if k == "decode"}, window_s=1.0)
+        lost["programs"]["decode"]["count"] = 100
+        out = perf.gate_findings(lost, path)
+        assert "PERF002" in [f.code for f in out]
+
+    def test_double_count_is_perf002(self, tmp_path):
+        from tools.lint import perf
+        path = self._sentinel(tmp_path)
+        over = _payload(_BASE, window_s=0.5)    # attributed 1.5x window
+        out = perf.gate_findings(over, path)
+        assert any(f.code == "PERF002" and "double-count" in f.message
+                   for f in out)
+
+    def test_decisive_rank_flip_is_perf003_but_jitter_is_not(
+            self, tmp_path):
+        from tools.lint import perf
+        path = self._sentinel(tmp_path)
+        # decode p50 regresses 20x: dearer than prefill (committed
+        # cheaper) -> decisive flip + ratio blowout
+        slow = dict(_BASE, decode=(100, 4.0, 0.040, 0.5))
+        codes = [f.code for f in perf.gate_findings(_payload(slow),
+                                                    path)]
+        assert "PERF003" in codes and "PERF004" in codes
+        # near-tie reshuffle (verify drops just under prefill): within
+        # RANK_MARGIN, no finding — scheduler jitter must not gate
+        jitter = dict(_BASE, verify=(10, 0.08, 0.008, 0.6))
+        assert perf.gate_findings(_payload(jitter), path) == []
+
+    def test_same_tier_swing_never_fires_perf003(self, tmp_path):
+        """verify and prefill sit within TIER_MARGIN at commit (1.5x)
+        so they share a tier — verify swinging DECISIVELY past prefill
+        (3.5x, beyond RANK_MARGIN) must still not fire: the baseline
+        run could not order the pair, so the gate holds no claim about
+        it.  This is the exact flake two real bench runs produced
+        (verify p50 0.58 ms vs 0.81 ms against prefill 1.1/1.8 ms)."""
+        from tools.lint import perf
+        path = self._sentinel(tmp_path)
+        swung = dict(_BASE, verify=(10, 0.35, 0.035, 0.6))
+        assert perf.gate_findings(_payload(swung), path) == []
+
+    def test_sentinel_tiers_use_anchor_and_tier_margin(self):
+        """Tier construction: a program joins the tier unless the
+        tier's DEAREST member (the anchor, not the last joiner) is
+        >= TIER_MARGIN above it — a chain of near-ties cannot smear
+        one tier over a genuinely separated cost class."""
+        from tools.lint import perf
+        tiers = perf.sentinel_summary(_payload({
+            "prefill_chunk": (10, 0.1, 0.012, 0.4),   # anchor
+            "verify": (10, 0.1, 0.004, 0.4),          # 3x: joins
+            "decode": (10, 0.1, 0.0029, 0.4),         # 4.1x anchor: new
+        }))["ranking"]
+        assert tiers == [["prefill_chunk", "verify"], ["decode"]]
+
+    def test_insane_fraction_is_perf005(self, tmp_path):
+        from tools.lint import perf
+        path = self._sentinel(tmp_path)
+        bad = dict(_BASE, decode=(100, 0.2, 0.002, 97.0))
+        out = perf.gate_findings(_payload(bad), path)
+        assert any(f.code == "PERF005" and "decode" in f.message
+                   for f in out)
+        neg = dict(_BASE, decode=(100, 0.2, 0.002, -0.1))
+        out = perf.gate_findings(_payload(neg), path)
+        assert any(f.code == "PERF005" for f in out)
+
+    def test_suppression_waives_named_code_with_hygiene(self, tmp_path):
+        from tools.lint import perf
+        path = self._sentinel(tmp_path)
+        doc = json.load(open(path))
+        doc["suppress"] = {"PERF004": "known ratio shift on this box"}
+        json.dump(doc, open(path, "w"))
+        slow = dict(_BASE, decode=(100, 4.0, 0.040, 0.5))
+        codes = [f.code for f in perf.gate_findings(_payload(slow),
+                                                    path)]
+        assert "PERF004" not in codes and "PERF003" in codes
+        # a reasonless suppression is itself a finding
+        doc["suppress"] = {"PERF004": ""}
+        json.dump(doc, open(path, "w"))
+        codes = [f.code for f in perf.gate_findings(_payload(slow),
+                                                    path)]
+        assert "PERF000" in codes
+
+    def test_update_baseline_roundtrips_clean(self, tmp_path):
+        from tools.lint import perf
+        path = self._sentinel(tmp_path)
+        # the slowed run's window grows with its dispatches, so the
+        # completeness fraction stays sane — only ranking/ratio drift
+        slow = _payload(dict(_BASE, decode=(100, 4.0, 0.040, 0.5)),
+                        window_s=6.0)
+        assert perf.gate_findings(slow, path) != []
+        diff = perf.update_baseline(slow, path)
+        assert "decode_prefill_p50_ratio" in diff
+        assert perf.gate_findings(slow, path) == []
+
+    def test_seeded_regression_live_engine(self, llama, tmp_path):
+        """Acceptance end-to-end: a clean run baselines the sentinel;
+        then the SAME engine with its decode dispatch seam slowed (a
+        sleeping wrapper — the HLO is untouched) produces a payload the
+        gate rejects with named PERF00x findings and exit 1; re-
+        baselining accepts the regression as the new normal."""
+        from tools.lint import perf
+
+        eng = ServeEngine(llama, num_slots=4, max_len=32, block_size=8)
+        eng.submit(_prompts([4])[0], max_new_tokens=3)
+        eng.run_until_idle()                    # warm both programs
+        feats = perf.engine_features(eng)
+        assert {"prefill_chunk", "decode"} <= set(feats)
+
+        def run():
+            led = obs_attr.install()
+            t0 = time.perf_counter()
+            hs = [eng.submit(p, max_new_tokens=6)
+                  for p in _prompts([4, 6, 8, 10])]
+            eng.run_until_idle()
+            window = time.perf_counter() - t0
+            obs_attr.uninstall()
+            assert all(h.done for h in hs)
+            return obs_attr.attribution_payload(led.snapshot(), feats,
+                                                window)
+
+        sentinel = str(tmp_path / "sentinel.json")
+        perf.update_baseline(run(), sentinel)
+
+        orig = eng._decode
+
+        def slowed(*args):
+            time.sleep(0.03)            # ~15x the tiny decode p50
+            return orig(*args)
+
+        eng._decode = slowed
+        try:
+            bad = run()
+        finally:
+            eng._decode = orig
+        findings = perf.gate_findings(bad, sentinel)
+        codes = {f.code for f in findings}
+        assert codes & {"PERF003", "PERF004"}, findings
+        # the CLI front door exits 1 on the same payload
+        dump = str(tmp_path / "bad.json")
+        json.dump(bad, open(dump, "w"))
+        assert perf.perf_main(dump, sentinel_path=sentinel) == 1
+        # reviewed re-baseline flow: the same payload is clean after
+        perf.update_baseline(bad, sentinel)
+        assert perf.gate_findings(bad, sentinel) == []
+
+    def test_records_audit_rejects_stray_program_key(self, tmp_path):
+        """`tools.lint --records` names a perf_attr entry whose program
+        keys leak outside the flagship set."""
+        from tools.lint.audit import check_records_root
+
+        root = str(tmp_path)
+        os.makedirs(os.path.join(root, "runs"))
+        store = os.path.join(root, "runs", "records.jsonl")
+        snap = _mk_snapshot(decode=0.1, bogus_program=0.2)
+        feats = {"decode": {"flops": 1e9, "hbm_bytes": 1e8},
+                 "bogus_program": {"flops": 1e9, "hbm_bytes": 1e8}}
+        payload = obs_attr.attribution_payload(snap, feats, 1.0)
+        entry = obs_record.new_entry("perf_attr", "cpu", True, "cpu",
+                                     run_id="perfattr-test-stray",
+                                     payload=payload)
+        obs_record.RunRecord(store).append(entry)
+        errors = check_records_root(root)
+        assert any("bogus_program" in e for e in errors)
+
+
+# ---------------------------------------------------------------------------
+# obsq: the attr table and the --assert-last tripwire
+# ---------------------------------------------------------------------------
+
+class TestObsq:
+    def _store_with(self, tmp_path, values):
+        os.makedirs(str(tmp_path), exist_ok=True)
+        store = str(tmp_path / "records.jsonl")
+        rec = obs_record.RunRecord(store)
+        for i, v in enumerate(values):
+            snap = _mk_snapshot(decode=v)
+            feats = {"decode": {"flops": 1e9, "hbm_bytes": 1e8}}
+            payload = obs_attr.attribution_payload(snap, feats, 1.0)
+            rec.append(obs_record.new_entry(
+                "perf_attr", "cpu", True, "cpu",
+                run_id=f"perfattr-test-{i}", payload=payload))
+        return store
+
+    def test_attr_table_from_store_and_dump(self, tmp_path, capsys):
+        from tools import obsq
+        store = self._store_with(tmp_path, [0.2])
+        assert obsq.main(["attr", "--records", store]) == 0
+        out = capsys.readouterr().out
+        assert "decode" in out and "achieved_frac" in out
+        # same table from a payload dump file
+        snap = _mk_snapshot(decode=0.2)
+        feats = {"decode": {"flops": 1e9, "hbm_bytes": 1e8}}
+        dump = str(tmp_path / "pa.json")
+        json.dump(obs_attr.attribution_payload(snap, feats, 1.0),
+                  open(dump, "w"))
+        assert obsq.main(["attr", dump, "--records", store]) == 0
+        assert "decode" in capsys.readouterr().out
+
+    def test_assert_last_green_red_and_trivial(self, tmp_path, capsys):
+        from tools import obsq
+        store = self._store_with(tmp_path, [0.2, 0.25])  # +25%
+        base = ["diff", "perf_attr", "--records", store]
+        assert obsq.main(base + ["--assert-last",
+                                 "attributed_s<=+50%"]) == 0
+        assert obsq.main(base + ["--assert-last",
+                                 "attributed_s<=+10%"]) == 1
+        assert "ASSERT FAILED" in capsys.readouterr().err
+        assert obsq.main(base + ["--assert-last",
+                                 "attributed_s>=-10%"]) == 0
+        # fewer than two records: trivially green (fresh trajectory)
+        one = self._store_with(tmp_path / "one", [0.2])
+        assert obsq.main(["diff", "perf_attr", "--records", one,
+                          "--assert-last", "attributed_s<=+1%"]) == 0
+
+    def test_assert_last_rejects_bad_spec_and_missing_field(
+            self, tmp_path, capsys):
+        from tools import obsq
+        store = self._store_with(tmp_path, [0.2, 0.25])
+        with pytest.raises(ValueError, match="FIELD"):
+            obsq.assert_last(store, "perf_attr", "attributed_s < 5")
+        # a typo'd field must error, not read as permanently green
+        with pytest.raises(ValueError, match="attributed_z"):
+            obsq.assert_last(store, "perf_attr", "attributed_z<=+5%")
+
+    def test_assert_last_dotted_field(self, tmp_path):
+        from tools import obsq
+        store = self._store_with(tmp_path, [0.2, 0.25])
+        # one-level flattening reaches window_s etc.; dotted specs use
+        # _flat_get (programs.* is nested two deep, so top-level and
+        # one-dot fields are the supported surface)
+        assert obsq.assert_last(store, "perf_attr",
+                                "window_s<=+0%") is None

@@ -36,7 +36,13 @@
 #             2-point sweep -> fit -> table round-trip in a temp
 #             store, then tools/loadgen.py and bench.py --serve run
 #             END TO END with table-resolved arena knobs, no store
-#             writes)
+#             writes.  The serve smoke additionally dumps its runtime-
+#             attribution payload (ISSUE 16) and `tools.lint --perf`
+#             gates it against the committed sentinel — PERF00x
+#             box-robust invariants: completeness, per-program ranking,
+#             decode/prefill ratio band, achieved-fraction sanity —
+#             and `obsq diff perf_attr --assert-last` tripwires the
+#             committed record trajectory)
 #   stage 7  tier-1 tests  the ROADMAP.md tier-1 command     exit 20
 #
 # Exit 0 = every stage green.  Intentional compiled-program changes are
@@ -66,7 +72,14 @@ echo "== ci_gate stage 6/7: autotune smoke (sweep -> fit -> table -> consumers) 
 JAX_PLATFORMS=cpu python -m tools.autotune smoke || exit 15
 JAX_PLATFORMS=cpu python -m tools.loadgen --requests 6 --rate 50 \
     --no-record || exit 15
-JAX_PLATFORMS=cpu python bench.py --serve --no-record || exit 15
+rm -f /tmp/_perf_attr.json
+JAX_PLATFORMS=cpu python bench.py --serve --no-record \
+    --perf-attr /tmp/_perf_attr.json || exit 15
+echo "== ci_gate stage 6/7 (cont.): runtime-attribution sentinel (PERF00x) =="
+JAX_PLATFORMS=cpu python -m tools.lint --perf /tmp/_perf_attr.json \
+    || exit 15
+JAX_PLATFORMS=cpu python -m tools.obsq diff perf_attr \
+    --assert-last "attributed_s<=+300%" || exit 15
 
 echo "== ci_gate stage 7/7: tier-1 test suite (ROADMAP.md budget) =="
 rm -f /tmp/_t1.log
