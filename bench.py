@@ -663,6 +663,167 @@ def bench_serve(dev, on_tpu: bool, record: bool = True,
                     or dev.platform)
 
 
+def bench_arena_compare(dev, on_tpu: bool, record: bool = True) -> None:
+    """`--serve --arena-compare` (ISSUE 17): peak measured concurrency
+    at EQUAL arena memory, f32 paged arena vs int8 QuantKV arena.
+
+    Methodology — PR 6's equal-memory harness with the byte budget as
+    the controlled variable:
+
+      * the budget is what a FIXED (num_slots, max_len) f32 arena
+        burns (`fixed_max_concurrent` = that slot count — deliberately
+        small so the paged side is BLOCK-bound, not request-bound;
+        PR 6's own compare saturated its 24-request stream and could
+        not see past the paging win);
+      * the f32 paged engine gets exactly that block budget and a
+        non-binding slot ceiling: its peak concurrency is what paging
+        alone buys per byte (streams asserted token-identical to
+        sequential generate);
+      * the int8 engine gets as many QuantKV blocks as the SAME byte
+        budget holds (`arena_bytes_int8 <= arena_bytes_f32`, both on
+        the record) — ~3.5x the blocks at serve_bench shapes, so the
+        same bytes admit >= 2x the peak concurrency;
+      * int8 KV breaks bitwise greedy identity BY CONSTRUCTION, so the
+        quality number on the record is the spec-verify referee's
+        accept rate: the SAME int8 arena proposes as a draft against
+        an f32 target referee (draft_kv_dtype="int8"), whose output
+        streams ARE asserted token-identical — the committed
+        accept_rate is the fraction of quantized proposals the
+        full-precision referee kept.
+
+    Appends ONE serve_throughput record carrying the arena five-tuple
+    plus the referee pair (tokens_per_s/ttft on it are the int8
+    engine's own timed pass)."""
+    import numpy as np
+
+    from singa_tpu import models, tensor
+    from singa_tpu.serve import ServeEngine
+    from singa_tpu.serve import mem as serve_mem
+
+    tensor.set_seed(0)
+    np.random.seed(0)
+    if on_tpu:
+        cfg = models.LlamaConfig.small()
+        fixed_slots, max_len, block_size, n_new = 2, 192, 32, 64
+        plens, reps = (32, 64, 96, 128), 8
+    else:
+        cfg = models.LlamaConfig.serve_bench()
+        # a 2-slot fixed-arena byte budget against a 32-request stream:
+        # small enough that BOTH paged sides stay block-bound (neither
+        # peak touches the request count), so the ratio measures
+        # concurrency per BYTE, not stream exhaustion
+        fixed_slots, max_len, block_size, n_new = 2, 48, 8, 24
+        plens, reps = (6, 10, 12, 16), 8
+    m = models.Llama(cfg)
+    m.eval()
+    prompts = [np.random.randint(0, cfg.vocab_size, (p,)).astype(np.int32)
+               for p in plens for _ in range(reps)]
+    m.compile([tensor.from_numpy(prompts[0][None])], is_train=False,
+              use_graph=False)
+    m.generate(prompts[0][None], max_new_tokens=n_new)
+    t0 = time.perf_counter()
+    refs = [m.generate(p[None], max_new_tokens=n_new)[0, p.size:]
+            for p in prompts]
+    t_seq = time.perf_counter() - t0
+
+    max_blocks = -(-max_len // block_size)
+    pool_blocks = fixed_slots * max_blocks + 1
+
+    def drive(eng):
+        """Timed pass over the full stream; returns (handles, peak
+        concurrency, wall seconds)."""
+        eng.submit(prompts[0], max_new_tokens=n_new)
+        eng.run_until_idle()
+        from singa_tpu.serve.metrics import ServeMetrics
+        eng.metrics = ServeMetrics()
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, max_new_tokens=n_new) for p in prompts]
+        peak = 0
+        while eng.pending:
+            eng.step()
+            peak = max(peak, eng.pool.active_count)
+        return handles, peak, time.perf_counter() - t0
+
+    # f32 paged arena at the byte budget, slots non-binding
+    wide = ServeEngine(m, len(prompts), max_len, block_size=block_size,
+                       num_blocks=pool_blocks,
+                       max_queue=2 * len(prompts))
+    arena_f32 = serve_mem.arena_bytes(wide.pool.caches)
+    handles, paged_peak, _ = drive(wide)
+    mismatched = sum(not np.array_equal(ref, np.asarray(h.tokens))
+                     for ref, h in zip(refs, handles))
+    if mismatched:
+        raise AssertionError(
+            f"{mismatched}/{len(prompts)} f32 paged streams diverged "
+            f"from GenerateMixin.generate greedy decode")
+
+    # int8 arena: as many QuantKV blocks as the SAME bytes hold
+    int8_bb = serve_mem.arena_block_bytes(
+        serve_mem.quant_arena(m, 1, block_size))
+    quant_blocks = arena_f32 // int8_bb
+    quant = ServeEngine(m, len(prompts), max_len, block_size=block_size,
+                        num_blocks=quant_blocks, kv_dtype="int8",
+                        max_queue=2 * len(prompts))
+    arena_int8 = serve_mem.arena_bytes(quant.pool.caches)
+    assert arena_int8 <= arena_f32
+    qhandles, quant_peak, t_quant = drive(quant)
+    assert all(h.done and len(h.tokens) == n_new for h in qhandles)
+    qsnap = quant.metrics.snapshot()
+    qttft = qsnap["ttft_ms"] or {}
+    n_tok = sum(len(h.tokens) for h in qhandles)
+
+    # quality referee: the int8 arena proposes, the f32 target judges
+    ref_eng = ServeEngine(m, fixed_slots, max_len + block_size,
+                          block_size=block_size, draft_model=m,
+                          spec_k=3, draft_kv_dtype="int8",
+                          max_queue=2 * len(prompts))
+    rhandles = [ref_eng.submit(p, max_new_tokens=n_new) for p in prompts]
+    ref_eng.run_until_idle()
+    mismatched = sum(not np.array_equal(ref, np.asarray(h.tokens))
+                     for ref, h in zip(refs, rhandles))
+    if mismatched:
+        raise AssertionError(
+            f"{mismatched}/{len(prompts)} referee streams diverged — "
+            f"the f32 verify referee must keep greedy identity over "
+            f"any draft, including a quantized one")
+    rsnap = ref_eng.metrics.snapshot()
+
+    payload = {
+        "tokens_per_s": round(n_tok / t_quant, 1),
+        "speedup_vs_sequential": round(t_seq / t_quant, 3),
+        "ttft_p50_ms": round(qttft.get("p50", 0.0), 3),
+        "ttft_p99_ms": round(qttft.get("p99", 0.0), 3),
+        "requests": len(prompts),
+        "fixed_max_concurrent": fixed_slots,
+        "paged_peak_concurrent": paged_peak,
+        "quant_peak_concurrent": quant_peak,
+        "arena_bytes_f32": int(arena_f32),
+        "arena_bytes_int8": int(arena_int8),
+        "accept_rate": round(rsnap["accept_rate"] or 0.0, 4),
+        "tokens_per_dispatch": round(rsnap["tokens_per_dispatch"]
+                                     or 0.0, 3),
+    }
+    detail = dict(payload)
+    detail.update({
+        "device": getattr(dev, "device_kind", "") or dev.platform,
+        "max_len": max_len, "block_size": block_size,
+        "pool_blocks_f32": pool_blocks,
+        "pool_blocks_int8": int(quant_blocks),
+        "new_tokens": n_new,
+        "concurrency_gain": round(quant_peak / max(paged_peak, 1), 3),
+    })
+    _detail("serve_arena_compare", detail)
+    if quant_peak < 2 * paged_peak:
+        raise AssertionError(
+            f"int8 peak concurrency {quant_peak} is under 2x the f32 "
+            f"paged peak {paged_peak} at equal arena memory "
+            f"({arena_int8}/{arena_f32} B) — the int8 tier's "
+            f"acceptance claim does not hold on this box")
+    if record:
+        _record_serve(payload, "tpu" if on_tpu else "cpu",
+                      getattr(dev, "device_kind", "") or dev.platform)
+
+
 def _emit_perf_attr(led, seng, window_s: float, dump_path: str | None,
                     *, record: bool, on_tpu: bool,
                     device_kind: str) -> None:
@@ -1219,7 +1380,9 @@ def _serve_only_main() -> None:
     `--no-record` skips the store append (the CI gate's table-resolved
     smoke must not dirty the committed store on every run);
     `--perf-attr PATH` additionally dumps the runtime-attribution
-    payload (ISSUE 16) to PATH for `tools.lint --perf`."""
+    payload (ISSUE 16) to PATH for `tools.lint --perf`;
+    `--arena-compare` instead runs the ISSUE-17 equal-memory
+    f32-vs-int8 KV arena comparison (bench_arena_compare)."""
     import jax
 
     dev = jax.devices()[0]
@@ -1229,6 +1392,10 @@ def _serve_only_main() -> None:
     parallel.set_mesh(None)
     device.set_default_device(device.create_tpu_device() if on_tpu
                               else device.create_cpu_device())
+    if "--arena-compare" in sys.argv:
+        bench_arena_compare(dev, on_tpu,
+                            record="--no-record" not in sys.argv)
+        return
     perf_attr = None
     if "--perf-attr" in sys.argv:
         idx = sys.argv.index("--perf-attr")

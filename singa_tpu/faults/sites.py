@@ -87,6 +87,17 @@ SITES: Dict[str, Tuple[str, Tuple[str, ...]]] = {
         "prompt (+ tokens so far) on a prefill worker and its greedy "
         "stream is unchanged",
         ("error", "hang")),
+    "serve.spill": (
+        "KV spill tier seams (serve/mem.py, both directions): the "
+        "spill WRITE when an evicted refcount-0 prefix block's bytes "
+        "are copied to the host store, and the prefetch READ when a "
+        "prefix hit restores a spilled block into a free physical "
+        "block; fires BEFORE either copy, so an injected error only "
+        "DEGRADES — the block dies unspilled / the prefix re-prefills, "
+        "exactly the pre-spill behavior — streams stay bitwise "
+        "identical and the fault lands as a serve.spill incident with "
+        "a flight dump",
+        ("error", "hang")),
     "serve.router": (
         "disaggregated-tier routing decision (per Router.submit, "
         "before a prefill worker is chosen); an injected error "

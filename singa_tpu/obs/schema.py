@@ -42,6 +42,7 @@ __all__ = ["SCHEMA_VERSION", "SchemaError", "require", "validate_entry",
            "validate_autotune_sweep_payload", "validate_perf_attr_payload",
            "validate_wire_byte_fields", "validate_flight_ref",
            "validate_serve_tier_fields", "validate_spec_fields",
+           "validate_serve_spill_fields", "validate_serve_arena_fields",
            "entry_key"]
 
 #: bump when entry fields change incompatibly; validators dispatch on it
@@ -85,6 +86,36 @@ _SERVE_TIER_FIELDS = ("prefill_workers", "decode_workers", "handoffs",
 #: vice versa, cannot support the tokens-per-dispatch claim
 #: speculation exists to make)
 _SPEC_FIELDS = ("accept_rate", "tokens_per_dispatch")
+
+#: the KV spill-tier trio (ServeEngine(spill_blocks=) /
+#: tools/loadgen.py --spill-blocks): evicted prefix blocks spilled to
+#: host RAM, spilled blocks restored on prefix hits, and the cumulative
+#: host-side restore wait.  OPTIONAL on serve_load payloads — a run
+#: with no spill tier has nothing to report — but a record carrying ANY
+#: of them must carry ALL, numeric (spill pressure with no restore
+#: evidence, or hits with no wait cost, cannot support the
+#: TTFT-on-re-hit claim the tier exists to make)
+_SERVE_SPILL_FIELDS = ("spilled_blocks", "prefetch_hits",
+                       "prefetch_wait_ms")
+
+#: the KV-arena memory-hierarchy compare (bench.py --serve
+#: --arena-compare): peak measured concurrency of an f32 paged arena
+#: and of an int8 QuantKV arena holding the SAME HBM byte budget (both
+#: byte totals on the record), against the fixed-arena slot ceiling
+#: that budget buys.  OPTIONAL on serve_throughput payloads — the
+#: plain serving bench has no quantized arena — but a record carrying
+#: ANY of the int8-side fields (``_SERVE_ARENA_TRIGGERS``) must carry
+#: ALL FIVE, numeric: a quantized peak without the equal-bytes
+#: evidence (or without the f32 peak it beats) cannot support the
+#: concurrency-per-byte claim the int8 tier exists to make (see
+#: docs/serving.md, "KV memory hierarchy").  The fixed/paged pair
+#: alone stays valid — that is the PR 6 paged-vs-fixed compare, which
+#: predates the int8 tier.
+_SERVE_ARENA_FIELDS = ("fixed_max_concurrent", "paged_peak_concurrent",
+                       "quant_peak_concurrent", "arena_bytes_f32",
+                       "arena_bytes_int8")
+_SERVE_ARENA_TRIGGERS = ("quant_peak_concurrent", "arena_bytes_f32",
+                         "arena_bytes_int8")
 
 #: required numeric payload fields of a train_run entry — what the
 #: training orchestrator (singa_tpu.train.TrainRunner) commits for
@@ -290,9 +321,27 @@ def validate_serve_payload(payload: Any, ctx: str = "serve payload") -> None:
     ``_SERVE_FIELDS`` present and numeric (a serving record with a
     missing TTFT percentile is the r5 silent-truncation failure mode
     wearing a new hat).  The optional speculative-decoding pair
-    (``_SPEC_FIELDS``) is linted whenever either appears."""
+    (``_SPEC_FIELDS``) and the optional KV-arena compare group
+    (``_SERVE_ARENA_FIELDS``) are linted whenever any of them
+    appear."""
     _require_numeric_fields(payload, _SERVE_FIELDS, ctx)
     validate_spec_fields(payload, ctx)
+    validate_serve_arena_fields(payload, ctx)
+
+
+def validate_serve_arena_fields(payload: Any,
+                                ctx: str = "payload") -> None:
+    """The optional KV-arena memory-hierarchy compare: a payload
+    carrying ANY of the int8-side fields (``_SERVE_ARENA_TRIGGERS``)
+    must carry all five of ``_SERVE_ARENA_FIELDS``, numeric — a
+    quantized concurrency peak stripped of its equal-bytes evidence
+    (or of the f32 peak it is measured against) cannot support the
+    concurrency-per-byte claim the int8 KV tier exists to make.  The
+    PR 6 fixed/paged pair on its own is NOT a trigger."""
+    if not isinstance(payload, dict):
+        return
+    if any(f in payload for f in _SERVE_ARENA_TRIGGERS):
+        _require_numeric_fields(payload, _SERVE_ARENA_FIELDS, ctx)
 
 
 def validate_serve_load_payload(payload: Any,
@@ -301,12 +350,27 @@ def validate_serve_load_payload(payload: Any,
     ``_SERVE_LOAD_FIELDS`` present and numeric — an overload run whose
     shed/rejected counts went missing would let 'survived the chaos
     run' masquerade as 'served every request'.  The optional
-    disaggregated-tier pool fields (``_SERVE_TIER_FIELDS``) and the
-    optional speculative-decoding pair (``_SPEC_FIELDS``) are linted
+    disaggregated-tier pool fields (``_SERVE_TIER_FIELDS``), the
+    optional speculative-decoding pair (``_SPEC_FIELDS``) and the
+    optional KV spill-tier trio (``_SERVE_SPILL_FIELDS``) are linted
     whenever any of them appear."""
     _require_numeric_fields(payload, _SERVE_LOAD_FIELDS, ctx)
     validate_serve_tier_fields(payload, ctx)
     validate_spec_fields(payload, ctx)
+    validate_serve_spill_fields(payload, ctx)
+
+
+def validate_serve_spill_fields(payload: Any,
+                                ctx: str = "payload") -> None:
+    """The optional KV spill-tier trio: a payload carrying ANY of
+    ``_SERVE_SPILL_FIELDS`` must carry all three, numeric — spill
+    pressure without restore evidence (or hits without their wait
+    cost) cannot support the TTFT-on-re-hit claim the spill tier
+    exists to make (see docs/serving.md, "KV memory hierarchy")."""
+    if not isinstance(payload, dict):
+        return
+    if any(f in payload for f in _SERVE_SPILL_FIELDS):
+        _require_numeric_fields(payload, _SERVE_SPILL_FIELDS, ctx)
 
 
 def validate_spec_fields(payload: Any, ctx: str = "payload") -> None:

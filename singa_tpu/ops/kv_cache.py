@@ -9,6 +9,21 @@ Prefill attends within the prompt via the regular attention stack (the
 Pallas flash kernel when the shape tiles); decode steps (Tq=1) are
 bandwidth-bound matvecs where flash has nothing to win, so they run the
 masked-reference path against the full cache.
+
+**Int8 KV blocks** (ISSUE 17): a paged arena may store its blocks as
+:class:`QuantKV` — int8 codes plus a per-position f32 scale (one scale
+per (K, D) slab, i.e. a ``(block_size,)`` scale vector per block).
+Every gather/scatter primitive below branches on ``isinstance(ck,
+QuantKV)`` at TRACE time: quantize-on-scatter / dequantize-on-gather
+are fixed-shape elementwise ops folded into the same programs, so an
+int8 arena compiles the same fixed program set as a full-precision one
+(one jit entry per program, asserted in tests) while its decode
+dispatch streams ~4x fewer KV bytes through HBM (the hlocost
+``decode_int8`` flagship baseline is the committed evidence).  The
+scale granularity is per POSITION, not per block, because
+``scatter_token_kv``/``scatter_tokens_kv`` write partial blocks — a
+single per-block scalar would force requantizing the block's existing
+content whenever a new token's amax grew past it.
 """
 
 from __future__ import annotations
@@ -21,7 +36,67 @@ import jax.numpy as jnp
 
 __all__ = ["init_cache", "update_cache", "cached_sdpa",
            "gather_block_kv", "scatter_block_kv", "scatter_token_kv",
-           "scatter_tokens_kv"]
+           "scatter_tokens_kv", "QuantKV", "quantize_kv",
+           "dequantize_kv"]
+
+#: int8 code range: symmetric, -127..127 (the -128 code is unused so
+#: quantization commutes with negation and the scale maps amax -> 127)
+_QMAX = 127.0
+#: scale floor so an all-zero (K, D) slab quantizes to exact zeros
+#: instead of dividing by zero (dequantized value stays exactly 0.0)
+_SCALE_FLOOR = 1e-30
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantKV:
+    """One int8-quantized KV pool: ``q`` int8 codes with the pool's
+    layout (``(num_blocks, block_size, K, D)``) and ``scale`` f32 of
+    shape ``(num_blocks, block_size, 1, 1)`` — dequantized value is
+    ``q * scale``.  A registered pytree, so it flows through jit
+    arguments, donation and ``jax.tree`` utilities exactly like the
+    plain arrays it replaces; ``.shape``/``.dtype`` mirror ``q`` so
+    shape-reading call sites (``ck.shape[1]``) need no branch."""
+
+    __slots__ = ("q", "scale")
+
+    def __init__(self, q, scale):
+        self.q = q
+        self.scale = scale
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def __repr__(self):
+        return f"QuantKV(q={self.q.shape}, scale={self.scale.shape})"
+
+
+def quantize_kv(x):
+    """Quantize ``x`` (..., K, D) to (int8 codes, f32 scales): one
+    symmetric absmax scale per leading index (per position), shape
+    (..., 1, 1).  Fixed-shape elementwise math — folds into whatever
+    program performs the scatter."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=(-2, -1), keepdims=True)
+    scale = jnp.maximum(amax / _QMAX, _SCALE_FLOOR)
+    q = jnp.clip(jnp.round(xf / scale), -_QMAX, _QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale):
+    """Inverse of :func:`quantize_kv` (f32 out)."""
+    return q.astype(jnp.float32) * scale
 
 
 def init_cache(num_layers: int, batch: int, max_len: int, num_kv_heads: int,
@@ -61,7 +136,10 @@ def gather_block_kv(ck, cv, table):
     fixed-shape ``jnp.take`` on the leading axis, so the paged arena
     rides ONE compiled program no matter which physical blocks a
     request holds (stale/unallocated table entries read garbage that
-    the attention ``limit`` mask makes unreachable)."""
+    the attention ``limit`` mask makes unreachable).  A :class:`QuantKV`
+    arena gathers codes AND scales through the same take and
+    dequantizes in-program — the dense view is f32 either way the
+    attention math sees it."""
     B, M = table.shape
     bs = ck.shape[1]
 
@@ -69,6 +147,9 @@ def gather_block_kv(ck, cv, table):
         g = jnp.take(c, table.reshape(-1), axis=0)        # (B*M, bs, K, D)
         return g.reshape((B, M * bs) + c.shape[2:])
 
+    if isinstance(ck, QuantKV):
+        return (dense(ck.q).astype(jnp.float32) * dense(ck.scale),
+                dense(cv.q).astype(jnp.float32) * dense(cv.scale))
     return dense(ck), dense(cv)
 
 
@@ -79,6 +160,13 @@ def scatter_block_kv(ck, cv, block, k_blk, v_blk):
     ``v_blk`` are (block_size, K, D).  The chunked-prefill counterpart
     of :func:`gather_block_kv` — a fixed-shape scatter at a dynamic
     leading index, one compiled shape for every block."""
+    if isinstance(ck, QuantKV):
+        kq, ks = quantize_kv(k_blk)
+        vq, vs = quantize_kv(v_blk)
+        return (QuantKV(ck.q.at[block].set(kq),
+                        ck.scale.at[block].set(ks)),
+                QuantKV(cv.q.at[block].set(vq),
+                        cv.scale.at[block].set(vs)))
     return (ck.at[block].set(k_blk.astype(ck.dtype)),
             cv.at[block].set(v_blk.astype(cv.dtype)))
 
@@ -92,6 +180,13 @@ def scatter_token_kv(ck, cv, block, offset, k_tok, v_tok):
     per-row vector path; rows sharing a target (inactive slots
     redirected to the null block) resolve arbitrarily, which is safe
     because the null block is never inside any row's validity window."""
+    if isinstance(ck, QuantKV):
+        kq, ks = quantize_kv(k_tok)
+        vq, vs = quantize_kv(v_tok)
+        return (QuantKV(ck.q.at[block, offset].set(kq),
+                        ck.scale.at[block, offset].set(ks)),
+                QuantKV(cv.q.at[block, offset].set(vq),
+                        cv.scale.at[block, offset].set(vs)))
     return (ck.at[block, offset].set(k_tok.astype(ck.dtype)),
             cv.at[block, offset].set(v_tok.astype(cv.dtype)))
 
@@ -109,6 +204,13 @@ def scatter_tokens_kv(ck, cv, blocks, offsets, k_toks, v_toks):
     exactly like any stale block content.  Rows sharing a target
     (inactive slots redirected to the null block for every window
     position) resolve arbitrarily, which is safe for the same reason."""
+    if isinstance(ck, QuantKV):
+        kq, ks = quantize_kv(k_toks)
+        vq, vs = quantize_kv(v_toks)
+        return (QuantKV(ck.q.at[blocks, offsets].set(kq),
+                        ck.scale.at[blocks, offsets].set(ks)),
+                QuantKV(cv.q.at[blocks, offsets].set(vq),
+                        cv.scale.at[blocks, offsets].set(vs)))
     return (ck.at[blocks, offsets].set(k_toks.astype(ck.dtype)),
             cv.at[blocks, offsets].set(v_toks.astype(cv.dtype)))
 

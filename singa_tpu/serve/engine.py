@@ -136,6 +136,7 @@ from ..obs import trace as obs_trace
 from ..ops import kv_cache as kv_ops
 from ..utils import failure
 from ..utils.failure import Heartbeat
+from . import mem as serve_mem
 from .metrics import ServeMetrics
 from .scheduler import (EVICTED, FAILED, FINISHED, QUEUED, RUNNING,
                         QueueFull, Request, RequestHandle, Scheduler,
@@ -178,6 +179,14 @@ class SharedPrograms(NamedTuple):
     draft_ref: object = None
     spec_k: int = 0
     verify: object = None
+    #: KV memory hierarchy (ISSUE 17, serve/mem.py): the arena storage
+    #: formats the closures were TRACED against (None = full precision,
+    #: "int8" = QuantKV codes + scales).  A format mismatch would not
+    #: error — it would silently add a second jit-cache entry per
+    #: program and break the (1, 1) invariant — so sharing validates
+    #: equality up front.
+    kv_dtype: object = None
+    draft_kv_dtype: object = None
 
 
 class ServeEngine:
@@ -219,6 +228,8 @@ class ServeEngine:
                  run_id: Optional[str] = None,
                  programs: Optional[SharedPrograms] = None,
                  draft_model=None, spec_k: Optional[int] = None,
+                 kv_dtype=None, draft_kv_dtype=None,
+                 spill_blocks: Optional[int] = None,
                  _sleep: Callable[[float], None] = time.sleep):
         self.model = model
         # speculative decoding (serve/spec.py): a draft model turns the
@@ -339,9 +350,29 @@ class ServeEngine:
         self._num_slots, self._max_len = num_slots, max_len
         self._block_size, self._num_blocks = block_size, num_blocks
         self._arena_dtype = arena_dtype
+        # KV memory hierarchy (ISSUE 17, serve/mem.py): arena storage
+        # formats + the host-RAM spill tier for evicted prefix blocks.
+        # The SpillStore is content-addressed (chain keys), so it
+        # SURVIVES arena recovery — _recover hands the same store to
+        # the fresh pool and a tenant's spilled system prompt outlives
+        # even a rebuild.
+        self._kv_dtype = serve_mem.normalize_kv_dtype(kv_dtype)
+        self._draft_kv_dtype = (self._kv_dtype if draft_kv_dtype is None
+                                else serve_mem.normalize_kv_dtype(
+                                    draft_kv_dtype))
+        if spill_blocks is not None and spill_blocks < 1:
+            raise ValueError(
+                f"spill_blocks must be >= 1 (or None to disable the "
+                f"spill tier), got {spill_blocks}")
+        self._spill = (serve_mem.SpillStore(spill_blocks)
+                       if spill_blocks is not None else None)
         self.pool = BlockPool(model, num_slots, max_len,
                               block_size=block_size, num_blocks=num_blocks,
-                              dtype=arena_dtype, draft_model=draft_model)
+                              dtype=arena_dtype, draft_model=draft_model,
+                              kv_dtype=self._kv_dtype,
+                              draft_kv_dtype=self._draft_kv_dtype,
+                              spill=self._spill)
+        self._wire_spill()
 
         self._running: Dict[int, Request] = {}      # slot -> request
         # device-resident per-slot last tokens: written by prefill (the
@@ -372,6 +403,16 @@ class ServeEngine:
                     "object and spec_k (the verify program's closures "
                     f"capture both; template spec_k={programs.spec_k}, "
                     f"this engine spec_k={self.spec_k})")
+            if programs.kv_dtype != self._kv_dtype or \
+                    programs.draft_kv_dtype != self._draft_kv_dtype:
+                raise ValueError(
+                    "programs= sharing requires matching arena storage "
+                    "formats (template kv_dtype="
+                    f"{programs.kv_dtype!r}/draft "
+                    f"{programs.draft_kv_dtype!r}, this engine "
+                    f"{self._kv_dtype!r}/{self._draft_kv_dtype!r}) — a "
+                    "mismatch would silently retrace every program "
+                    "against the other arena layout instead of sharing")
             self._prefill = programs.prefill
             self._decode = programs.decode
             self._handoff = programs.handoff
@@ -503,7 +544,8 @@ class ServeEngine:
         :class:`SharedPrograms`."""
         return SharedPrograms(self.model, self.pool.block_size,
                               self._prefill, self._decode, self._handoff,
-                              self.draft_model, self.spec_k, self._verify)
+                              self.draft_model, self.spec_k, self._verify,
+                              self._kv_dtype, self._draft_kv_dtype)
 
     def lower_programs(self, names=None):
         """jax ``Lowered`` handles of the exactly-two programs (keyed
@@ -743,8 +785,16 @@ class ServeEngine:
                         raise
                     self._recover(f"decode: {type(e).__name__}: {e}")
 
+            # settle spill payloads onto host numpy AFTER the tick's
+            # token-extraction sync: the D2H copies are already done,
+            # so this collects without waiting, and device-side spill
+            # buffers live at most one tick
+            if self._spill is not None:
+                self._spill.settle()
+
             self.metrics.on_step(self.sched.depth, self.pool.active_count,
-                                 self.pool.blocks_in_use)
+                                 self.pool.blocks_in_use,
+                                 self.pool.blocks_in_use_bytes)
             dt = time.monotonic() - now
             self._tick_ewma = dt if self._tick_ewma is None else \
                 0.8 * self._tick_ewma + 0.2 * dt
@@ -836,6 +886,25 @@ class ServeEngine:
         return False
 
     # -- internals ---------------------------------------------------------
+    def _wire_spill(self) -> None:
+        """Point the pool's spill-tier callbacks at this engine: spill/
+        prefetch accounting lands in the metrics, and an injected
+        ``serve.spill`` fault produces a flight dump + incident record
+        (the fault itself only DEGRADES — the block dies or the prefix
+        re-prefills, streams are unchanged — but the evidence trail
+        must still exist)."""
+        if self.pool.spill is None:
+            return
+        self.pool.on_spill = self.metrics.on_spill
+        self.pool.on_prefetch = self.metrics.on_prefetch
+        self.pool.on_spill_fault = self._spill_fault
+
+    def _spill_fault(self, op: str, exc: Exception) -> None:
+        ref = self._flight_dump("serve.spill",
+                                f"{op} fault: {type(exc).__name__}")
+        self._incident("serve.spill", type(exc).__name__, f"op:{op}",
+                       "degraded", 0, flight_ref=ref)
+
     #: dispatch site -> the cost model's program key (hlo.FLAGSHIP_
     #: PROGRAMS) the runtime-attribution ledger accumulates under; the
     #: handoff gather is timed at its own seam (serve/disagg/handoff.py
@@ -843,6 +912,15 @@ class ServeEngine:
     _ATTR_PROGRAMS = {"serve.prefill": "prefill_chunk",
                       "serve.decode": "decode",
                       "serve.verify": "verify"}
+
+    def _attr_program(self, site: str) -> str:
+        """The ledger key one dispatch accumulates under.  An int8
+        arena's decode is a DIFFERENT compiled program with its own
+        cost-model row (the ``decode_int8`` flagship), so its runtime
+        must reconcile against that row, not full-precision decode's."""
+        if site == "serve.decode" and self._kv_dtype == "int8":
+            return "decode_int8"
+        return self._ATTR_PROGRAMS.get(site, site)
 
     def _dispatch(self, site: str, fn, args, **attrs):
         """One guarded jitted dispatch: the injection site fires first
@@ -869,7 +947,7 @@ class ServeEngine:
                     return fn(*args)
                 t0 = time.perf_counter()
                 out = fn(*args)
-                led.note(self._ATTR_PROGRAMS.get(site, site),
+                led.note(self._attr_program(site),
                          time.perf_counter() - t0)
                 return out
             except (RuntimeError, OSError) as e:
@@ -1209,12 +1287,19 @@ class ServeEngine:
             # recompiles.  The prefix cache dies with the old pool
             # (its blocks' contents are gone); re-prefills rebuild
             # tables and refcounts from scratch.
+            # ... except what already SPILLED: the store is content-
+            # addressed (chain keys), so its host-side payloads stay
+            # valid for the fresh arena and survive the rebuild
             self.pool = BlockPool(self.model, self._num_slots,
                                   self._max_len,
                                   block_size=self._block_size,
                                   num_blocks=self._num_blocks,
                                   dtype=self._arena_dtype,
-                                  draft_model=self.draft_model)
+                                  draft_model=self.draft_model,
+                                  kv_dtype=self._kv_dtype,
+                                  draft_kv_dtype=self._draft_kv_dtype,
+                                  spill=self._spill)
+            self._wire_spill()
             self._toks = jnp.zeros((self._num_slots,), jnp.int32)
             requeue = []
             for req in inflight:

@@ -1,0 +1,280 @@
+"""KV arena memory hierarchy (ISSUE 17): int8 KV blocks + a host-RAM
+spill tier for cold prefix blocks.
+
+Per-chip serve concurrency is bounded by HBM, and the paged arena's
+unit of management — the block — is exactly the unit to compress and
+to spill.  This module is the subsystem behind both tiers;
+:class:`~singa_tpu.serve.slots.BlockPool` consumes it and
+:class:`~singa_tpu.serve.engine.ServeEngine` exposes the knobs
+(``kv_dtype=``, ``draft_kv_dtype=``, ``spill_blocks=``).
+
+**Tier 1 — int8 KV blocks** (``kv_dtype="int8"``): the per-layer block
+pools are :class:`~singa_tpu.ops.kv_cache.QuantKV` containers — int8
+codes plus a per-position f32 absmax scale (a ``(block_size,)`` scale
+vector per block, the EQuARX-style blockwise granule: one scale per
+(K, D) slab a scatter writes).  Quantize-on-scatter and
+dequantize-on-gather live INSIDE the existing gather/scatter
+primitives, so an int8 engine compiles the same fixed program set
+(prefill, decode, verify, handoff) with one jit entry each — the
+``decode_int8`` hlocost flagship baseline commits the resulting
+HBM-traffic drop.  Quantized KV breaks bitwise greedy identity BY
+CONSTRUCTION, so the int8 tier is gated honestly through the
+spec-verify referee: run the quantized arena as the draft/proposer
+against a full-precision target referee and commit the measured accept
+rate as the quality number (``bench.py --serve --arena-compare``).
+
+**Tier 2 — host-RAM prefix spill** (``spill_blocks=N``): refcount-0
+LRU prefix blocks — which already park in the pool's evictable list —
+spill FULL-PRECISION (their exact device representation: int8 codes +
+scales for a quantized arena, raw f32/bf16 otherwise) to host memory
+instead of dying when the arena reclaims them.  On the next
+prefix-cache hit the block is prefetched back into a free physical
+block; JAX's async dispatch means the host never blocks on the copy —
+the restore is enqueued and the prefill/decode programs queue behind
+it.  A spilled-and-restored block round-trips BITWISE (device -> host
+-> device of the same buffer), so the spill tier never changes a
+stream: it only converts a re-prefill into a copy, which is the TTFT
+win on re-hit.  Both seams (spill write, prefetch read) fire the
+``serve.spill`` fault-injection site, and an injected fault degrades
+to exactly the pre-spill behavior (the block dies / the prefix
+re-prefills) — a performance loss, never a correctness one.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.kv_cache import QuantKV, dequantize_kv, quantize_kv
+
+__all__ = ["QuantKV", "quantize_kv", "dequantize_kv", "KV_DTYPES",
+           "normalize_kv_dtype", "quant_arena", "arena_block_bytes",
+           "arena_bytes", "SpillStore", "read_block", "write_block",
+           "write_blocks", "restore_compiled_count", "RESTORE_BATCH"]
+
+#: accepted ``kv_dtype=`` spellings -> canonical form (None = the
+#: model's native full-precision arena)
+KV_DTYPES = {None: None, "f32": None, "full": None, "int8": "int8"}
+
+
+def normalize_kv_dtype(kv_dtype) -> Optional[str]:
+    """Canonicalize a ``kv_dtype=`` knob value (``None`` | ``"int8"``),
+    rejecting typos loudly at construction."""
+    if kv_dtype in KV_DTYPES:
+        return KV_DTYPES[kv_dtype]
+    raise ValueError(
+        f"kv_dtype must be one of {sorted(k for k in KV_DTYPES if k)} "
+        f"or None, got {kv_dtype!r}")
+
+
+def quant_arena(model, num_blocks: int, block_size: int) -> List[Tuple]:
+    """Per-layer ``(QuantKV, QuantKV)`` block pools shaped like
+    ``model.init_caches(num_blocks, block_size)``.  ``eval_shape``
+    keeps the full-precision arena abstract — construction never
+    allocates a float copy, only the int8 codes + f32 scales."""
+    spec = jax.eval_shape(lambda: model.init_caches(num_blocks,
+                                                    block_size))
+    out = []
+    for ck, cv in spec:
+        def pool(s):
+            scale = s.shape[:2] + (1,) * (len(s.shape) - 2)
+            return QuantKV(jnp.zeros(s.shape, jnp.int8),
+                           jnp.zeros(scale, jnp.float32))
+        out.append((pool(ck), pool(cv)))
+    return out
+
+
+def arena_block_bytes(caches, draft_caches=None) -> int:
+    """Bytes ONE physical block occupies across every arena leaf —
+    target + draft pools, int8 codes AND f32 scale tensors (QuantKV
+    leaves flatten into both).  ``blocks_in_use * arena_block_bytes``
+    is the honest HBM footprint the ``serve.blocks_in_use_bytes``
+    gauge reports."""
+    leaves = jax.tree.leaves(caches)
+    if draft_caches is not None:
+        leaves += jax.tree.leaves(draft_caches)
+    return sum(int(np.prod(leaf.shape[1:])) * np.dtype(leaf.dtype).itemsize
+               for leaf in leaves)
+
+
+def arena_bytes(caches, draft_caches=None) -> int:
+    """Total bytes of the block pools (every leaf, all blocks)."""
+    leaves = jax.tree.leaves(caches)
+    if draft_caches is not None:
+        leaves += jax.tree.leaves(draft_caches)
+    return sum(int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+               for leaf in leaves)
+
+
+def read_block(caches, draft_caches, block: int) -> Dict[str, Any]:
+    """Snapshot physical ``block``'s exact device representation (the
+    spill payload): every leaf of the target (and draft) pools sliced
+    at the block index, with the device->host copy STARTED but never
+    awaited — an eviction must not put a sync barrier on the admission
+    path that evicts.  The slices are fresh buffers, so the arena
+    reclaiming the block cannot corrupt them; :class:`SpillStore`
+    materializes the payload to host numpy off this path (see
+    :meth:`SpillStore.put`), and the same-dtype round-trip through
+    :func:`write_blocks` is bitwise."""
+    def host(c):
+        s = c[block]
+        if hasattr(s, "copy_to_host_async"):
+            s.copy_to_host_async()
+        return s
+    return {"kv": jax.tree.map(host, caches),
+            "draft": (None if draft_caches is None
+                      else jax.tree.map(host, draft_caches))}
+
+
+#: spilled blocks restored per compiled-restore dispatch.  Restores are
+#: padded up to this batch (by repeating the first block — duplicate
+#: scatter indices carrying IDENTICAL updates, so the write is
+#: deterministic) and chunked above it, keeping the restore program's
+#: input shapes FIXED: it compiles once per arena structure and never
+#: retraces, however many blocks an admission restores.
+RESTORE_BATCH = 8
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _restore_step(arenas, idx, updates):
+    """THE restore program: scatter ``RESTORE_BATCH`` spilled blocks
+    into the arena pytree (target and draft together) in one donated
+    dispatch — the arenas are updated in place, never copied.  A
+    block-at-a-time eager restore pays per-leaf dispatch overhead that
+    makes a re-hit LOSE to re-prefill on small models; one compiled
+    scatter makes the spill tier's TTFT win real."""
+    return jax.tree.map(lambda c, u: c.at[idx].set(u), arenas, updates)
+
+
+def restore_compiled_count() -> int:
+    """Jit-cache entry count of the restore program — the spill tier's
+    own fixed-program invariant (at most one entry per arena
+    structure; asserted alongside the engine's (1, 1) contract)."""
+    return _restore_step._cache_size()
+
+
+def write_blocks(caches, draft_caches, blocks: List[int],
+                 payloads: List[Dict[str, Any]]):
+    """Write :func:`read_block` payloads back into physical ``blocks``
+    of (possibly different) pools — the prefetch restore, one
+    :func:`_restore_step` dispatch per ``RESTORE_BATCH`` chunk.
+    Returns ``(caches, draft_caches)``."""
+    has_draft = (draft_caches is not None
+                 and payloads[0]["draft"] is not None)
+    for i in range(0, len(blocks), RESTORE_BATCH):
+        bl = list(blocks[i:i + RESTORE_BATCH])
+        pl = payloads[i:i + RESTORE_BATCH]
+        pad = RESTORE_BATCH - len(bl)
+
+        def stack(*hs):
+            return np.stack(hs + hs[:1] * pad)
+        idx = np.asarray(bl + bl[:1] * pad, np.int32)
+        kv_u = jax.tree.map(stack, *[p["kv"] for p in pl])
+        draft_u = (jax.tree.map(stack, *[p["draft"] for p in pl])
+                   if has_draft else None)
+        caches, new_draft = _restore_step(
+            (caches, draft_caches if has_draft else None),
+            idx, (kv_u, draft_u))
+        if has_draft:
+            draft_caches = new_draft
+    return caches, draft_caches
+
+
+def write_block(caches, draft_caches, block: int, payload: Dict[str, Any]):
+    """Single-block :func:`write_blocks` (kept for tests and tools that
+    round-trip one payload)."""
+    return write_blocks(caches, draft_caches, [block], [payload])
+
+
+class SpillStore:
+    """Bounded host-RAM LRU of spilled prefix blocks, keyed by the
+    pool's content-addressed chain keys.  Because a chain key commits
+    to every token of the whole prefix (and block content is a
+    deterministic function of those tokens under the shared weights),
+    entries stay valid across arena rebuilds — recovery keeps the
+    store, so a tenant's system prompt survives even an arena
+    recovery.  Capacity overflow drops the OLDEST entry (those blocks
+    simply re-prefill on their next hit, the pre-spill behavior)."""
+
+    def __init__(self, max_blocks: int = 256):
+        if max_blocks < 1:
+            raise ValueError(
+                f"spill capacity must be >= 1 block, got {max_blocks}")
+        self.max_blocks = int(max_blocks)
+        self._data: "OrderedDict[bytes, Dict[str, Any]]" = OrderedDict()
+        #: keys whose payload still holds the device slices read_block
+        #: snapshotted (D2H copy in flight, not yet numpy)
+        self._lazy: set = set()
+        #: entries dropped for capacity (cumulative)
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __contains__(self, key: bytes) -> bool:
+        return key in self._data
+
+    @property
+    def bytes(self) -> int:
+        """Host bytes currently held (payload arrays only)."""
+        total = 0
+        for payload in self._data.values():
+            for part in (payload["kv"], payload["draft"]):
+                if part is not None:
+                    total += sum(a.nbytes for a in jax.tree.leaves(part))
+        return total
+
+    def _materialize(self, key: bytes) -> None:
+        """Settle ``key``'s payload onto host numpy — called from
+        :meth:`settle` (the engine's end-of-step, after its token sync,
+        so the copies are already done and this is a collect, not a
+        wait) and from :meth:`get`/:meth:`pop` before a payload is
+        handed out."""
+        if key not in self._lazy:
+            return
+        self._lazy.discard(key)
+        def host(a):
+            return np.asarray(a)  # singalint: disable=SGL008 the designed spill settle point: collects a D2H copy read_block started earlier, off the admission path
+        p = self._data[key]
+        self._data[key] = {
+            "kv": jax.tree.map(host, p["kv"]),
+            "draft": (None if p["draft"] is None
+                      else jax.tree.map(host, p["draft"]))}
+
+    def settle(self) -> None:
+        """Materialize every pending payload to host numpy, releasing
+        the device slice buffers.  The engine calls this at the end of
+        each :meth:`~singa_tpu.serve.engine.ServeEngine.step` — right
+        after the step's own token-extraction sync, when the spill
+        copies have necessarily completed — so device-side spill
+        buffers live at most one tick."""
+        for key in list(self._lazy):
+            self._materialize(key)
+
+    def put(self, key: bytes, payload: Dict[str, Any]) -> None:
+        self._data[key] = payload
+        self._lazy.add(key)
+        self._data.move_to_end(key)
+        while len(self._data) > self.max_blocks:
+            dropped, _ = self._data.popitem(last=False)
+            self._lazy.discard(dropped)
+            self.evictions += 1
+
+    def get(self, key: bytes) -> Optional[Dict[str, Any]]:
+        if key not in self._data:
+            return None
+        self._materialize(key)
+        self._data.move_to_end(key)
+        return self._data[key]
+
+    def pop(self, key: bytes) -> Optional[Dict[str, Any]]:
+        if key not in self._data:
+            return None
+        self._materialize(key)
+        self._lazy.discard(key)
+        return self._data.pop(key)

@@ -36,6 +36,20 @@ name                        kind       meaning
 ``serve.active_slots``      gauge      live slots, after each step
 ``serve.blocks_in_use``     gauge      referenced KV blocks, after each
                                        step (the paged-arena footprint)
+``serve.blocks_in_use_bytes``  gauge   HBM bytes those blocks pin —
+                                       target + draft arenas, int8
+                                       codes AND f32 scale tensors
+                                       (block counts alone under-report
+                                       a quantized/speculative arena)
+``serve.spilled_blocks``    counter    evicted prefix blocks whose
+                                       bytes landed in the host-RAM
+                                       spill tier instead of dying
+``serve.prefetch_hits``     counter    spilled blocks restored into the
+                                       arena on a prefix hit (one per
+                                       restored block)
+``serve.prefetch_wait_ms``  histogram  host-side restore orchestration
+                                       per prefetched block (the copy
+                                       itself rides JAX async dispatch)
 ``serve.step``              span       one engine step (host wall clock)
 ``serve.prefill``           span       one prefill dispatch (+ fetch)
 ``serve.decode``            span       one decode dispatch (+ fetch)
@@ -107,6 +121,10 @@ class ServeMetrics:
         self.prefix_hits = 0
         self.prefix_hit_tokens = 0
         self.steps = 0
+        # KV memory hierarchy (ISSUE 17): spill-tier pressure counters
+        self.spilled_blocks = 0
+        self.prefetch_hits = 0
+        self.prefetch_wait_ms = 0.0
         # speculative decoding (ISSUE 13): per-(slot, round) accounting
         # for the accept rate and the tokens-per-dispatch headline —
         # slot_dispatches counts per-slot participations in a decode OR
@@ -179,6 +197,26 @@ class ServeMetrics:
         events.counter("serve.prefix_hit_tokens", tokens)
         self._note("counter", "serve.prefix_hits", tokens=tokens)
 
+    # -- KV memory hierarchy / spill tier (ISSUE 17) -----------------------
+    def on_spill(self, blocks: int) -> None:
+        """``blocks`` evicted prefix blocks spilled to host RAM instead
+        of dying (their next prefix hit restores them copy-wise)."""
+        self.spilled_blocks += blocks
+        events.counter("serve.spilled_blocks", blocks)
+        self._note("counter", "serve.spilled_blocks", blocks=blocks)
+
+    def on_prefetch(self, blocks: int, wait_ms: float) -> None:
+        """``blocks`` spilled block(s) restored on one prefix hit (the
+        pool fires this once per restored block); ``wait_ms`` is the
+        host-side restore orchestration time (the device copy itself
+        is async-dispatched)."""
+        self.prefetch_hits += blocks
+        self.prefetch_wait_ms += wait_ms
+        events.counter("serve.prefetch_hits", 1, blocks=blocks)
+        events.histogram("serve.prefetch_wait_ms", wait_ms)
+        self._note("counter", "serve.prefetch_hits", blocks=blocks,
+                   wait_ms=round(wait_ms, 3))
+
     # -- speculative decoding (ISSUE 13) -----------------------------------
     def on_spec_round(self, proposed: int, accepted: int) -> None:
         """One (slot, verify round): ``proposed`` = k draft tokens,
@@ -245,14 +283,17 @@ class ServeMetrics:
 
     # -- per-step levels ---------------------------------------------------
     def on_step(self, queue_depth: int, active_slots: int,
-                blocks_in_use: int = 0) -> None:
+                blocks_in_use: int = 0,
+                blocks_in_use_bytes: int = 0) -> None:
         self.steps += 1
         events.gauge("serve.queue_depth", queue_depth)
         events.gauge("serve.active_slots", active_slots)
         events.gauge("serve.blocks_in_use", blocks_in_use)
+        events.gauge("serve.blocks_in_use_bytes", blocks_in_use_bytes)
         self._note("gauge", "serve.step", queue_depth=queue_depth,
                    active_slots=active_slots,
-                   blocks_in_use=blocks_in_use)
+                   blocks_in_use=blocks_in_use,
+                   blocks_in_use_bytes=blocks_in_use_bytes)
 
     def snapshot(self) -> Dict[str, Any]:
         """Exact totals + THIS engine's latency summaries (None until
@@ -267,6 +308,9 @@ class ServeMetrics:
             "prefix_hits": self.prefix_hits,
             "prefix_hit_tokens": self.prefix_hit_tokens,
             "steps": self.steps,
+            "spilled_blocks": self.spilled_blocks,
+            "prefetch_hits": self.prefetch_hits,
+            "prefetch_wait_ms": self.prefetch_wait_ms,
             "spec_rounds": self.spec_rounds,
             "spec_proposed": self.spec_proposed,
             "spec_accepted": self.spec_accepted,

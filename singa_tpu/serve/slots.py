@@ -39,16 +39,32 @@ writes.  Its contents are garbage by design — every reader masks cache
 positions past its own validity window (``cached_sdpa`` per-row
 ``limit``), so the null block (like any stale table entry) is
 unreachable.
+
+**Memory hierarchy** (ISSUE 17, :mod:`singa_tpu.serve.mem`):
+``kv_dtype="int8"`` stores either arena as int8 codes + per-position
+f32 scales (:class:`~singa_tpu.ops.kv_cache.QuantKV` — the gather/
+scatter primitives quantize/dequantize in-program, so the compiled
+program set is unchanged), and a :class:`~singa_tpu.serve.mem.
+SpillStore` (``spill=``) turns LRU eviction of a keyed prefix block
+into a spill to host RAM: :meth:`_evict_lru` copies the block's exact
+device bytes out before reclaiming it, and :meth:`match_prefix`
+restores spilled blocks into free physical blocks on the next prefix
+hit (both seams fire the ``serve.spill`` injection site; an injected
+fault degrades to the pre-spill behavior — the block dies or the
+prefix re-prefills — never to a changed stream).
 """
 
 from __future__ import annotations
 
 import hashlib
+import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
+
+from . import mem
 
 __all__ = ["BlockPool"]
 
@@ -84,7 +100,9 @@ class BlockPool:
 
     def __init__(self, model, num_slots: int, max_len: int, *,
                  block_size: int = 16, num_blocks: Optional[int] = None,
-                 dtype=None, draft_model=None):
+                 dtype=None, draft_model=None, kv_dtype=None,
+                 draft_kv_dtype=None,
+                 spill: Optional[mem.SpillStore] = None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if max_len < 2:
@@ -105,7 +123,21 @@ class BlockPool:
                 f"request plus the null block (>= {self.max_blocks + 1} "
                 f"for max_len {max_len} at block_size {block_size})")
         self.num_blocks = num_blocks
-        if dtype is None:
+        # the memory-hierarchy knobs (serve/mem.py): per-arena storage
+        # format (None = full precision, "int8" = QuantKV codes +
+        # scales) — the draft arena inherits the target's format unless
+        # overridden, so a quantized engine quantizes both by default
+        # while the referee configuration (int8 proposer, f32 target)
+        # stays expressible via draft_kv_dtype="int8" alone
+        self.kv_dtype = mem.normalize_kv_dtype(kv_dtype)
+        self.draft_kv_dtype = (self.kv_dtype if draft_kv_dtype is None
+                               else mem.normalize_kv_dtype(draft_kv_dtype))
+        if self.kv_dtype == "int8":
+            # int8 arena: codes + scales replace the float pool (the
+            # dtype= serving-precision override is moot — scales are
+            # f32 by contract, codes are int8)
+            self.caches = mem.quant_arena(model, num_blocks, block_size)
+        elif dtype is None:
             self.caches = model.init_caches(num_blocks, block_size)
         else:
             # allocate straight in the serving dtype (e.g. bf16 under a
@@ -128,6 +160,9 @@ class BlockPool:
         self.draft_model = draft_model
         if draft_model is None:
             self.draft_caches = None
+        elif self.draft_kv_dtype == "int8":
+            self.draft_caches = mem.quant_arena(draft_model, num_blocks,
+                                                block_size)
         elif dtype is None:
             self.draft_caches = draft_model.init_caches(num_blocks,
                                                         block_size)
@@ -155,6 +190,19 @@ class BlockPool:
         self._block_of: Dict[bytes, int] = {}   # chain key -> block
         # refcount-0 keyed blocks, oldest first (eviction order)
         self._lru: "OrderedDict[int, None]" = OrderedDict()
+        # spill tier (serve/mem.py): evicted keyed blocks land here
+        # instead of dying; the engine wires the three callbacks after
+        # construction (metrics for spill/prefetch accounting, incident
+        # plumbing for injected serve.spill faults)
+        self.spill = spill
+        self.on_spill = None        # callable(n_blocks)
+        self.on_prefetch = None     # callable(n_blocks, wait_ms)
+        self.on_spill_fault = None  # callable(op, exc)
+        #: bytes ONE physical block occupies across every arena leaf
+        #: (target + draft, codes + scales) — the honest per-block HBM
+        #: footprint behind blocks_in_use_bytes
+        self.block_bytes = mem.arena_block_bytes(self.caches,
+                                                 self.draft_caches)
 
     # -- slot bookkeeping -------------------------------------------------
     @property
@@ -182,6 +230,13 @@ class BlockPool:
         """Blocks currently referenced by at least one mapped slot."""
         return int((self.ref > 0).sum())
 
+    @property
+    def blocks_in_use_bytes(self) -> int:
+        """HBM bytes those blocks pin across BOTH arenas (target +
+        draft, int8 codes AND f32 scale tensors) — blocks alone
+        under-report a quantized or speculative arena's footprint."""
+        return self.blocks_in_use * self.block_bytes
+
     def mapped_count(self, slot: int) -> int:
         return len(self._mapped[slot])
 
@@ -201,7 +256,80 @@ class BlockPool:
         key = self._key_of.pop(block, None)
         if key is not None and self._block_of.get(key) == block:
             del self._block_of[key]
+            if self.spill is not None:
+                self._spill_block(key, block)
         return block
+
+    # -- spill tier (serve/mem.py) ----------------------------------------
+    def _spill_block(self, key: bytes, block: int) -> None:
+        """Spill-write seam: copy the evicted keyed block's exact
+        device bytes into the host store BEFORE the arena reclaims the
+        physical block.  An injected ``serve.spill`` fault here skips
+        the spill — the block dies exactly as it did before the spill
+        tier existed (a prefix-cache miss later, never a changed
+        stream)."""
+        from .. import faults
+        try:
+            faults.fire("serve.spill", op="spill", block=block)
+        except (RuntimeError, OSError) as e:
+            if self.on_spill_fault is not None:
+                self.on_spill_fault("spill", e)
+            return
+        self.spill.put(key, mem.read_block(self.caches,
+                                           self.draft_caches, block))
+        if self.on_spill is not None:
+            self.on_spill(1)
+
+    def _stage_restore(self, key: bytes) -> Optional[Tuple[int, dict]]:
+        """Prefetch-read seam: claim an available physical block — a
+        free one, else by evicting the coldest refcount-0 LRU block
+        (which itself spills: a SWAP of a cold prefix for the hot one
+        being requested, never touching a referenced block) — and pop
+        the spilled payload for it.  Returns ``(block, payload)``, or
+        None on a store miss / no claimable block / injected fault
+        (all of which degrade to a plain prefix miss: the suffix
+        prefills normally).  Consuming free-or-LRU is exactly the
+        budget :meth:`probe_prefix`'s conservative feasibility math
+        (spilled = miss) already charged for this block's fresh
+        allocation, so admission accounting is unchanged.  The device
+        write is deferred to :meth:`_commit_restores` so an admission
+        restoring several blocks pays ONE batched write."""
+        if self.spill is None or key not in self.spill \
+                or not (self._free_blocks or self._lru):
+            return None
+        from .. import faults
+        try:
+            faults.fire("serve.spill", op="prefetch")
+        except (RuntimeError, OSError) as e:
+            if self.on_spill_fault is not None:
+                self.on_spill_fault("prefetch", e)
+            return None
+        payload = self.spill.get(key)
+        if (payload["draft"] is None) != (self.draft_caches is None):
+            return None  # arena shape changed under the store
+        self.spill.pop(key)
+        block = (self._free_blocks.pop() if self._free_blocks
+                 else self._evict_lru())
+        return block, payload
+
+    def _commit_restores(self, restores: List[Tuple[bytes, int, dict]]
+                         ) -> None:
+        """Land an admission's staged restores: one fancy-indexed
+        device write per arena leaf (see :func:`mem.write_blocks`),
+        then key the blocks resident.  The writes ride JAX's async
+        dispatch — the host enqueues the copies and returns;
+        ``wait_ms`` measures the host-side restore orchestration the
+        admission actually waited."""
+        t0 = time.perf_counter()
+        self.caches, self.draft_caches = mem.write_blocks(
+            self.caches, self.draft_caches,
+            [b for _, b, _ in restores], [p for _, _, p in restores])
+        for key, block, _ in restores:
+            self._key_of[block] = key
+            self._block_of[key] = block
+        if self.on_prefetch is not None:
+            self.on_prefetch(len(restores),
+                             (time.perf_counter() - t0) * 1e3)
 
     def alloc_blocks(self, n: int) -> Optional[List[int]]:
         """Claim ``n`` physical blocks (all-or-nothing), evicting LRU
@@ -279,18 +407,31 @@ class BlockPool:
                      ) -> Tuple[int, List[int]]:
         """Claim the longest resident chain of leading full prompt
         blocks: each matched block's refcount is bumped (reactivating
-        it out of the evictable LRU).  Returns (n_shared, block ids)."""
+        it out of the evictable LRU).  A key that misses residency but
+        hits the spill tier is PREFETCHED into a free physical block
+        and the chain continues — the restored block consumes exactly
+        the one free block the conservative :meth:`probe_prefix`
+        feasibility math already budgeted for its fresh allocation, so
+        admission accounting is unchanged.  Returns (n_shared, block
+        ids)."""
         if keys is None:
             keys = _chain_keys(prompt, limit_blocks, self.block_size)
         ids: List[int] = []
+        restores: List[Tuple[bytes, int, dict]] = []
         for key in keys[:limit_blocks]:
             block = self._block_of.get(key)
             if block is None:
-                break
+                staged = self._stage_restore(key)
+                if staged is None:
+                    break
+                block, payload = staged
+                restores.append((key, block, payload))
             if self.ref[block] == 0:
                 self._lru.pop(block, None)
             self.ref[block] += 1
             ids.append(block)
+        if restores:
+            self._commit_restores(restores)
         return len(ids), ids
 
     def register_prefix(self, prompt: np.ndarray, slot: int,

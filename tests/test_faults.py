@@ -1216,3 +1216,136 @@ class TestDisaggChaos:
                 if e.get("name") == "serve.handoff_in"]
         assert len(dump) == 2
         assert sum(e["shared"] for e in dump) >= 1
+
+
+# ---------------------------------------------------------------------------
+# serve.spill — the memory-hierarchy seams (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+class TestSpillChaos:
+    """Chaos contract for the KV spill tier: a fault at EITHER seam
+    (spill write, prefetch read) only degrades performance.  A dead
+    spill loses the host copy — the block dies unspilled, exactly the
+    pre-spill behavior; a dead prefetch is a spill miss — the prefix
+    re-prefills.  Streams stay bitwise identical to ``generate()``
+    either way, and every fired fault lands as a ``serve.spill``
+    'degraded' incident whose flight_ref resolves to a dump."""
+
+    def _engine(self, llama, store=None):
+        # 9 physical blocks: the 20-token churn requests below need 3+
+        # blocks each and run two-at-a-time, so the LRU must evict the
+        # cold shared-prefix blocks between the two prefix hits
+        return ServeEngine(llama, num_slots=2, max_len=32, block_size=8,
+                           num_blocks=9, spill_blocks=16,
+                           record_store=store)
+
+    @staticmethod
+    def _workload():
+        rng = np.random.RandomState(17)
+        shared = rng.randint(0, 256, (16,)).astype(np.int32)
+        tails = [rng.randint(0, 256, (4,)).astype(np.int32)
+                 for _ in range(2)]
+        churn = [rng.randint(0, 256, (20,)).astype(np.int32)
+                 for _ in range(4)]
+        return [np.concatenate([shared, t]) for t in tails], churn
+
+    @staticmethod
+    def _refs(llama, prompts):
+        return [llama.generate(p[None], max_new_tokens=6)[0, p.size:]
+                for p in prompts]
+
+    def _drive(self, eng, prompts, churn):
+        h1 = eng.submit(prompts[0], max_new_tokens=6)
+        eng.run_until_idle()
+        for q in churn:
+            eng.submit(q, max_new_tokens=4)
+        eng.run_until_idle()
+        h2 = eng.submit(prompts[1], max_new_tokens=6)
+        eng.run_until_idle()
+        return h1, h2
+
+    def _check_incidents(self, store, op):
+        """Every incident is a valid serve.spill degradation with a
+        resolvable flight_ref, and at least one is the seam under
+        test (``op``) — a faulted prefetch may trigger further spill
+        writes on the re-prefill path, which also fault and record."""
+        incidents = [e for e in obs_record.RunRecord(store).entries()
+                     if e["kind"] == "incident"]
+        assert incidents, "fired spill faults left no incident record"
+        for inc in incidents:
+            p = inc["payload"]
+            assert p["site"] == "serve.spill"
+            assert p["outcome"] == "degraded"
+            assert p["ref"] in ("op:spill", "op:prefetch")
+            ref = p["flight_ref"]
+            dump = os.path.join(os.path.dirname(store), ref)
+            assert os.path.exists(dump)
+        assert any(e["payload"]["ref"] == f"op:{op}" for e in incidents)
+        from tools.lint import audit
+        root = os.path.dirname(os.path.dirname(store))
+        assert audit.check_records_root(root) == []
+
+    def test_spill_write_fault_dies_unspilled(self, llama, tmp_path):
+        """Every spill write errors: nothing reaches the host store,
+        the re-hit re-prefills (a plain miss), streams are unchanged."""
+        store = str(tmp_path / "runs" / "records.jsonl")
+        prompts, churn = self._workload()
+        refs = self._refs(llama, prompts)
+        eng = self._engine(llama, store)
+        plan = FaultPlan([FaultSpec("serve.spill", "error")])
+        with faults.active(plan):
+            h1, h2 = self._drive(eng, prompts, churn)
+        assert plan.fire_count() > 0
+        np.testing.assert_array_equal(refs[0], np.asarray(h1.tokens))
+        np.testing.assert_array_equal(refs[1], np.asarray(h2.tokens))
+        # every copy was refused BEFORE it happened: store empty,
+        # metrics clean — this is bitwise the pre-spill engine
+        assert len(eng.pool.spill) == 0
+        assert eng.metrics.spilled_blocks == 0
+        assert eng.metrics.prefetch_hits == 0
+        assert_program_count(eng, (1, 1))
+        self._check_incidents(store, "spill")
+
+    def test_prefetch_fault_is_a_spill_miss(self, llama, tmp_path):
+        """Spills land fault-free, then the prefetch on the prefix
+        re-hit errors: the restore is abandoned BEFORE the payload is
+        popped (the store keeps it), the prefix re-prefills, and the
+        stream is unchanged."""
+        store = str(tmp_path / "runs" / "records.jsonl")
+        prompts, churn = self._workload()
+        refs = self._refs(llama, prompts)
+        eng = self._engine(llama, store)
+        h1 = eng.submit(prompts[0], max_new_tokens=6)
+        eng.run_until_idle()
+        for q in churn:
+            eng.submit(q, max_new_tokens=4)
+        eng.run_until_idle()
+        spilled = eng.metrics.spilled_blocks
+        assert spilled > 0 and len(eng.pool.spill) > 0
+        # now ONLY the prefetch seam can fire: churn is drained, and
+        # the next fires at this site are the re-hit's restores
+        plan = FaultPlan([FaultSpec("serve.spill", "error")])
+        with faults.active(plan):
+            h2 = eng.submit(prompts[1], max_new_tokens=6)
+            eng.run_until_idle()
+        assert plan.fire_count() > 0
+        np.testing.assert_array_equal(refs[0], np.asarray(h1.tokens))
+        np.testing.assert_array_equal(refs[1], np.asarray(h2.tokens))
+        # the miss re-prefilled: no restore was counted, and the store
+        # still holds every payload the fault-free churn spilled
+        assert eng.metrics.prefetch_hits == 0
+        assert_program_count(eng, (1, 1))
+        self._check_incidents(store, "prefetch")
+
+    def test_fault_free_spill_roundtrip_is_bitwise(self, llama):
+        """The no-fault control for the two tests above: same workload,
+        blocks spill AND restore, streams still bitwise generate()."""
+        prompts, churn = self._workload()
+        refs = self._refs(llama, prompts)
+        eng = self._engine(llama)
+        h1, h2 = self._drive(eng, prompts, churn)
+        np.testing.assert_array_equal(refs[0], np.asarray(h1.tokens))
+        np.testing.assert_array_equal(refs[1], np.asarray(h2.tokens))
+        assert eng.metrics.spilled_blocks > 0
+        assert eng.metrics.prefetch_hits > 0
+        assert_program_count(eng, (1, 1))

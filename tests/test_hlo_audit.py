@@ -5,7 +5,7 @@ lean.
 The invariants under test are the gates' contract:
   * the committed baselines under tools/lint/data/hlo/ (structure) and
     tools/lint/data/hlo/cost/ (cost) are CLEAN against a fresh lowering
-    of all seven flagship programs — so any future change that moves a
+    of all eight flagship programs — so any future change that moves a
     fusion, collective, donation, flop count, HBM byte, peak-memory
     byte or wire byte fails CI with a named finding until it is
     reviewed via ``--update-baselines``;
@@ -28,7 +28,7 @@ The invariants under test are the gates' contract:
     wire_bytes) roundtrips through the obs schema, and
     ``cost_features()`` returns the stable documented dict per program.
 
-Budget discipline: ONE module fixture lowers all seven programs
+Budget discipline: ONE module fixture lowers all eight programs
 (~15 s); every other test summarizes texts or diffs summaries in
 memory.  The defused and many-chunk train-step variants are the only
 extra compiles (tiny 1-block config — the cheap lowering).  Per-metric
@@ -51,7 +51,7 @@ REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 @pytest.fixture(scope="module")
 def texts():
-    """All seven flagship programs (incl. train_step_dp2_int8, the
+    """All eight flagship programs (incl. train_step_dp2_int8, the
     error-feedback int8-ring DP step) lowered ONCE — the file's whole
     compile budget (plus the two seeded train-step variants); tests
     share and never mutate it."""
@@ -143,6 +143,11 @@ def test_summaries_encode_the_flagship_invariants(summaries):
     # the disagg handoff gather reads the arena without consuming it
     assert summaries["handoff_gather"]["donated_outputs"] == 0
     assert summaries["handoff_gather"]["collectives"]["total"] == 0
+    # the int8-arena decode donates MORE outputs than f32 decode — the
+    # QuantKV arena flattens into codes + scale leaves, all in place
+    assert summaries["decode_int8"]["donated_outputs"] > \
+        summaries["decode"]["donated_outputs"]
+    assert summaries["decode_int8"]["collectives"]["total"] == 0
 
 
 def test_cost_summaries_encode_the_flagship_invariants(costs):
@@ -199,6 +204,16 @@ def test_cost_summaries_encode_the_flagship_invariants(costs):
     assert costs["train_step"]["donated_bytes"] > 0
     assert costs["decode"]["donated_bytes"] > 0
     assert costs["prefill_chunk"]["donated_bytes"] > 0
+    # ISSUE-17 acceptance, enforced in tier-1: the int8-KV decode moves
+    # FEWER HBM bytes than the f32-arena decode (committed baselines:
+    # 630,816 B vs 672,794 B at the tiny audited config, where weight
+    # traffic dominates — the gap IS the KV-arena traffic drop), and
+    # its int8 arena donates fewer bytes than the f32 arena it replaces
+    assert costs["decode_int8"]["hbm_bytes"] < \
+        costs["decode"]["hbm_bytes"]
+    assert 0 < costs["decode_int8"]["donated_bytes"] < \
+        costs["decode"]["donated_bytes"]
+    assert costs["decode_int8"]["roofline"] == "memory-bound"
 
 
 # ---------------------------------------------------------------------------

@@ -787,6 +787,25 @@ class TestRegistryRules:
         assert codes_of(out) == ["SGL007"]
         assert "serve.verfy" in out[0].message
 
+    def test_spill_site_is_registered(self):
+        """ISSUE 17: the spill tier's write/prefetch seam is a real
+        registry entry — plans/chaos tests naming it lint clean, typos
+        fire."""
+        out = lint("""
+            from singa_tpu import faults
+
+            faults.fire("serve.spill", op="spill", block=3)
+            faults.fire("serve.spill", op="prefetch")
+        """, "SGL007")
+        assert out == []
+        out = lint("""
+            from singa_tpu import faults
+
+            faults.fire("serve.spil", op="prefetch")
+        """, "SGL007")
+        assert codes_of(out) == ["SGL007"]
+        assert "serve.spil" in out[0].message
+
     def test_typoed_disagg_site_fires(self):
         out = lint("""
             from singa_tpu import faults
@@ -856,13 +875,15 @@ class TestFlightSite:
 
     def test_registered_sites_are_clean(self):
         # injection sites AND the incident-only seams both validate
-        # (serve.verify: the ISSUE 13 speculative seam)
+        # (serve.verify: the ISSUE 13 speculative seam; serve.spill:
+        # the ISSUE 17 memory-hierarchy seam)
         out = lint("""
             class Engine:
                 def ok(self):
                     self.flight.dump("serve.prefill", "runs/incidents")
                     self.flight.dump("serve.verify", "runs/incidents")
                     self.flight.dump("serve.arena", "runs/incidents")
+                    self.flight.dump("serve.spill", "runs/incidents")
                     self._flight_dump("train.fatal", "msg")
         """, "SGL009")
         assert out == []
@@ -1292,14 +1313,15 @@ class Sneaky:
 def test_ci_gate_picks_up_conclint_with_no_stage_renumbering():
     """tools/ci_gate.sh stage 1 is the bare `python -m tools.lint`
     full audit, which now includes the conc thread-model gate — so
-    conclint rides in with NO stage renumbering (ISSUE 15 satellite):
-    the script still declares exactly stages 1/7..7/7 and its stage-1
-    command is still the bare invocation."""
+    conclint rides in with NO extra stage (ISSUE 15 satellite): the
+    script declares a contiguous ladder (1/8..8/8 since ISSUE 17's
+    spill-smoke stage) and its stage-1 command is still the bare
+    invocation."""
     sh = open(os.path.join(REPO, "tools", "ci_gate.sh")).read()
-    for n in range(1, 8):
-        assert f"stage {n}/7" in sh, f"stage {n}/7 vanished/renumbered"
-    assert "stage 8" not in sh
-    stage1 = sh.split("stage 2/7")[0]
+    for n in range(1, 9):
+        assert f"stage {n}/8" in sh, f"stage {n}/8 vanished/renumbered"
+    assert "stage 9" not in sh
+    stage1 = sh.split("stage 2/8")[0]
     assert "python -m tools.lint || exit 10" in stage1
     # and the bare invocation really runs the conc gate (CLI contract)
     from tools.lint.__main__ import _AUDIT_MODES

@@ -7,11 +7,13 @@
 #
 #   stage 1  full audit   `python -m tools.lint`            exit 10
 #            (static SGL rules + HLO structure gate + cost gate over
-#             the SEVEN flagship programs — train_step, train_step_dp2,
+#             the EIGHT flagship programs — train_step, train_step_dp2,
 #             train_step_dp2_int8 (the int8-ring wire-bytes win,
 #             COST005-gated vs the f32 DP baseline), prefill_chunk,
-#             decode, verify (the speculative verify-k round), and
-#             handoff_gather (the disagg tier's KV handoff source) —
+#             decode, verify (the speculative verify-k round),
+#             handoff_gather (the disagg tier's KV handoff source), and
+#             decode_int8 (the int8-KV-arena decode, COST003-gated
+#             HBM-traffic drop vs the f32 decode) —
 #             one shared lowering, tools/lint/{rules,hlo,cost}.py)
 #   stage 2  records      `python -m tools.lint --records`  exit 11
 #            (telemetry/record store validation incl. the extended
@@ -29,7 +31,13 @@
 #            exit 14 (self-speculation verify-k streams asserted
 #             IDENTICAL to generate() and a plain engine, accept rate
 #             asserted 1.0 — the speculative decode path end to end)
-#   stage 6  autotune     `python -m tools.autotune smoke` + the
+#   stage 6  spill smoke  `python -m tools.loadgen --spill-smoke`
+#            exit 16 (a shrunk arena under churn spills shared-prefix
+#             blocks to host RAM, a re-hit restores them, and both
+#             streams are asserted IDENTICAL to generate() — the KV
+#             spill/prefetch tier end to end, spill + restore counters
+#             asserted nonzero)
+#   stage 7  autotune     `python -m tools.autotune smoke` + the
 #            table-resolved consumers, exit 15
 #            (committed best.json + autotune_sweep records validate —
 #             incl. the stale-schema_version guard — then a real
@@ -43,7 +51,7 @@
 #             decode/prefill ratio band, achieved-fraction sanity —
 #             and `obsq diff perf_attr --assert-last` tripwires the
 #             committed record trajectory)
-#   stage 7  tier-1 tests  the ROADMAP.md tier-1 command     exit 20
+#   stage 8  tier-1 tests  the ROADMAP.md tier-1 command     exit 20
 #
 # Exit 0 = every stage green.  Intentional compiled-program changes are
 # re-baselined first via `python -m tools.lint --hlo --update-baselines`
@@ -51,37 +59,40 @@
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
-echo "== ci_gate stage 1/7: full audit (static + HLO structure + cost) =="
+echo "== ci_gate stage 1/8: full audit (static + HLO structure + cost) =="
 JAX_PLATFORMS=cpu python -m tools.lint || exit 10
 
-echo "== ci_gate stage 2/7: record validation =="
+echo "== ci_gate stage 2/8: record validation =="
 JAX_PLATFORMS=cpu python -m tools.lint --records || exit 11
 
-echo "== ci_gate stage 3/7: obsq SLO smoke (trace-derived vs committed fixture) =="
+echo "== ci_gate stage 3/8: obsq SLO smoke (trace-derived vs committed fixture) =="
 JAX_PLATFORMS=cpu python -m tools.obsq slo --check \
     --records tests/data/obsq/records.jsonl \
     --events tests/data/obsq/events.jsonl || exit 12
 
-echo "== ci_gate stage 4/7: disagg smoke (1:1 tier streams == single engine) =="
+echo "== ci_gate stage 4/8: disagg smoke (1:1 tier streams == single engine) =="
 JAX_PLATFORMS=cpu python -m tools.loadgen --disagg-smoke || exit 13
 
-echo "== ci_gate stage 5/7: spec smoke (self-speculation streams == generate()) =="
+echo "== ci_gate stage 5/8: spec smoke (self-speculation streams == generate()) =="
 JAX_PLATFORMS=cpu python -m tools.loadgen --spec-smoke || exit 14
 
-echo "== ci_gate stage 6/7: autotune smoke (sweep -> fit -> table -> consumers) =="
+echo "== ci_gate stage 6/8: spill smoke (spill/restore streams == generate()) =="
+JAX_PLATFORMS=cpu python -m tools.loadgen --spill-smoke || exit 16
+
+echo "== ci_gate stage 7/8: autotune smoke (sweep -> fit -> table -> consumers) =="
 JAX_PLATFORMS=cpu python -m tools.autotune smoke || exit 15
 JAX_PLATFORMS=cpu python -m tools.loadgen --requests 6 --rate 50 \
     --no-record || exit 15
 rm -f /tmp/_perf_attr.json
 JAX_PLATFORMS=cpu python bench.py --serve --no-record \
     --perf-attr /tmp/_perf_attr.json || exit 15
-echo "== ci_gate stage 6/7 (cont.): runtime-attribution sentinel (PERF00x) =="
+echo "== ci_gate stage 7/8 (cont.): runtime-attribution sentinel (PERF00x) =="
 JAX_PLATFORMS=cpu python -m tools.lint --perf /tmp/_perf_attr.json \
     || exit 15
 JAX_PLATFORMS=cpu python -m tools.obsq diff perf_attr \
     --assert-last "attributed_s<=+300%" || exit 15
 
-echo "== ci_gate stage 7/7: tier-1 test suite (ROADMAP.md budget) =="
+echo "== ci_gate stage 8/8: tier-1 test suite (ROADMAP.md budget) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

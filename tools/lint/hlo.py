@@ -79,10 +79,15 @@ BASELINE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #: is the engine's optional program for the disaggregated tier's KV
 #: handoff source (one slot's dense per-layer view through its
 #: block-table row; no donation by design, so a failed handoff leaves
-#: the source arena valid).
+#: the source arena valid); decode_int8 is the decode step over an
+#: int8 KV arena (serve/mem.py: QuantKV block pools, quantize-on-
+#: scatter / dequantize-on-gather inside the paged primitives) —
+#: its committed COST003 hbm_bytes baseline proves (and permanently
+#: gates) the KV-traffic drop vs decode's f32 arena that is the whole
+#: point of the int8 tier.
 FLAGSHIP_PROGRAMS = ("train_step", "train_step_dp2",
                      "train_step_dp2_int8", "prefill_chunk", "decode",
-                     "verify", "handoff_gather")
+                     "verify", "handoff_gather", "decode_int8")
 
 #: summary format version — bump on incompatible metric changes; a
 #: baseline with another version fails the gate (HLO001) instead of
@@ -625,16 +630,19 @@ def lower_train_step(dp: bool = False, fused_loss: bool = True,
         parallel.set_mesh(saved_mesh)
 
 
-def _lower_serve_programs(want_verify: bool = True) -> Dict[str, str]:
+def _lower_serve_programs(want_verify: bool = True,
+                          want_int8: bool = True) -> Dict[str, str]:
     """Optimized-HLO texts of the serve engine's exactly-two programs
     plus the optional handoff gather (tiny Llama, 2 slots) via
     ``ServeEngine.lower_programs()`` — and, from a SECOND, speculative
     engine (self-speculation draft at spec_k=2), the ``verify``
-    program.  The plain engine stays the source of the
+    program, and from a THIRD engine with ``kv_dtype="int8"``, the
+    ``decode_int8`` program.  The plain engine stays the source of the
     prefill/decode/handoff baselines (a spec engine's prefill also
-    writes the draft arena, which would be a different audited
-    module), and only verify is compiled from the spec engine, so each
-    flagship program is still lowered exactly once."""
+    writes the draft arena, and an int8 engine's programs carry QuantKV
+    arena leaves — different audited modules), and each extra engine
+    contributes exactly its one extra flagship program, so each is
+    still lowered exactly once."""
     _ensure_cpu_backend()
     import numpy as np
     from singa_tpu import models, tensor
@@ -657,6 +665,12 @@ def _lower_serve_programs(want_verify: bool = True) -> Dict[str, str]:
         lowered = spec_eng.lower_programs(names=("verify",))
         texts["verify"] = lowered["verify"].compile().as_text()
         assert spec_eng.spec_compiled_counts() == (0, 0, 0, 0)
+    if want_int8:
+        q_eng = ServeEngine(m, num_slots=2, max_len=16, block_size=8,
+                            kv_dtype="int8")
+        lowered = q_eng.lower_programs(names=("decode",))
+        texts["decode_int8"] = lowered["decode"].compile().as_text()
+        assert_program_count(q_eng, (0, 0))
     return texts
 
 
@@ -678,9 +692,12 @@ def lower_flagship_texts(programs: Optional[Iterable[str]] = None
     if "train_step_dp2_int8" in wanted:
         texts["train_step_dp2_int8"] = lower_train_step(
             compression="int8_ring")
-    serve_names = ("prefill_chunk", "decode", "verify", "handoff_gather")
+    serve_names = ("prefill_chunk", "decode", "verify", "handoff_gather",
+                   "decode_int8")
     if any(name in wanted for name in serve_names):
-        serve = _lower_serve_programs(want_verify="verify" in wanted)
+        serve = _lower_serve_programs(
+            want_verify="verify" in wanted,
+            want_int8="decode_int8" in wanted)
         for name in serve_names:
             if name in wanted:
                 texts[name] = serve[name]

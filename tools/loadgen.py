@@ -184,6 +184,14 @@ def run_load(engine, workload: List[_Arrival], *,
         payload["accept_rate"] = round(snap["accept_rate"], 4)
         payload["tokens_per_dispatch"] = round(
             snap["tokens_per_dispatch"] or 0.0, 3)
+    pool = getattr(engine, "pool", None)
+    if pool is not None and getattr(pool, "spill", None) is not None:
+        # spill-tier engine: the trio joins the headline as a unit
+        # (schema all-or-nothing contract, _SERVE_SPILL_FIELDS)
+        payload["spilled_blocks"] = int(snap.get("spilled_blocks", 0))
+        payload["prefetch_hits"] = int(snap.get("prefetch_hits", 0))
+        payload["prefetch_wait_ms"] = round(
+            float(snap.get("prefetch_wait_ms", 0.0)), 3)
     payload["detail"] = {
         "wall_s": round(wall, 3),
         "generated_tokens": tokens,
@@ -474,6 +482,63 @@ def spec_smoke() -> int:
     return 0
 
 
+def spill_smoke() -> int:
+    """The CI gate's spill-tier stage: a deliberately shrunk arena
+    (num_blocks=9) with a host spill store serves a shared-prefix
+    request, churns the arena until the cold prefix blocks are evicted
+    to the spill tier, then re-hits the prefix so the blocks are
+    restored.  Asserts both shared-prefix streams are IDENTICAL to
+    ``generate()``, that blocks actually spilled, and that the prefix
+    re-hit was served from the spill store — one cheap command
+    (``python -m tools.loadgen --spill-smoke``)."""
+    from singa_tpu.serve import ServeEngine
+
+    m = _build_model()
+    rng = np.random.RandomState(17)
+    shared = rng.randint(0, m.cfg.vocab_size, (16,)).astype(np.int32)
+    tails = [rng.randint(0, m.cfg.vocab_size, (4,)).astype(np.int32)
+             for _ in range(2)]
+    prompts = [np.concatenate([shared, t]) for t in tails]
+    refs = [list(map(int, m.generate(p[None], max_new_tokens=6)
+                     [0, p.size:])) for p in prompts]
+    # shrunk arena: the churn requests below need 3+ blocks each and
+    # run two-at-a-time, so with only 9 physical blocks the LRU must
+    # evict the first request's cold shared-prefix blocks — into the
+    # spill store instead of oblivion
+    eng = ServeEngine(m, num_slots=2, max_len=32, block_size=8,
+                      num_blocks=9, spill_blocks=16)
+    h1 = eng.submit(prompts[0], max_new_tokens=6)
+    eng.run_until_idle()
+    for _ in range(4):
+        q = rng.randint(0, m.cfg.vocab_size, (20,)).astype(np.int32)
+        eng.submit(q, max_new_tokens=4)
+    eng.run_until_idle()
+    # prefix re-hit: the shared blocks come back from the spill store
+    h2 = eng.submit(prompts[1], max_new_tokens=6)
+    eng.run_until_idle()
+    got = [h1.tokens, h2.tokens]
+    if got != refs:
+        for i, (a, b) in enumerate(zip(refs, got)):
+            if a != b:
+                print(f"spill-smoke: FAIL — request {i} diverged: "
+                      f"generate={a} spill={b}", file=sys.stderr)
+        return 1
+    snap = eng.metrics.snapshot()
+    if snap["spilled_blocks"] < 1:
+        print("spill-smoke: FAIL — the shrunk arena never spilled a "
+              "block (arena sizing drifted?)", file=sys.stderr)
+        return 1
+    if snap["prefetch_hits"] < 1:
+        print("spill-smoke: FAIL — blocks spilled but no prefix re-hit "
+              "was served from the spill store", file=sys.stderr)
+        return 1
+    print(f"spill-smoke: OK — streams identical to generate() through "
+          f"a 9-block arena, {snap['spilled_blocks']} blocks spilled, "
+          f"{snap['prefetch_hits']} restored "
+          f"({snap['prefetch_wait_ms']:.1f} ms total prefetch wait)")
+    return 0
+
+
 def spec_compare(args, store, trials: int = 3) -> int:
     """``--spec-compare``: the SAME Poisson workload through a plain
     engine and a self-speculation verify-k engine (the PR 12-era
@@ -637,14 +702,35 @@ def main(argv=None) -> int:
                          "identical to generate() and a plain engine, "
                          "accept rate asserted 1.0; exits non-zero on "
                          "divergence")
+    ap.add_argument("--spill-smoke", action="store_true",
+                    help="CI smoke: shrunk arena + host spill store; "
+                         "streams asserted identical to a roomy "
+                         "engine, with blocks spilled AND a prefix "
+                         "re-hit served from the spill store; exits "
+                         "non-zero on divergence")
+    ap.add_argument("--kv-dtype", default=None,
+                    choices=("f32", "int8"),
+                    help="KV arena storage format (plain engine only; "
+                         "int8 = quantize-on-scatter blocks with "
+                         "per-position scales)")
+    ap.add_argument("--spill-blocks", type=int, default=None,
+                    help="host spill-store capacity in blocks (plain "
+                         "engine only; default: no spill tier)")
     args = ap.parse_args(argv)
 
     if args.disagg_smoke:
         return disagg_smoke()
     if args.spec_smoke:
         return spec_smoke()
+    if args.spill_smoke:
+        return spill_smoke()
     if args.spec_k < 0:
         ap.error("--spec-k must be >= 0")
+    if ((args.kv_dtype or args.spill_blocks) and
+            (args.prefill_workers or args.decode_workers or
+             args.ratio_sweep or args.spec_compare)):
+        ap.error("--kv-dtype/--spill-blocks drive a plain engine — "
+                 "not a tier, sweep, or --spec-compare")
 
     from singa_tpu.obs import record as obs_record
     from singa_tpu.serve import ServeEngine
@@ -736,7 +822,9 @@ def main(argv=None) -> int:
                           # engine-default budget of 2 is tuned for unit
                           # scenarios, not sustained injection
                           max_recoveries=100,
-                          record_store=store, **spec)
+                          record_store=store,
+                          kv_dtype=args.kv_dtype,
+                          spill_blocks=args.spill_blocks, **spec)
     wl = build_workload(args.requests, args.rate, args.seed,
                         prompt_lens=prompt_lens,
                         new_tokens=new_tokens,
