@@ -18,7 +18,10 @@ driver (``singa_tpu.autotune.sweep``) drives:
   "int8_ring")`` on the DP mesh, 0/1).
 * ``serve`` — ``num_slots`` / ``block_size`` (the paged-arena shape
   every ``ServeEngine`` compiles against), ``spec_k`` (the speculative
-  verify-k window; 0 = plain decode).
+  verify-k window; 0 = plain decode), ``spill_blocks`` (the host-RAM
+  KV spill store capacity; 0 = off), ``pool_ratio`` (the decode share
+  of the disaggregated worker budget the serve.net elastic policy
+  steers toward).
 
 Knob values are stored as NUMBERS in records and in the best-config
 table (booleans as 0/1) so the predictor's feature vector needs no
@@ -47,15 +50,20 @@ KNOBS: Dict[str, Dict[str, str]] = {
         "num_slots": "ServeEngine decode-batch slot count (arena rows)",
         "block_size": "paged-KV block size in tokens (arena granularity)",
         "spec_k": "speculative verify-k window (0 = plain decode)",
+        "spill_blocks": "host-RAM KV spill store capacity in blocks "
+                        "(ServeEngine spill_blocks; 0 = spill off)",
+        "pool_ratio": "decode share of the disaggregated worker budget "
+                      "(serve.net elastic target; 0.5 = even split)",
     },
 }
 
 #: the hand-carried constants each consumer falls back to when no
 #: best-config table is committed — today's behavior, preserved exactly
 #: (bench.py's CPU serve config; loadgen's CLI defaults; DP2 train).
-DEFAULTS: Dict[str, Dict[str, int]] = {
+DEFAULTS: Dict[str, Dict[str, float]] = {
     "train": {"batch": 4, "ce_chunk": 512, "int8_ring": 0},
-    "serve": {"num_slots": 8, "block_size": 8, "spec_k": 0},
+    "serve": {"num_slots": 8, "block_size": 8, "spec_k": 0,
+              "spill_blocks": 0, "pool_ratio": 0.5},
 }
 
 #: domain -> (objective payload field, direction).  The sweep driver

@@ -47,7 +47,7 @@ from .plan import KINDS, FaultPlan, FaultSpec, InjectedFault
 from .sites import SITES
 
 __all__ = ["KINDS", "SITES", "FaultPlan", "FaultSpec", "InjectedFault",
-           "fire", "corrupt", "active", "install", "uninstall",
+           "fire", "corrupt", "tear", "active", "install", "uninstall",
            "get_active"]
 
 _active: Optional[FaultPlan] = None
@@ -139,6 +139,26 @@ def corrupt(site: str, value: Any) -> Any:
     for _, spec in hits:
         _emit(site, spec.kind, plan.calls.get(site, 0))
     return _nanify(value)
+
+
+def tear(site: str, data: bytes) -> bytes:
+    """Torn-frame hook for byte payloads: returns ``data`` unchanged
+    unless a ``torn_frame`` spec fires, in which case only the first
+    half survives — the in-memory analogue of a ``torn_write`` for
+    transport seams, where the payload is bytes on a wire rather than
+    a file on disk.  Does not advance the site's call counter — by
+    convention a transport site calls :func:`fire` first (pre-send)
+    and ``tear`` on the same logical call's payload, mirroring the
+    :func:`corrupt` convention."""
+    plan = _active
+    if plan is None:
+        return data
+    hits = plan.match(site, ("torn_frame",), count=False)
+    if not hits:
+        return data
+    for _, spec in hits:
+        _emit(site, spec.kind, plan.calls.get(site, 0))
+    return data[:len(data) // 2]
 
 
 def _truncate(path: Optional[str]) -> None:

@@ -17,6 +17,10 @@ Fault kinds:
                    detection and the recovery paths behind it;
 * ``torn_write`` — truncate the file named by the site's ``path``
                    context (checkpoint torn-write simulation);
+* ``torn_frame`` — truncate the BYTES payload flowing past the site
+                   (wire torn-frame simulation, applied by
+                   :func:`faults.tear` — the in-memory analogue of
+                   ``torn_write`` for transport seams);
 * ``nan``        — replace float array values flowing past the site
                    with NaN (applied by :func:`faults.corrupt`).
 
@@ -41,7 +45,7 @@ from . import sites as _sites
 
 __all__ = ["KINDS", "InjectedFault", "FaultSpec", "FaultPlan"]
 
-KINDS = ("error", "hang", "torn_write", "nan")
+KINDS = ("error", "hang", "torn_write", "torn_frame", "nan")
 
 
 class InjectedFault(RuntimeError):

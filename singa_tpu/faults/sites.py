@@ -27,8 +27,10 @@ __all__ = ["SITES", "INCIDENT_SITES", "supported_kinds", "is_known",
 #: site name -> (description, kinds the site supports).
 #: ``error``/``hang`` are raised/slept by :func:`faults.fire` before the
 #: guarded operation dispatches; ``torn_write`` truncates the file named
-#: by the site's ``path`` context; ``nan`` is applied by
-#: :func:`faults.corrupt` to the value flowing PAST the site.
+#: by the site's ``path`` context; ``torn_frame`` truncates the bytes
+#: payload flowing past the site (applied by :func:`faults.tear`);
+#: ``nan`` is applied by :func:`faults.corrupt` to the value flowing
+#: PAST the site.
 SITES: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "device.execute": (
         "compiled step-graph dispatch (model step executor); nan "
@@ -103,6 +105,25 @@ SITES: Dict[str, Tuple[str, Tuple[str, ...]]] = {
         "before a prefill worker is chosen); an injected error "
         "surfaces to the submitter like a routing outage — requests "
         "already inside the tier are unaffected",
+        ("error", "hang")),
+    "serve.transport": (
+        "multi-process KV wire transport (serve/net: every framed "
+        "handoff payload, send and receive side); fires BEFORE the "
+        "bytes move, and torn_frame truncates the serialized package "
+        "mid-wire (faults.tear on the payload).  NEVER retried in "
+        "place: the codec's digest check rejects a torn frame before "
+        "inject, the supervisor treats any transport fault as a dead "
+        "handoff and re-routes the request via replay (prompt + tokens "
+        "so far on a surviving prefill worker), so streams stay "
+        "bitwise and a torn transfer is never injected",
+        ("error", "hang", "torn_frame")),
+    "serve.resize": (
+        "elastic pool resize (serve/net supervisor, before a grow "
+        "spawn or drain-shrink mutates the tier); an injected error "
+        "aborts THAT resize cleanly — the worker set, in-flight "
+        "streams and admission are untouched, and the autoscaler "
+        "simply re-evaluates on a later round (no quarantine: resizes "
+        "are idempotent tier-shape goals, not per-request work)",
         ("error", "hang")),
     "train.step": (
         "TrainRunner's retried step region (the shared injector the "

@@ -43,7 +43,7 @@ __all__ = ["SCHEMA_VERSION", "SchemaError", "require", "validate_entry",
            "validate_wire_byte_fields", "validate_flight_ref",
            "validate_serve_tier_fields", "validate_spec_fields",
            "validate_serve_spill_fields", "validate_serve_arena_fields",
-           "entry_key"]
+           "validate_serve_transport_fields", "entry_key"]
 
 #: bump when entry fields change incompatibly; validators dispatch on it
 SCHEMA_VERSION = 1
@@ -97,6 +97,17 @@ _SPEC_FIELDS = ("accept_rate", "tokens_per_dispatch")
 #: TTFT-on-re-hit claim the tier exists to make)
 _SERVE_SPILL_FIELDS = ("spilled_blocks", "prefetch_hits",
                        "prefetch_wait_ms")
+
+#: the multi-process transport trio (tools/loadgen.py --procs driving a
+#: serve.net ProcRouter): KV bytes the handoff wire actually carried,
+#: the p99 serialize+deserialize cost per handoff, and how many elastic
+#: pool resizes the run performed.  OPTIONAL on serve_load payloads —
+#: an in-process tier has no wire — but a record carrying ANY of them
+#: must carry ALL, numeric (a multi-process tokens/s claim without its
+#: wire-cost evidence cannot support the handoff-over-sockets story;
+#: see docs/serving.md, "Multi-process serving")
+_SERVE_TRANSPORT_FIELDS = ("handoff_wire_bytes", "handoff_ser_ms_p99",
+                           "resizes")
 
 #: the KV-arena memory-hierarchy compare (bench.py --serve
 #: --arena-compare): peak measured concurrency of an f32 paged arena
@@ -351,13 +362,15 @@ def validate_serve_load_payload(payload: Any,
     shed/rejected counts went missing would let 'survived the chaos
     run' masquerade as 'served every request'.  The optional
     disaggregated-tier pool fields (``_SERVE_TIER_FIELDS``), the
-    optional speculative-decoding pair (``_SPEC_FIELDS``) and the
-    optional KV spill-tier trio (``_SERVE_SPILL_FIELDS``) are linted
-    whenever any of them appear."""
+    optional speculative-decoding pair (``_SPEC_FIELDS``), the optional
+    KV spill-tier trio (``_SERVE_SPILL_FIELDS``) and the optional
+    multi-process transport trio (``_SERVE_TRANSPORT_FIELDS``) are
+    linted whenever any of them appear."""
     _require_numeric_fields(payload, _SERVE_LOAD_FIELDS, ctx)
     validate_serve_tier_fields(payload, ctx)
     validate_spec_fields(payload, ctx)
     validate_serve_spill_fields(payload, ctx)
+    validate_serve_transport_fields(payload, ctx)
 
 
 def validate_serve_spill_fields(payload: Any,
@@ -371,6 +384,20 @@ def validate_serve_spill_fields(payload: Any,
         return
     if any(f in payload for f in _SERVE_SPILL_FIELDS):
         _require_numeric_fields(payload, _SERVE_SPILL_FIELDS, ctx)
+
+
+def validate_serve_transport_fields(payload: Any,
+                                    ctx: str = "payload") -> None:
+    """The optional multi-process transport trio: a payload carrying
+    ANY of ``_SERVE_TRANSPORT_FIELDS`` must carry all three, numeric —
+    a multi-process throughput point whose wire-byte or serialization
+    evidence went missing cannot support the KV-handoff-over-sockets
+    claim the transport exists to make (see docs/serving.md,
+    "Multi-process serving")."""
+    if not isinstance(payload, dict):
+        return
+    if any(f in payload for f in _SERVE_TRANSPORT_FIELDS):
+        _require_numeric_fields(payload, _SERVE_TRANSPORT_FIELDS, ctx)
 
 
 def validate_spec_fields(payload: Any, ctx: str = "payload") -> None:

@@ -36,6 +36,13 @@ whole serving lifetime runs through exactly two compiled XLA programs.
   set of compiled programs) behind an SLO-aware :class:`Router` with
   per-tenant quotas, KV block handoff between arenas, and worker-death
   re-routing with bitwise-identical streams.
+* :mod:`~singa_tpu.serve.net` — multi-process disaggregated serving
+  (ISSUE 18): the same tier with each worker a ``ServeEngine`` in its
+  own OS process behind a framed local-socket RPC, KV handoff over a
+  versioned digest-checked wire codec (a torn transfer is never
+  injected — it replays), and elastic grow/shrink of either pool at
+  runtime (:class:`ProcRouter` / :func:`build_proc_pools` /
+  :class:`ElasticPolicy`).
 
 See docs/serving.md for the architecture, the slot lifecycle and the
 backpressure semantics.
@@ -44,6 +51,8 @@ backpressure semantics.
 from .disagg import (QuotaExceeded, Router, SLOClass, Worker,
                      build_pools)
 from .engine import EngineClosed, ServeEngine, SharedPrograms
+from .net import (ElasticPolicy, ProcHandle, ProcRouter, WorkerDied,
+                  WorkerProc, build_proc_pools)
 from .scheduler import (EVICTED, FAILED, FINISHED, QUEUED, RUNNING,
                         QueueFull, RequestHandle, Scheduler)
 from .slots import BlockPool
@@ -52,4 +61,6 @@ __all__ = ["ServeEngine", "BlockPool", "Scheduler", "RequestHandle",
            "QueueFull", "EngineClosed", "SharedPrograms",
            "Router", "SLOClass", "QuotaExceeded", "Worker",
            "build_pools",
+           "ProcRouter", "ProcHandle", "WorkerProc", "WorkerDied",
+           "build_proc_pools", "ElasticPolicy",
            "QUEUED", "RUNNING", "FINISHED", "EVICTED", "FAILED"]

@@ -710,6 +710,46 @@ class ServeEngine:
             self.metrics.on_submit()
         return req.handle
 
+    def resubmit(self, prompt_ids, tokens, *, max_new_tokens: int,
+                 deadline_s: Optional[float] = None,
+                 eos_id: Optional[int] = None,
+                 trace_id: Optional[str] = None,
+                 ttft_s: Optional[float] = None) -> RequestHandle:
+        """Re-admit a request that was already in flight SOMEWHERE ELSE
+        (a drained or dead worker in the multi-process tier): the
+        replay re-route primitive across process boundaries.  The
+        request re-enters at the HEAD of the queue with its generated
+        ``tokens`` pre-installed, so the next admission re-prefills
+        prompt + tokens and greedy replay idempotence continues the
+        stream bit-identically — the same machinery ``withdraw()`` +
+        ``requeue_front()`` provide in-process, reconstructed here from
+        the supervisor's host mirror of the request.  ``ttft_s`` (the
+        original first-token latency, when one was already delivered)
+        is preserved so a re-route never *improves* a reported TTFT.
+        Not counted as a new submission in the run ledger — the request
+        was submitted once, on the worker that lost it."""
+        if self._closed:
+            raise EngineClosed("resubmit() on a closed engine")
+        if self._draining:
+            raise EngineClosed(
+                "engine is draining — new submissions are refused while "
+                "in-flight requests complete")
+        req = Request(prompt_ids, max_new_tokens, deadline_s, eos_id,
+                      None)
+        req.tokens = [int(t) for t in tokens]
+        req.trace_id = trace_id or f"{self.run_id}/r{req.rid}"
+        req.ttft_s = ttft_s
+        p = req.prompt.size
+        if p + req.max_new_tokens + self.spec_k > self.pool.max_len:
+            raise ValueError(
+                f"prompt ({p}) + max_new_tokens ({req.max_new_tokens}) "
+                f"exceeds max_len ({self.pool.max_len})")
+        with obs_trace.activate(req.trace_id):
+            self.sched.requeue_front([req])
+            self.flight.note("counter", "serve.resubmit", rid=req.rid,
+                             replayed=len(req.tokens))
+        return req.handle
+
     # -- the engine loop ---------------------------------------------------
     def step(self, *, decode: bool = True) -> int:
         """One continuous-batching tick: recovery (if requested by the

@@ -1,0 +1,37 @@
+"""singa_tpu.serve.net — multi-process disaggregated serving
+(ISSUE 18).
+
+The in-process tier (:mod:`~singa_tpu.serve.disagg`) with the workers
+moved into their own OS processes:
+
+* :mod:`~singa_tpu.serve.net.rpc` — framed request/response protocol
+  over local sockets; every frame carries the contextvar trace id, so
+  one request's timeline spans process boundaries.
+* :mod:`~singa_tpu.serve.net.codec` — versioned, length-prefixed,
+  digest-checked binary wire format for ``HandoffPackage`` (a torn
+  transfer is never injected; it replays instead).
+* :mod:`~singa_tpu.serve.net.procworker` — the worker-process main:
+  one ``ServeEngine`` per process, platform-pinned, deterministically
+  built, reporting compile/readiness over the control channel.
+* :mod:`~singa_tpu.serve.net.supervisor` — :func:`build_proc_pools`
+  (mirrors ``build_pools``) and :class:`ProcRouter` (mirrors
+  ``Router``), plus elastic grow/shrink of either pool at runtime.
+* :mod:`~singa_tpu.serve.net.elastic` — the debounced autoscaling
+  policy over SLO/backpressure signals and the committed
+  ``serve.pool_ratio`` autotune knob.
+
+See docs/serving.md ("Multi-process serving") for the architecture and
+the measurement caveats.
+"""
+
+from .codec import (TornFrame, WireError, decode_package,
+                    encode_package, probe_package)
+from .elastic import ElasticPolicy
+from .rpc import RPCError
+from .supervisor import (ProcHandle, ProcRouter, ProcTierMetrics,
+                         WorkerDied, WorkerProc, build_proc_pools)
+
+__all__ = ["ProcRouter", "ProcHandle", "ProcTierMetrics", "WorkerProc",
+           "WorkerDied", "build_proc_pools", "ElasticPolicy",
+           "RPCError", "WireError", "TornFrame", "encode_package",
+           "decode_package", "probe_package"]

@@ -1,0 +1,205 @@
+"""Versioned binary wire codec for :class:`HandoffPackage` (ISSUE 18).
+
+A cross-process handoff is the single-engine handoff with a socket in
+the middle: the source worker host-stages its ``handoff_gather`` output
+(`jax.device_get`), this codec serializes the package to one
+length-prefixed frame payload, and the destination worker deserializes
+and feeds the result to the existing ``inject_handoff`` path — the
+donated-scatter install code is shared with the in-process tier, so the
+wire adds representation, not new semantics.
+
+Frame layout (all integers big-endian)::
+
+    MAGIC "SGKV" | u8 version | u32 header_len | header JSON (utf-8)
+    | tensor bytes (C-order, concatenated in manifest order)
+    | blake2b-128 digest of every byte above
+
+The header carries the request's full host state (prompt, tokens so
+far, budget, remaining deadline, trace id) plus the package metadata
+(pos, n_blocks, prefix chain keys as hex) and a tensor manifest
+(dtype + shape per tensor, target KV pairs first, then draft pairs).
+
+**Torn transfers are never injected**: :func:`decode_package` verifies
+the trailing digest over the whole frame before it parses anything
+mutable, so a truncated or bit-flipped frame (crash mid-send, the
+``serve.transport`` chaos site's ``torn_frame`` kind) raises
+:class:`TornFrame` and the supervisor re-routes the request via replay
+(prompt + tokens so far re-prefill on a surviving worker — greedy
+replay idempotence keeps the stream bitwise, same machinery as
+worker death).
+
+The codec is deliberately dumb about device placement: it consumes and
+produces HOST numpy arrays (`encode_package` stages with
+``device_get``; inject's eager scatters accept numpy slices), so the
+bytes on the wire are platform-independent.  ``bfloat16`` round-trips
+through the ``ml_dtypes`` numpy extension jax registers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import struct
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..scheduler import Request
+from ..disagg.handoff import HandoffPackage
+
+__all__ = ["WireError", "TornFrame", "encode_package", "decode_package",
+           "probe_package", "WIRE_VERSION"]
+
+_MAGIC = b"SGKV"
+WIRE_VERSION = 1
+_DIGEST_BYTES = 16
+_HEAD = struct.Struct(">4sBI")   # magic, version, header_len
+
+
+class WireError(ValueError):
+    """Structurally invalid frame: bad magic, unknown version, or a
+    manifest that does not describe the payload.  Distinct from
+    :class:`TornFrame` so callers can tell 'wrong protocol' from
+    'right protocol, damaged in flight'."""
+
+
+class TornFrame(WireError):
+    """Digest mismatch: the frame was truncated or corrupted between
+    encode and decode.  The package MUST NOT be injected — the caller
+    re-routes the request via replay instead."""
+
+
+def _digest(data: bytes) -> bytes:
+    return hashlib.blake2b(data, digest_size=_DIGEST_BYTES).digest()
+
+
+def _host_pairs(pairs, what: str) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """device_get a list of (k, v) per-layer views as contiguous host
+    arrays, refusing non-array leaves (the int8 QuantKV arena keeps its
+    codes+scales structure out of this codec for now — residue)."""
+    import jax
+    staged = jax.device_get(list(pairs))
+    out = []
+    for i, pair in enumerate(staged):
+        if not (isinstance(pair, (tuple, list)) and len(pair) == 2):
+            raise WireError(
+                f"{what}[{i}] is not a (k, v) pair — the wire codec "
+                f"ships dense array views only (int8 QuantKV arenas "
+                f"are not wire-serializable yet)")
+        k, v = pair
+        if getattr(k, "dtype", None) is None or \
+                getattr(v, "dtype", None) is None:
+            raise WireError(f"{what}[{i}] leaves are not arrays")
+        out.append((np.ascontiguousarray(k), np.ascontiguousarray(v)))
+    return out
+
+
+def encode_package(pkg: HandoffPackage, *, src: Optional[str] = None
+                   ) -> bytes:
+    """Serialize ``pkg`` to one frame payload (see module docstring).
+    ``src`` overrides the package's source-worker tag (the supervisor
+    stamps the worker name it extracted from)."""
+    req = pkg.req
+    kv = _host_pairs(pkg.kv, "kv")
+    draft = (_host_pairs(pkg.draft_kv, "draft_kv")
+             if pkg.draft_kv is not None else [])
+    tensors: List[np.ndarray] = []
+    manifest: List[Tuple[str, List[int]]] = []
+    for k, v in kv + draft:
+        for t in (k, v):
+            tensors.append(t)
+            manifest.append((str(t.dtype), list(t.shape)))
+    deadline_rem = (req.deadline - time.monotonic()
+                    if req.deadline is not None else None)
+    header = {
+        "rid": req.rid,
+        "prompt": np.asarray(req.prompt).tolist(),
+        "tokens": list(req.tokens),
+        "max_new_tokens": req.max_new_tokens,
+        "deadline_rem_s": deadline_rem,
+        "eos_id": req.eos_id,
+        "trace": req.trace_id,
+        "ttft_s": req.ttft_s,
+        "pos": pkg.pos,
+        "n_blocks": pkg.n_blocks,
+        "prompt_keys": [k.hex() for k in pkg.prompt_keys],
+        "src": src if src is not None else pkg.src,
+        "n_kv": len(kv),
+        "n_draft": len(draft),
+        "tensors": manifest,
+    }
+    hj = json.dumps(header, separators=(",", ":")).encode()
+    parts = [_HEAD.pack(_MAGIC, WIRE_VERSION, len(hj)), hj]
+    parts.extend(t.tobytes() for t in tensors)
+    body = b"".join(parts)
+    return body + _digest(body)
+
+
+def decode_package(data: bytes) -> HandoffPackage:
+    """Parse a frame payload back into a :class:`HandoffPackage` with
+    host-numpy KV views, verifying the trailing digest FIRST — a torn
+    or corrupted frame raises :class:`TornFrame` before any request
+    state is constructed."""
+    if len(data) < _HEAD.size + _DIGEST_BYTES:
+        raise TornFrame(
+            f"frame too short ({len(data)} bytes) — truncated in flight")
+    if data[:4] != _MAGIC:
+        raise WireError(f"bad magic {data[:4]!r} (want {_MAGIC!r})")
+    body, tail = data[:-_DIGEST_BYTES], data[-_DIGEST_BYTES:]
+    if _digest(body) != tail:
+        raise TornFrame(
+            "frame digest mismatch — torn transfer, refusing to inject")
+    magic, version, hlen = _HEAD.unpack_from(data, 0)
+    if version != WIRE_VERSION:
+        raise WireError(f"unknown wire version {version} "
+                        f"(this build speaks {WIRE_VERSION})")
+    off = _HEAD.size
+    if off + hlen > len(body):
+        raise WireError("header length exceeds frame")
+    header = json.loads(body[off:off + hlen].decode())
+    off += hlen
+    tensors: List[np.ndarray] = []
+    for dtype_name, shape in header["tensors"]:
+        dt = np.dtype(dtype_name)
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        nbytes = n * dt.itemsize
+        if off + nbytes > len(body):
+            raise WireError("tensor manifest exceeds frame payload")
+        tensors.append(np.frombuffer(body, dtype=dt, count=n, offset=off)
+                       .reshape(shape))
+        off += nbytes
+    if off != len(body):
+        raise WireError(
+            f"{len(body) - off} trailing bytes after manifest tensors")
+    n_kv, n_draft = int(header["n_kv"]), int(header["n_draft"])
+    if len(tensors) != 2 * (n_kv + n_draft):
+        raise WireError("tensor count disagrees with layer counts")
+    pairs = [(tensors[2 * i], tensors[2 * i + 1])
+             for i in range(n_kv + n_draft)]
+    kv, draft = pairs[:n_kv], pairs[n_kv:]
+    req = Request(np.asarray(header["prompt"], np.int32),
+                  header["max_new_tokens"],
+                  header["deadline_rem_s"], header["eos_id"], None)
+    req.tokens = [int(t) for t in header["tokens"]]
+    req.trace_id = header.get("trace")
+    req.ttft_s = header.get("ttft_s")
+    return HandoffPackage(
+        req=req, kv=kv, pos=int(header["pos"]),
+        n_blocks=int(header["n_blocks"]),
+        prompt_keys=[bytes.fromhex(h) for h in header["prompt_keys"]],
+        src=header.get("src", ""),
+        draft_kv=draft if n_draft else None)
+
+
+def probe_package(prompt_ids, n_blocks: int,
+                  prompt_keys_hex: List[str]) -> HandoffPackage:
+    """A KV-less stand-in package for capacity probes: carries exactly
+    the fields ``can_accept_handoff`` reads (prompt, block count,
+    prefix chain keys), so a destination worker can answer 'would this
+    fit' without the source gathering or shipping a single KV byte.
+    Must never be passed to inject."""
+    req = Request(np.asarray(prompt_ids, np.int32), 1, None, None, None)
+    return HandoffPackage(
+        req=req, kv=[], pos=0, n_blocks=int(n_blocks),
+        prompt_keys=[bytes.fromhex(h) for h in prompt_keys_hex])

@@ -70,11 +70,15 @@ class TestKnobs:
         assert errs and "must be numeric" in errs[0]
 
     def test_registry_covers_the_advertised_knobs(self):
-        # the ISSUE-14 knob set, verbatim
+        # the ISSUE-14 knob set plus the ISSUE-17/18 serve additions
+        # (spill-tier sizing and the disagg decode share), verbatim
         assert sorted(at_knobs.KNOBS["train"]) == ["batch", "ce_chunk",
                                                    "int8_ring"]
         assert sorted(at_knobs.KNOBS["serve"]) == ["block_size",
-                                                   "num_slots", "spec_k"]
+                                                   "num_slots",
+                                                   "pool_ratio",
+                                                   "spec_k",
+                                                   "spill_blocks"]
 
 
 # ---------------------------------------------------------------------------

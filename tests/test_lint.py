@@ -806,6 +806,30 @@ class TestRegistryRules:
         assert codes_of(out) == ["SGL007"]
         assert "serve.spil" in out[0].message
 
+    def test_net_sites_are_registered(self):
+        """ISSUE 18: the multi-process tier's wire + elastic-resize
+        seams are real registry entries — and ``faults.tear`` (the
+        torn-frame injector) is scanned exactly like fire/corrupt."""
+        out = lint("""
+            from singa_tpu import faults
+
+            faults.fire("serve.transport", dir="send", frames=1)
+            faults.fire("serve.resize", prefill=2, decode=1)
+            wire = faults.tear("serve.transport", wire)
+        """, "SGL007")
+        assert out == []
+
+    def test_typoed_tear_site_fires(self):
+        """A torn-frame chaos plan naming an unregistered site would
+        tear nothing — the tear() spelling is linted too."""
+        out = lint("""
+            from singa_tpu import faults
+
+            wire = faults.tear("serve.transprot", wire)
+        """, "SGL007")
+        assert codes_of(out) == ["SGL007"]
+        assert "serve.transprot" in out[0].message
+
     def test_typoed_disagg_site_fires(self):
         out = lint("""
             from singa_tpu import faults
@@ -887,6 +911,25 @@ class TestFlightSite:
                     self._flight_dump("train.fatal", "msg")
         """, "SGL009")
         assert out == []
+
+    def test_net_dump_sites_are_clean_and_typos_fire(self):
+        """ISSUE 18: the multi-process tier's incident dumps (torn
+        transfers at serve.transport, drains at serve.resize) name
+        registered sites; typos fire."""
+        out = lint("""
+            class Supervisor:
+                def ok(self):
+                    self.flight.dump("serve.transport", "runs/incidents")
+                    self._flight_dump("serve.resize", "drain")
+        """, "SGL009")
+        assert out == []
+        out = lint("""
+            class Supervisor:
+                def boom(self):
+                    self._flight_dump("serve.trasport", "msg")
+        """, "SGL009")
+        assert codes_of(out) == ["SGL009"]
+        assert "serve.trasport" in out[0].message
 
     def test_unrelated_dump_calls_are_ignored(self):
         out = lint("""
@@ -1314,14 +1357,14 @@ def test_ci_gate_picks_up_conclint_with_no_stage_renumbering():
     """tools/ci_gate.sh stage 1 is the bare `python -m tools.lint`
     full audit, which now includes the conc thread-model gate — so
     conclint rides in with NO extra stage (ISSUE 15 satellite): the
-    script declares a contiguous ladder (1/8..8/8 since ISSUE 17's
-    spill-smoke stage) and its stage-1 command is still the bare
+    script declares a contiguous ladder (1/9..9/9 since ISSUE 18's
+    mp-smoke stage) and its stage-1 command is still the bare
     invocation."""
     sh = open(os.path.join(REPO, "tools", "ci_gate.sh")).read()
-    for n in range(1, 9):
-        assert f"stage {n}/8" in sh, f"stage {n}/8 vanished/renumbered"
-    assert "stage 9" not in sh
-    stage1 = sh.split("stage 2/8")[0]
+    for n in range(1, 10):
+        assert f"stage {n}/9" in sh, f"stage {n}/9 vanished/renumbered"
+    assert "stage 10" not in sh
+    stage1 = sh.split("stage 2/9")[0]
     assert "python -m tools.lint || exit 10" in stage1
     # and the bare invocation really runs the conc gate (CLI contract)
     from tools.lint.__main__ import _AUDIT_MODES

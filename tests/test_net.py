@@ -1,0 +1,500 @@
+"""Multi-process disaggregated serving (ISSUE 18, `serve/net`).
+
+Four layers, cheapest first:
+
+* **framing** — the RPC wire format round-trips headers + payloads
+  over a socketpair, stamps the contextvar trace id, and fails loudly
+  on torn reads (no processes, no jax programs);
+* **elastic policy** — grow/shrink decisions over a duck-typed fake
+  router (debounce, budget, committed-share steering);
+* **frozen records** — the committed multi-process ratio-sweep entries
+  in runs/records.jsonl carry the transport trio + procs/host_cores
+  provenance and hold the structural contract; REAL scaling with
+  process count is asserted only when the record's `host_cores` made
+  it physically possible (a 1-core box time-slices the workers — its
+  record says so instead of faking a win);
+* **live tier** — ONE module-scoped 3-process tier (tiny llama,
+  1 prefill + 2 decode — the ROADMAP item-7 budget guard) is reused
+  by every live test, in order: bitwise parity, torn-frame chaos,
+  resize-abort chaos, elastic drain under load, worker death.  The
+  full ratio sweep and the resize soak live in the slow lane.
+"""
+
+import json
+import os
+import socket
+import tempfile
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from singa_tpu import faults
+from singa_tpu.obs import record as obs_record
+from singa_tpu.obs import schema
+from singa_tpu.obs import trace as obs_trace
+from singa_tpu.serve.net import rpc
+from singa_tpu.serve.net.elastic import ElasticPolicy, target_decode_share
+
+
+# ---------------------------------------------------------------------------
+# RPC framing (no processes)
+# ---------------------------------------------------------------------------
+
+class TestFraming:
+    def test_round_trip_with_payload_and_trace(self):
+        a, b = socket.socketpair()
+        try:
+            with obs_trace.activate("tr-net-1"):
+                rpc.send_frame(a, {"op": "handoff"}, b"\x00\x01kv")
+            hdr, payload = rpc.recv_frame(b)
+            assert hdr["op"] == "handoff"
+            assert hdr["trace"] == "tr-net-1"
+            assert payload == b"\x00\x01kv"
+        finally:
+            a.close()
+            b.close()
+
+    def test_header_only_frame(self):
+        a, b = socket.socketpair()
+        try:
+            rpc.send_frame(a, {"op": "tick", "decode": True})
+            hdr, payload = rpc.recv_frame(b)
+            assert hdr == {"op": "tick", "decode": True}
+            assert payload == b""
+        finally:
+            a.close()
+            b.close()
+
+    def test_peer_hangup_mid_frame_is_loud(self):
+        a, b = socket.socketpair()
+        try:
+            # a well-formed length prefix promising bytes that never come
+            a.sendall(b"\x00\x00\x00\x08\x00\x00\x00\x00head")
+            a.close()
+            with pytest.raises(rpc.RPCError):
+                rpc.recv_frame(b)
+        finally:
+            b.close()
+
+    def test_oversized_length_prefix_is_refused(self):
+        a, b = socket.socketpair()
+        try:
+            import struct
+            a.sendall(struct.pack(">II", rpc.MAX_FRAME + 1, 0))
+            with pytest.raises(rpc.RPCError):
+                rpc.recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_torn_frame_plan_truncates_payload_bytes(self):
+        """The send side passes payloads through faults.tear — a
+        torn_frame spec halves the bytes while the frame itself stays
+        parseable, exactly what the codec digest must catch."""
+        a, b = socket.socketpair()
+        try:
+            plan = faults.FaultPlan.parse(
+                "serve.transport=torn_frame:at=1")
+            with faults.active(plan):
+                rpc.send_frame(a, {"op": "handoff"}, b"x" * 64)
+            hdr, payload = rpc.recv_frame(b)
+            assert hdr["op"] == "handoff"
+            assert payload == b"x" * 32
+        finally:
+            a.close()
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# elastic policy over a fake router (no processes)
+# ---------------------------------------------------------------------------
+
+class _FakeWorker:
+    def __init__(self, load=0):
+        self.alive = True
+        self.load = load
+
+
+class _FakeRouter:
+    def __init__(self, n_prefill, n_decode, *, pending=0, parked=0,
+                 loads=0):
+        self.prefill = [_FakeWorker(loads) for _ in range(n_prefill)]
+        self.decode = [_FakeWorker() for _ in range(n_decode)]
+        self.pending = pending
+        self.parked = parked
+        self.model_key = None
+
+
+class TestElasticPolicy:
+    def test_parked_prefills_grow_decode(self):
+        pol = ElasticPolicy(check_every=1, patience=1, max_total=4,
+                            decode_share=0.5)
+        r = _FakeRouter(1, 1, pending=3, parked=2)
+        assert pol.decide(r) == {"n_decode": 2}
+
+    def test_at_budget_trades_prefill_for_decode_below_share(self):
+        pol = ElasticPolicy(check_every=1, patience=1, max_total=4,
+                            decode_share=0.6)
+        r = _FakeRouter(3, 1, pending=3, parked=2)   # total at budget
+        assert pol.decide(r) == {"n_prefill": 2, "n_decode": 2}
+
+    def test_deep_prefill_queues_grow_prefill(self):
+        pol = ElasticPolicy(check_every=1, patience=1, max_total=4,
+                            decode_share=0.5)
+        r = _FakeRouter(1, 1, pending=5, loads=4)    # queued > 2*n_p
+        assert pol.decide(r) == {"n_prefill": 2}
+
+    def test_idle_shrinks_toward_the_committed_share(self):
+        pol = ElasticPolicy(check_every=1, patience=1, max_total=4,
+                            decode_share=0.5)
+        r = _FakeRouter(1, 2, pending=0)
+        assert pol.decide(r) == {"n_decode": 1}
+        r = _FakeRouter(2, 1, pending=0)
+        assert pol.decide(r) == {"n_prefill": 1}
+
+    def test_debounce_needs_patience_consecutive_checks(self):
+        pol = ElasticPolicy(check_every=1, patience=2, max_total=4,
+                            decode_share=0.5)
+        r = _FakeRouter(1, 1, pending=3, parked=1)
+        assert pol.decide(r) is None          # first sighting: wait
+        assert pol.decide(r) == {"n_decode": 2}
+        # the signal clearing resets the debounce
+        assert pol.decide(_FakeRouter(1, 1, pending=3)) is None
+        assert pol.decide(r) is None
+
+    def test_min_per_pool_is_a_floor(self):
+        pol = ElasticPolicy(check_every=1, patience=1, max_total=4,
+                            decode_share=0.5)
+        r = _FakeRouter(1, 1, pending=0)
+        assert pol.decide(r) is None          # nothing above the floor
+
+    def test_bad_budget_is_rejected(self):
+        with pytest.raises(ValueError):
+            ElasticPolicy(min_per_pool=0)
+        with pytest.raises(ValueError):
+            ElasticPolicy(min_per_pool=2, max_total=3)
+
+    def test_target_share_defaults_sanely(self):
+        assert 0.0 <= target_decode_share("no-such-model") <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# schema: the transport trio
+# ---------------------------------------------------------------------------
+
+def _base_serve_load():
+    return {f: 1 for f in schema._SERVE_LOAD_FIELDS}
+
+
+class TestTransportTrioSchema:
+    def test_absent_trio_is_valid(self):
+        schema.validate_serve_load_payload(_base_serve_load())
+
+    def test_full_trio_is_valid(self):
+        p = _base_serve_load()
+        p.update(handoff_wire_bytes=801421, handoff_ser_ms_p99=322.5,
+                 resizes=0)
+        schema.validate_serve_load_payload(p)
+
+    def test_partial_trio_is_rejected(self):
+        for f in schema._SERVE_TRANSPORT_FIELDS:
+            p = _base_serve_load()
+            p[f] = 1
+            with pytest.raises(schema.SchemaError):
+                schema.validate_serve_load_payload(p)
+
+    def test_non_numeric_trio_field_is_rejected(self):
+        p = _base_serve_load()
+        p.update(handoff_wire_bytes="many", handoff_ser_ms_p99=1.0,
+                 resizes=0)
+        with pytest.raises(schema.SchemaError):
+            schema.validate_serve_load_payload(p)
+
+
+# ---------------------------------------------------------------------------
+# obsq: per-process sink merge
+# ---------------------------------------------------------------------------
+
+class TestObsqSinkMerge:
+    def test_glob_merges_per_process_sinks_in_time_order(self, tmp_path):
+        from tools import obsq
+
+        sup = tmp_path / "ev.jsonl"
+        wrk = tmp_path / "ev.jsonl.d0-mp0"
+        sup.write_text(json.dumps(
+            {"t": 1.0, "kind": "counter", "name": "serve.route",
+             "trace": "q1"}) + "\n")
+        wrk.write_text(json.dumps(
+            {"t": 2.0, "kind": "counter", "name": "serve.token",
+             "trace": "q1"}) + "\n")
+        paths = obsq.expand_event_paths([str(tmp_path / "ev.jsonl*")])
+        assert sorted(paths) == sorted([str(sup), str(wrk)])
+        evs = obsq.load_events(*paths)
+        assert [e["name"] for e in evs] == ["serve.route", "serve.token"]
+        out = obsq.render_trace(evs, "q1")
+        assert "serve.route" in out and "serve.token" in out
+
+    def test_empty_glob_is_loud(self):
+        from tools import obsq
+        with pytest.raises(ValueError):
+            obsq.expand_event_paths(["/nonexistent/dir/ev.jsonl*"])
+
+    def test_literal_paths_pass_through(self):
+        from tools import obsq
+        assert obsq.expand_event_paths(["a.jsonl", "b.jsonl"]) == \
+            ["a.jsonl", "b.jsonl"]
+
+
+# ---------------------------------------------------------------------------
+# the committed multi-process sweep records (frozen data, tier-1)
+# ---------------------------------------------------------------------------
+
+def _mp_sweep_groups(store_path):
+    groups = {}
+    for e in obs_record.RunRecord(store_path).entries():
+        if e["kind"] != "serve_load":
+            continue
+        p = e.get("payload", {})
+        if p.get("mp_sweep_id"):
+            groups.setdefault(p["mp_sweep_id"], []).append(p)
+    return {k: v for k, v in groups.items() if len(v) >= 2}
+
+
+class TestCommittedMpSweep:
+    def test_committed_mp_sweep_holds_the_structural_contract(self):
+        """ISSUE-18 acceptance, the always-true half: every committed
+        multi-process sweep point completed its whole workload with
+        real bytes over the wire, carries the schema'd transport trio
+        and the procs/host_cores provenance, and the points share one
+        workload."""
+        groups = _mp_sweep_groups(os.path.join(REPO, "runs",
+                                               "records.jsonl"))
+        assert groups, ("no committed multi-process ratio-sweep "
+                        "records (tools/loadgen.py --procs "
+                        "--ratio-sweep)")
+        for pts in groups.values():
+            assert len({p["requests"] for p in pts}) == 1
+            for p in pts:
+                schema.validate_serve_load_payload(p)
+                assert p["completed"] == p["requests"], p
+                assert p["handoffs"] >= 1
+                assert p["handoff_wire_bytes"] > 0
+                assert p["handoff_ser_ms_p99"] > 0
+                assert p["procs"] == (p["prefill_workers"]
+                                      + p["decode_workers"])
+                assert p["host_cores"] >= 1
+                assert p["tokens_per_s"] > 0
+                # sweep_id stays absent: the in-process direction
+                # assertion (tests/test_disagg.py) must never adopt
+                # points measured across process boundaries
+                assert not p.get("sweep_id")
+
+    def test_scaling_is_asserted_only_where_cores_allow(self):
+        """The core-aware half: on a host with at least as many cores
+        as the largest tier, tokens/s must not DROP as processes are
+        added (that is what the wire buys); on a smaller host the
+        workers time-slice, so only a no-collapse band holds — the
+        record's own host_cores field decides which claim it can
+        support."""
+        groups = _mp_sweep_groups(os.path.join(REPO, "runs",
+                                               "records.jsonl"))
+        for pts in groups.values():
+            pts = sorted(pts, key=lambda p: p["procs"])
+            lo, hi = pts[0], pts[-1]
+            cores = min(p["host_cores"] for p in pts)
+            if cores >= hi["procs"]:
+                assert hi["tokens_per_s"] >= 0.9 * lo["tokens_per_s"], (
+                    f"{hi['procs']} procs on {cores} cores delivered "
+                    f"{hi['tokens_per_s']} tok/s vs {lo['tokens_per_s']} "
+                    f"at {lo['procs']} procs — pool size bought "
+                    f"nothing")
+            else:
+                # time-sliced: more processes may only pay overhead,
+                # but the tier must not collapse
+                assert hi["tokens_per_s"] >= lo["tokens_per_s"] / 8.0
+
+
+# ---------------------------------------------------------------------------
+# the live 3-process tier (module-scoped; ROADMAP item-7 budget guard)
+# ---------------------------------------------------------------------------
+
+_N_PROMPTS = 4
+_MAX_NEW = 6
+
+
+def _prompts(vocab):
+    rng = np.random.RandomState(23)
+    return [rng.randint(0, vocab, (int(n),)).astype(np.int32)
+            for n in (5, 9, 12, 7)][:_N_PROMPTS]
+
+
+@pytest.fixture(scope="module")
+def mp_tier():
+    """ONE spawn for every live test in this module: a 1 prefill + 2
+    decode process tier (3 child processes — the budget ceiling), a
+    single-engine reference stream set, and a record store the drain
+    test's incident lands in.  Tests run in definition order and the
+    destructive ones (drain, kill) come last."""
+    from singa_tpu.serve import ServeEngine
+    from tools.loadgen import _build_model, _build_proc_tier
+
+    m = _build_model()
+    prompts = _prompts(m.cfg.vocab_size)
+    eng = ServeEngine(m, num_slots=4, max_len=32, block_size=8)
+    ref = [eng.submit(p, max_new_tokens=_MAX_NEW) for p in prompts]
+    eng.run_until_idle()
+    ref_toks = [h.tokens for h in ref]
+    eng.close()
+
+    tmp = tempfile.mkdtemp(prefix="singa-net-test-")
+    store = os.path.join(tmp, "records.jsonl")
+    args = SimpleNamespace(num_slots=4, max_len=32, block_size=8,
+                           num_blocks=None, max_queue=None, spec_k=0,
+                           no_share=False)
+    tier = _build_proc_tier(1, 2, args, store)
+    try:
+        yield SimpleNamespace(tier=tier, prompts=prompts,
+                              ref_toks=ref_toks, store=store)
+    finally:
+        tier.close()
+
+
+def _serve_all(tier, prompts):
+    handles = [tier.submit(p, max_new_tokens=_MAX_NEW) for p in prompts]
+    tier.run_until_idle(max_steps=500)
+    return [h.tokens for h in handles]
+
+
+class TestLiveTier:
+    def test_streams_bitwise_identical_across_processes(self, mp_tier):
+        got = _serve_all(mp_tier.tier, mp_tier.prompts)
+        assert got == mp_tier.ref_toks
+        assert mp_tier.tier.metrics.handoffs >= 1
+        assert mp_tier.tier.metrics.wire_bytes > 0
+
+    def test_torn_frame_is_rejected_and_replayed_bitwise(self, mp_tier):
+        """Chaos: tear the first inject payload (supervisor-side
+        serve.transport fires recv-extract then send-inject per
+        handoff, so at=2 is the inject).  The codec digest must refuse
+        the torn package — it is NEVER injected — and the replay path
+        must finish every stream bitwise."""
+        m = mp_tier.tier.metrics
+        torn0, rer0 = m.torn_frames, m.reroutes
+        plan = faults.FaultPlan.parse("serve.transport=torn_frame:at=2")
+        with faults.active(plan):
+            got = _serve_all(mp_tier.tier, mp_tier.prompts)
+        assert got == mp_tier.ref_toks
+        assert m.torn_frames == torn0 + 1
+        assert m.reroutes >= rer0 + 1
+
+    def test_injected_resize_fault_aborts_atomically(self, mp_tier):
+        tier = mp_tier.tier
+        n_p, n_d = len(tier.prefill), len(tier.decode)
+        plan = faults.FaultPlan.parse("serve.resize=error:at=1")
+        with faults.active(plan):
+            assert tier.resize(n_decode=n_d + 1) is False
+        assert tier.metrics.resizes_aborted >= 1
+        assert (len(tier.prefill), len(tier.decode)) == (n_p, n_d)
+        assert tier.metrics.resizes == 0
+
+    def test_scale_down_under_load_drains_bitwise_with_incident(
+            self, mp_tier):
+        """ISSUE-18 acceptance: shrink the decode pool under load.
+        Every in-flight stream must complete bitwise (the drained
+        worker's requests replay), and the drain must commit an
+        incident record at site serve.resize whose flight_ref resolves
+        to a real dump."""
+        tier = mp_tier.tier
+        handles = [tier.submit(p, max_new_tokens=_MAX_NEW)
+                   for p in mp_tier.prompts]
+        for _ in range(3):                      # get streams in flight
+            tier.step()
+        assert tier.resize(n_decode=1) is True
+        tier.run_until_idle(max_steps=500)
+        assert [h.tokens for h in handles] == mp_tier.ref_toks
+        assert len(tier.decode) == 1
+        assert tier.metrics.resizes == 1
+        incidents = [e for e in
+                     obs_record.RunRecord(mp_tier.store).entries()
+                     if e["kind"] == "incident"
+                     and e["payload"].get("site") == "serve.resize"]
+        assert incidents, "drain committed no serve.resize incident"
+        ref = incidents[-1]["payload"].get("flight_ref")
+        assert ref, incidents[-1]["payload"]
+        dump = os.path.join(os.path.dirname(mp_tier.store), ref)
+        assert os.path.exists(dump), dump
+
+    def test_worker_death_mid_flight_replays_bitwise(self, mp_tier):
+        tier = mp_tier.tier
+        deaths0 = tier.metrics.worker_deaths
+        handles = [tier.submit(p, max_new_tokens=_MAX_NEW)
+                   for p in mp_tier.prompts]
+        for _ in range(3):
+            tier.step()
+        tier.decode[0].proc.kill()              # the last decode worker
+        tier.run_until_idle(max_steps=500)
+        assert [h.tokens for h in handles] == mp_tier.ref_toks
+        assert tier.metrics.worker_deaths == deaths0 + 1
+
+
+# ---------------------------------------------------------------------------
+# slow lane: the full mp ratio sweep + the elastic resize soak
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestMpSlowLane:
+    def test_live_mp_ratio_sweep_commits_structural_records(
+            self, tmp_path):
+        from tools import loadgen
+
+        store = str(tmp_path / "records.jsonl")
+        rc = loadgen.main(["--procs", "--ratio-sweep", "1:1,1:2",
+                           "--requests", "12", "--rate", "30",
+                           "--deadline", "30", "--store", store])
+        assert rc == 0
+        groups = _mp_sweep_groups(store)
+        assert len(groups) == 1
+        (pts,) = groups.values()
+        assert len(pts) == 2
+        for p in pts:
+            schema.validate_serve_load_payload(p)
+            assert p["completed"] == p["requests"]
+            assert p["handoff_wire_bytes"] > 0
+
+    def test_elastic_policy_resizes_a_live_tier_bitwise(self):
+        """Resize soak: an ElasticPolicy-driven tier under sustained
+        load grows the decode pool from backpressure and shrinks on
+        idle, with every stream bitwise identical to the single-engine
+        reference."""
+        from singa_tpu.serve import ServeEngine
+        from tools.loadgen import _build_model, _build_proc_tier
+
+        m = _build_model()
+        prompts = _prompts(m.cfg.vocab_size) * 3
+        eng = ServeEngine(m, num_slots=4, max_len=32, block_size=8,
+                          max_queue=32)
+        ref = [eng.submit(p, max_new_tokens=_MAX_NEW) for p in prompts]
+        eng.run_until_idle()
+        ref_toks = [h.tokens for h in ref]
+        eng.close()
+
+        args = SimpleNamespace(num_slots=2, max_len=32, block_size=8,
+                               num_blocks=None, max_queue=32, spec_k=0,
+                               no_share=False)
+        pol = ElasticPolicy(check_every=2, patience=1, max_total=3,
+                            decode_share=0.5)
+        tier = _build_proc_tier(1, 1, args, None, policy=pol)
+        try:
+            handles = [tier.submit(p, max_new_tokens=_MAX_NEW)
+                       for p in prompts]
+            tier.run_until_idle(max_steps=1000)
+            got = [h.tokens for h in handles]
+            assert got == ref_toks
+            # idle ticks after the burst let the shrink side fire too
+            for _ in range(8):
+                tier.step()
+        finally:
+            tier.close()

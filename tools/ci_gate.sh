@@ -37,7 +37,15 @@
 #             streams are asserted IDENTICAL to generate() — the KV
 #             spill/prefetch tier end to end, spill + restore counters
 #             asserted nonzero)
-#   stage 7  autotune     `python -m tools.autotune smoke` + the
+#   stage 7  mp smoke     `python -m tools.loadgen --mp-smoke`
+#            exit 17 (a 2-PROCESS 1:1 tier — each worker a ServeEngine
+#             in its own OS process behind the serve.net framed RPC —
+#             serves 6 requests with greedy streams asserted IDENTICAL
+#             to a single in-process engine, with at least one KV
+#             handoff over the digest-checked wire codec — process
+#             spawn, the wire transport, and donated-scatter injection
+#             end to end)
+#   stage 8  autotune     `python -m tools.autotune smoke` + the
 #            table-resolved consumers, exit 15
 #            (committed best.json + autotune_sweep records validate —
 #             incl. the stale-schema_version guard — then a real
@@ -51,7 +59,7 @@
 #             decode/prefill ratio band, achieved-fraction sanity —
 #             and `obsq diff perf_attr --assert-last` tripwires the
 #             committed record trajectory)
-#   stage 8  tier-1 tests  the ROADMAP.md tier-1 command     exit 20
+#   stage 9  tier-1 tests  the ROADMAP.md tier-1 command     exit 20
 #
 # Exit 0 = every stage green.  Intentional compiled-program changes are
 # re-baselined first via `python -m tools.lint --hlo --update-baselines`
@@ -59,40 +67,43 @@
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
-echo "== ci_gate stage 1/8: full audit (static + HLO structure + cost) =="
+echo "== ci_gate stage 1/9: full audit (static + HLO structure + cost) =="
 JAX_PLATFORMS=cpu python -m tools.lint || exit 10
 
-echo "== ci_gate stage 2/8: record validation =="
+echo "== ci_gate stage 2/9: record validation =="
 JAX_PLATFORMS=cpu python -m tools.lint --records || exit 11
 
-echo "== ci_gate stage 3/8: obsq SLO smoke (trace-derived vs committed fixture) =="
+echo "== ci_gate stage 3/9: obsq SLO smoke (trace-derived vs committed fixture) =="
 JAX_PLATFORMS=cpu python -m tools.obsq slo --check \
     --records tests/data/obsq/records.jsonl \
     --events tests/data/obsq/events.jsonl || exit 12
 
-echo "== ci_gate stage 4/8: disagg smoke (1:1 tier streams == single engine) =="
+echo "== ci_gate stage 4/9: disagg smoke (1:1 tier streams == single engine) =="
 JAX_PLATFORMS=cpu python -m tools.loadgen --disagg-smoke || exit 13
 
-echo "== ci_gate stage 5/8: spec smoke (self-speculation streams == generate()) =="
+echo "== ci_gate stage 5/9: spec smoke (self-speculation streams == generate()) =="
 JAX_PLATFORMS=cpu python -m tools.loadgen --spec-smoke || exit 14
 
-echo "== ci_gate stage 6/8: spill smoke (spill/restore streams == generate()) =="
+echo "== ci_gate stage 6/9: spill smoke (spill/restore streams == generate()) =="
 JAX_PLATFORMS=cpu python -m tools.loadgen --spill-smoke || exit 16
 
-echo "== ci_gate stage 7/8: autotune smoke (sweep -> fit -> table -> consumers) =="
+echo "== ci_gate stage 7/9: mp smoke (2-process tier streams == single engine) =="
+JAX_PLATFORMS=cpu python -m tools.loadgen --mp-smoke || exit 17
+
+echo "== ci_gate stage 8/9: autotune smoke (sweep -> fit -> table -> consumers) =="
 JAX_PLATFORMS=cpu python -m tools.autotune smoke || exit 15
 JAX_PLATFORMS=cpu python -m tools.loadgen --requests 6 --rate 50 \
     --no-record || exit 15
 rm -f /tmp/_perf_attr.json
 JAX_PLATFORMS=cpu python bench.py --serve --no-record \
     --perf-attr /tmp/_perf_attr.json || exit 15
-echo "== ci_gate stage 7/8 (cont.): runtime-attribution sentinel (PERF00x) =="
+echo "== ci_gate stage 8/9 (cont.): runtime-attribution sentinel (PERF00x) =="
 JAX_PLATFORMS=cpu python -m tools.lint --perf /tmp/_perf_attr.json \
     || exit 15
 JAX_PLATFORMS=cpu python -m tools.obsq diff perf_attr \
     --assert-last "attributed_s<=+300%" || exit 15
 
-echo "== ci_gate stage 8/8: tier-1 test suite (ROADMAP.md budget) =="
+echo "== ci_gate stage 9/9: tier-1 test suite (ROADMAP.md budget) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -9,7 +9,7 @@
 |        |                  | old disable=SGL004 suppressions fail loudly    |
 | SGL005 | wall-clock       | time.time() is banned (monotonic-only rule)    |
 | SGL006 | obs-kind         | record kinds are members of obs.schema._KINDS  |
-| SGL007 | fault-site       | faults.fire/corrupt sites exist in the registry|
+| SGL007 | fault-site       | faults.fire/corrupt/tear sites are registered  |
 | SGL008 | host-sync        | no device fetches in hot engine/runner loops   |
 | SGL009 | flight-site      | flight-recorder dump sites are registered names|
 
@@ -747,8 +747,9 @@ class FaultSiteRule(Rule):
     code = "SGL007"
     name = "fault-site"
     description = ("literal site names passed to faults.fire/"
-                   "faults.corrupt must exist in faults.sites.SITES — a "
-                   "typo'd site silently injects nothing")
+                   "faults.corrupt/faults.tear must exist in "
+                   "faults.sites.SITES — a typo'd site silently "
+                   "injects nothing")
 
     def check(self, tree: ast.Module, src: str,
               path: str) -> Iterable[Finding]:
@@ -756,7 +757,8 @@ class FaultSiteRule(Rule):
         imports = import_map(tree)
         for node in module_calls(tree):
             full = resolve(node.func, imports) or ""
-            if full not in ("faults.fire", "faults.corrupt"):
+            if full not in ("faults.fire", "faults.corrupt",
+                            "faults.tear"):
                 continue
             site = _call_arg(node, 0, "site")
             if site is None:

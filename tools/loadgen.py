@@ -25,6 +25,17 @@ shed when the offered rate exceeds capacity.
     python -m tools.loadgen --spec-compare --num-slots 1 --spec-k 7
     python -m tools.loadgen --spec-smoke       # CI: spec == generate()
 
+    # multi-process tier (ISSUE 18): every worker a ServeEngine in its
+    # own OS process behind the serve.net wire (framed RPC + digest-
+    # checked KV handoff codec); records stamp the transport trio,
+    # `procs` and `host_cores` (a 1-core box serializes the workers —
+    # the record says so instead of faking a scaling win), and
+    # `mp_sweep_id` (NOT sweep_id: the in-process ratio-direction
+    # assertion in tests/test_disagg.py must not adopt mp points)
+    python -m tools.loadgen --procs --prefill-workers 1 --decode-workers 2
+    python -m tools.loadgen --procs --ratio-sweep 2:1,1:2 --rate 40
+    python -m tools.loadgen --mp-smoke         # CI: mp tier == engine
+
 The run drives ``ServeEngine.step()`` directly (arrivals are submitted
 the tick their timestamp passes; ``QueueFull`` rejections count as
 overload outcomes, not errors) and reports SLO percentiles from the
@@ -264,7 +275,10 @@ def _attr_source_engine(target):
     for pool in (getattr(router, "decode", None),
                  getattr(router, "prefill", None)):
         if pool:
-            return pool[0].engine
+            # a ProcRouter's pools hold WorkerProc handles — the
+            # engines live in other processes, so there is nothing to
+            # attribute against here (each child keeps its own ledger)
+            return getattr(pool[0], "engine", None)
     return None
 
 
@@ -330,22 +344,29 @@ def _build_model():
 
 
 def _resolve_serve_knobs(args, model) -> dict:
-    """Fill ``args.num_slots`` / ``args.block_size`` from the committed
-    best-config table (``singa_tpu.autotune.table``) when the CLI left
-    them at their None defaults.  Precedence is the autotuner's
-    contract: an explicit flag always wins; else the table's entry for
-    this (model, platform); else the registry's hand-carried constants
-    (``autotune.knobs.DEFAULTS`` — the 8/8 pair this CLI shipped with,
-    ONE source of truth), announced loudly once."""
+    """Fill ``args.num_slots`` / ``args.block_size`` /
+    ``args.spill_blocks`` from the committed best-config table
+    (``singa_tpu.autotune.table``) when the CLI left them at their None
+    defaults.  Precedence is the autotuner's contract: an explicit flag
+    always wins; else the table's entry for this (model, platform);
+    else the registry's hand-carried constants
+    (``autotune.knobs.DEFAULTS`` — ONE source of truth), announced
+    loudly once.  The registry stores ``spill_blocks`` as a number with
+    0 = off; the engine constructor wants None for off, so 0 maps
+    back."""
     import jax
 
     from singa_tpu.autotune import table as autotune_table
 
     knobs = autotune_table.resolve(
         "serve", autotune_table.model_key(model), jax.default_backend(),
-        {"num_slots": args.num_slots, "block_size": args.block_size})
+        {"num_slots": args.num_slots, "block_size": args.block_size,
+         "spill_blocks": getattr(args, "spill_blocks", None)})
     args.num_slots = int(knobs["num_slots"])
     args.block_size = int(knobs["block_size"])
+    if getattr(args, "spill_blocks", None) is None:
+        spill = int(knobs.get("spill_blocks", 0) or 0)
+        args.spill_blocks = spill if spill > 0 else None
     return {"num_slots": args.num_slots,
             "block_size": args.block_size}
 
@@ -539,6 +560,89 @@ def spill_smoke() -> int:
     return 0
 
 
+def _build_proc_tier(n_prefill: int, n_decode: int, args, store,
+                     policy=None):
+    """A ProcRouter over N + M worker PROCESSES (ISSUE 18): each worker
+    re-builds this module's ``_build_model`` in its own interpreter
+    (deterministic — seed 0, same tiny config) and compiles its own
+    program set; KV handoffs travel the digest-checked wire codec
+    instead of a same-process device copy."""
+    from singa_tpu.serve import ProcRouter, build_proc_pools
+
+    pw, dw = build_proc_pools(
+        "tools.loadgen:_build_model", n_prefill, n_decode,
+        num_slots=args.num_slots, max_len=args.max_len,
+        block_size=args.block_size, num_blocks=args.num_blocks,
+        share_prefix=not args.no_share, max_queue=args.max_queue,
+        record_store=store, self_spec_k=args.spec_k)
+    return ProcRouter(pw, dw, record_store=store, policy=policy)
+
+
+def _stamp_mp(payload: dict, tier, n_procs: int) -> None:
+    """The multi-process provenance a ``--procs`` record carries: the
+    transport trio (schema ``_SERVE_TRANSPORT_FIELDS``), the worker
+    process count, and the host's core count — ``host_cores`` is what
+    lets a reader (and the frozen-record assertion in tests) judge
+    whether the tokens/s number COULD have scaled with processes, or
+    whether a 1-core box serialized them."""
+    payload.update(tier.transport_stats())
+    if tier.model_key:
+        payload["model"] = tier.model_key
+    payload["procs"] = int(n_procs)
+    payload["host_cores"] = int(os.cpu_count() or 1)
+
+
+def mp_smoke() -> int:
+    """The CI gate's multi-process stage: a 2-process 1:1 tier (each
+    worker a ServeEngine in its own OS process behind the serve.net
+    RPC) serves 6 requests with greedy streams asserted IDENTICAL to a
+    single in-process engine — spawn, framed RPC, the digest-checked KV
+    wire codec, and donated-scatter injection end-to-end as one cheap
+    command (``python -m tools.loadgen --mp-smoke``)."""
+    from singa_tpu.serve import ServeEngine
+
+    m = _build_model()
+    rng = np.random.RandomState(19)
+    prompts = [rng.randint(0, m.cfg.vocab_size, (int(n),)).astype(np.int32)
+               for n in (4, 6, 9, 12, 5, 10)]
+    eng = ServeEngine(m, num_slots=4, max_len=32, block_size=8)
+    ref = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.run_until_idle()
+    ref_toks = [h.tokens for h in ref]
+    eng.close()
+
+    class _Args:
+        num_slots, max_len, block_size = 4, 32, 8
+        num_blocks, max_queue, spec_k = None, None, 0
+        no_share = False
+
+    tier = _build_proc_tier(1, 1, _Args(), None)
+    try:
+        got = [tier.submit(p, max_new_tokens=6) for p in prompts]
+        tier.run_until_idle()
+        got_toks = [h.tokens for h in got]
+        handoffs = tier.metrics.handoffs
+        wire = tier.metrics.wire_bytes
+    finally:
+        tier.close()
+    if got_toks != ref_toks:
+        for i, (a, b) in enumerate(zip(ref_toks, got_toks)):
+            if a != b:
+                print(f"mp-smoke: FAIL — request {i} diverged across "
+                      f"the process boundary: engine={a} tier={b}",
+                      file=sys.stderr)
+        return 1
+    if handoffs < 1:
+        print("mp-smoke: FAIL — a 1:1 tier completed without a single "
+              "KV handoff (the wire path was never exercised)",
+              file=sys.stderr)
+        return 1
+    print(f"mp-smoke: OK — {len(prompts)} streams identical through a "
+          f"2-process 1:1 tier ({handoffs} KV handoffs, {wire} bytes "
+          f"over the wire)")
+    return 0
+
+
 def spec_compare(args, store, trials: int = 3) -> int:
     """``--spec-compare``: the SAME Poisson workload through a plain
     engine and a self-speculation verify-k engine (the PR 12-era
@@ -688,6 +792,21 @@ def main(argv=None) -> int:
                     help="CI smoke: 1:1 tier streams asserted "
                          "identical to a single engine (8 requests); "
                          "exits non-zero on divergence")
+    ap.add_argument("--procs", action="store_true",
+                    help="run the tier MULTI-PROCESS (serve.net): each "
+                         "worker a ServeEngine in its own OS process, "
+                         "KV handoffs over the digest-checked wire "
+                         "codec; records stamp the transport trio plus "
+                         "procs/host_cores provenance")
+    ap.add_argument("--elastic-max", type=int, default=0,
+                    help="with --procs: cap for an ElasticPolicy that "
+                         "grows/shrinks the pools at runtime from "
+                         "backpressure signals (0 = fixed pools)")
+    ap.add_argument("--mp-smoke", action="store_true",
+                    help="CI smoke: 2-process 1:1 tier streams "
+                         "asserted identical to a single in-process "
+                         "engine (6 requests, >=1 wire handoff); "
+                         "exits non-zero on divergence")
     ap.add_argument("--spec-k", type=int, default=0,
                     help="speculative decoding: propose/verify k "
                          "tokens per round through a self-speculation "
@@ -720,6 +839,8 @@ def main(argv=None) -> int:
 
     if args.disagg_smoke:
         return disagg_smoke()
+    if args.mp_smoke:
+        return mp_smoke()
     if args.spec_smoke:
         return spec_smoke()
     if args.spill_smoke:
@@ -728,9 +849,23 @@ def main(argv=None) -> int:
         ap.error("--spec-k must be >= 0")
     if ((args.kv_dtype or args.spill_blocks) and
             (args.prefill_workers or args.decode_workers or
-             args.ratio_sweep or args.spec_compare)):
+             args.ratio_sweep or args.spec_compare or args.procs)):
         ap.error("--kv-dtype/--spill-blocks drive a plain engine — "
                  "not a tier, sweep, or --spec-compare")
+    if args.procs and args.spec_compare:
+        ap.error("--spec-compare is an in-process A/B (interleaved "
+                 "trials on shared engines) — it has no --procs mode")
+    if args.procs and not (args.ratio_sweep or
+                           (args.prefill_workers and
+                            args.decode_workers)):
+        ap.error("--procs needs a tier: --prefill-workers/"
+                 "--decode-workers or --ratio-sweep")
+    if args.procs and args.tenant_quota is not None:
+        ap.error("--tenant-quota is the in-process Router's door — "
+                 "the multi-process tier has no per-tenant quota yet")
+    if args.elastic_max and not args.procs:
+        ap.error("--elastic-max resizes worker PROCESSES — it needs "
+                 "--procs")
 
     from singa_tpu.obs import record as obs_record
     from singa_tpu.serve import ServeEngine
@@ -751,6 +886,49 @@ def main(argv=None) -> int:
                        if t.strip())
     prompt_lens = tuple(int(t) for t in args.prompt_lens.split(",")
                         if t.strip())
+
+    if args.ratio_sweep and args.procs:
+        points = parse_ratios(args.ratio_sweep)
+        # no template sharing across process boundaries: every point
+        # spawns fresh workers that each compile their own program set
+        # (the per-point spawn+compile cost is the price of real
+        # process isolation, and it stays OUT of run_load's wall)
+        sweep_id = obs_record.new_run_id("mpsweep")
+        rows = []
+        for i, (n, mdec) in enumerate(points):
+            tier = _build_proc_tier(n, mdec, args, store)
+            try:
+                wl = build_workload(args.requests, args.rate, args.seed,
+                                    prompt_lens=prompt_lens,
+                                    new_tokens=new_tokens,
+                                    tenants=args.tenants,
+                                    shared_len=args.shared_prefix,
+                                    vocab=m.cfg.vocab_size)
+                payload = run_load(tier, wl, deadline_s=args.deadline)
+                _stamp_mp(payload, tier, n + mdec)
+            finally:
+                tier.close()
+            # mp_sweep_id, NOT sweep_id: the in-process ratio-direction
+            # assertion (tests/test_disagg.py) groups by sweep_id and
+            # must never adopt points measured across process
+            # boundaries on an unknown core budget
+            payload["mp_sweep_id"] = sweep_id
+            payload["mp_sweep_seq"] = i
+            rows.append((n, mdec, payload))
+            print(f"# mp ratio {n}:{mdec} ({n + mdec} procs, "
+                  f"{payload['host_cores']} cores)  "
+                  f"ttft_p99={payload['ttft_p99_ms']} ms  "
+                  f"tokens/s={payload['tokens_per_s']}  "
+                  f"handoffs={payload['handoffs']}  "
+                  f"wire_bytes={payload['handoff_wire_bytes']}",
+                  file=sys.stderr)
+            print(json.dumps(payload, indent=2))
+            if store is not None:
+                append_record(payload, store, prefix=f"mpload{i}")
+        if store is not None:
+            print(f"# {len(rows)} serve_load entries (mp sweep "
+                  f"{sweep_id}) appended to {store}", file=sys.stderr)
+        return 0
 
     if args.ratio_sweep:
         points = parse_ratios(args.ratio_sweep)
@@ -804,8 +982,17 @@ def main(argv=None) -> int:
         if args.prefill_workers < 1 or args.decode_workers < 1:
             ap.error("a tier needs --prefill-workers >= 1 AND "
                      "--decode-workers >= 1")
-        eng = _build_tier(m, args.prefill_workers, args.decode_workers,
-                          args, store)
+        if args.procs:
+            policy = None
+            if args.elastic_max:
+                from singa_tpu.serve import ElasticPolicy
+                policy = ElasticPolicy(max_total=args.elastic_max)
+            eng = _build_proc_tier(args.prefill_workers,
+                                   args.decode_workers, args, store,
+                                   policy=policy)
+        else:
+            eng = _build_tier(m, args.prefill_workers,
+                              args.decode_workers, args, store)
     else:
         if args.tenant_quota is not None:
             ap.error("--tenant-quota needs a tier "
@@ -837,12 +1024,21 @@ def main(argv=None) -> int:
     payload = run_load(eng, wl, deadline_s=args.deadline,
                        pass_tenant=args.tenant_quota is not None)
     obs_attr.uninstall()
+    if args.procs:
+        _stamp_mp(payload, eng,
+                  args.prefill_workers + args.decode_workers)
+        eng.close()
     print(json.dumps(payload, indent=2))
     if store is not None:
-        append_record(payload, store)
+        append_record(payload, store,
+                      prefix="mpload" if args.procs else "load")
         print(f"# serve_load entry appended to {store}", file=sys.stderr)
-    _emit_perf_attr(led, eng, payload["detail"]["wall_s"],
-                    args.perf_attr, store)
+    if not args.procs:
+        # attribution is per-process: the supervisor dispatches no XLA
+        # programs of its own, so an mp run's ledger here is empty —
+        # each worker keeps its own
+        _emit_perf_attr(led, eng, payload["detail"]["wall_s"],
+                        args.perf_attr, store)
     return 0
 
 

@@ -6,8 +6,12 @@ records (``runs/records.jsonl``).  Until now, answering "why was this
 request's TTFT bad" or "which PR moved wire bytes" meant hand-grepping
 JSONL; obsq is the layer that answers questions:
 
-    # one request's (or one train run's) full timeline
+    # one request's (or one train run's) full timeline — a glob merges
+    # a multi-process tier's per-worker sink files (serve.net writes
+    # one per process), so a handoff renders as ONE ordered timeline
+    # across process boundaries
     python -m tools.obsq trace serve-...-e0/r7 --events ev.jsonl
+    python -m tools.obsq trace mptier-...-q0 --events 'ev.jsonl*'
 
     # recompute a serve_load record's SLO numbers from raw traces and
     # assert they match (CI smoke: --check)
@@ -52,9 +56,10 @@ What ``slo`` recomputes, and from what:
   a record whose throughput claim the traces cannot support, not clock
   skew.
 
-Importable: :func:`load_events`, :func:`derive_slo`, :func:`compare_slo`,
-:func:`trace_events`, :func:`diff_rows` are used by the tests and by
-``tools.lint --records`` (flight-dump validation).
+Importable: :func:`load_events`, :func:`expand_event_paths`,
+:func:`derive_slo`, :func:`compare_slo`, :func:`trace_events`,
+:func:`diff_rows` are used by the tests and by ``tools.lint --records``
+(flight-dump validation).
 """
 
 from __future__ import annotations
@@ -78,11 +83,35 @@ def _ensure_repo_on_path() -> None:
 # event loading
 # ---------------------------------------------------------------------------
 
+def expand_event_paths(patterns: Sequence[str]) -> List[str]:
+    """Resolve ``--events`` arguments to concrete files, expanding glob
+    patterns — a multi-process serve tier (``serve.net``) writes ONE
+    sink file per worker process (``ev.jsonl.p0-mp0``, ...), so the
+    natural invocation is ``--events 'ev.jsonl*'``.  Literal paths pass
+    through untouched (missing ones surface as open() errors, naming
+    the file); a glob pattern matching nothing raises — a trace
+    silently rendered from zero of its per-process files would read as
+    an empty timeline, not a wrong invocation."""
+    import glob as _glob
+    out: List[str] = []
+    for pat in patterns:
+        if any(ch in pat for ch in "*?["):
+            hits = sorted(_glob.glob(pat))
+            if not hits:
+                raise ValueError(
+                    f"--events pattern {pat!r} matches no files")
+            out.extend(hits)
+        else:
+            out.append(pat)
+    return out
+
+
 def load_events(*paths: str) -> List[Dict[str, Any]]:
     """Parse one or more JSONL event files (a sink file, its ``.1``
-    rollover, a flight dump) into a single time-ordered list.  A
-    malformed line raises ValueError naming file and line — a truncated
-    trace must fail loudly, not read as a shorter run."""
+    rollover, a flight dump, or every per-process sink of a
+    multi-process run) into a single time-ordered list.  A malformed
+    line raises ValueError naming file and line — a truncated trace
+    must fail loudly, not read as a shorter run."""
     out: List[Dict[str, Any]] = []
     for path in paths:
         with open(path, encoding="utf-8") as f:
@@ -436,7 +465,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_trace.add_argument("--events", nargs="+", required=True,
                          metavar="FILE",
                          help="event JSONL files (sink output, its .1 "
-                              "rollover, and/or a flight dump)")
+                              "rollover, and/or a flight dump); glob "
+                              "patterns expand, merging a multi-"
+                              "process run's per-worker sinks "
+                              "('ev.jsonl*') into one timeline")
 
     p_slo = sub.add_parser(
         "slo", help="recompute a serve_load record's TTFT p50/p99 and "
@@ -493,11 +525,13 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     try:
         if args.cmd == "trace":
-            print(render_trace(load_events(*args.events), args.trace_id))
+            paths = expand_event_paths(args.events)
+            print(render_trace(load_events(*paths), args.trace_id))
             return 0
         if args.cmd == "slo":
             entry = _pick_record(args.records, args.run_id)
-            derived = derive_slo(load_events(*args.events))
+            derived = derive_slo(
+                load_events(*expand_event_paths(args.events)))
             payload = entry.get("payload", {})
             print(f"serve_load {entry['run_id']} "
                   f"({os.path.basename(args.records)}):")
