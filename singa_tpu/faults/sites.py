@@ -125,6 +125,15 @@ SITES: Dict[str, Tuple[str, Tuple[str, ...]]] = {
         "simply re-evaluates on a later round (no quarantine: resizes "
         "are idempotent tier-shape goals, not per-request work)",
         ("error", "hang")),
+    "serve.respawn": (
+        "self-healing respawn decision (serve/net supervisor, before "
+        "a replacement worker spawn is scheduled for a dead one); an "
+        "injected error makes THAT attempt fail — it counts toward "
+        "the capped exponential backoff and is retried at a later "
+        "step boundary — and a hang delays the decision; the tier is "
+        "otherwise untouched (the dead worker's requests already "
+        "replayed on survivors before respawn runs)",
+        ("error", "hang")),
     "train.step": (
         "TrainRunner's retried step region (the shared injector the "
         "train retry/backoff path is exercised through)",
@@ -139,13 +148,15 @@ SITES: Dict[str, Tuple[str, Tuple[str, ...]]] = {
 #: subsystem seams that appear in incident records / flight-recorder
 #: dumps but are NOT injection sites (nothing fires there — they name
 #: where the SYSTEM acted, not where a fault was injected):
-#: ``serve.arena`` (arena rebuild/recovery), ``train.fatal`` (retry
+#: ``serve.arena`` (arena rebuild/recovery), ``serve.crashloop`` (the
+#: respawn circuit breaker giving up on a role after K deaths in a
+#: window — the tier degrades to survivors), ``train.fatal`` (retry
 #: exhaustion / checkpoint-write failure), ``train.hung`` (heartbeat
 #: hang abort).  ``FlightRecorder.dump`` accepts SITES plus these;
 #: singalint SGL009 enforces the same union statically so a typo'd dump
 #: site cannot silently never dump.
-INCIDENT_SITES: Tuple[str, ...] = ("serve.arena", "train.fatal",
-                                   "train.hung")
+INCIDENT_SITES: Tuple[str, ...] = ("serve.arena", "serve.crashloop",
+                                   "train.fatal", "train.hung")
 
 
 def is_known(site: str) -> bool:

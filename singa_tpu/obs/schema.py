@@ -38,7 +38,8 @@ __all__ = ["SCHEMA_VERSION", "SchemaError", "require", "validate_entry",
            "validate_stage", "validate_session_doc", "validate_bench_doc",
            "validate_multichip_doc", "validate_serve_payload",
            "validate_serve_load_payload", "validate_train_run_payload",
-           "validate_incident_payload", "validate_hlo_audit_payload",
+           "validate_incident_payload", "validate_chaos_campaign_payload",
+           "validate_hlo_audit_payload",
            "validate_autotune_sweep_payload", "validate_perf_attr_payload",
            "validate_wire_byte_fields", "validate_flight_ref",
            "validate_serve_tier_fields", "validate_spec_fields",
@@ -50,7 +51,7 @@ SCHEMA_VERSION = 1
 
 _KINDS = ("session", "bench", "serve_throughput", "serve_load",
           "train_run", "incident", "hlo_audit", "autotune_sweep",
-          "perf_attr")
+          "perf_attr", "chaos_campaign")
 
 #: required numeric payload fields of a serve_throughput entry — the
 #: serving bench's headline quantities (tools/record_check.py lints
@@ -197,6 +198,21 @@ _PERF_ATTR_PROGRAM_FIELDS = ("count", "total_s", "p50_s", "p99_s",
 #: ``retries`` are validated separately in validate_incident_payload
 _INCIDENT_STR_FIELDS = ("site", "fault", "outcome")
 
+#: required numeric payload fields of a chaos_campaign entry — one
+#: seeded chaos campaign against a live multi-process tier
+#: (tools/chaosd.py, ISSUE 19): the seed that makes the event sequence
+#: reproducible, the event counts by kind (kills / hangs / injected
+#: fault plans / resizes), what the self-healing layer did about them
+#: (respawns adopted, requests rerouted, worker deaths declared), and
+#: the traffic served across it all.  ``bitwise_ok`` — every stream
+#: matched its single-engine reference — is validated separately as a
+#: STRICT bool (the campaign's headline claim must never lint as a
+#: numeric measurement, nor a number as the claim)
+_CHAOS_CAMPAIGN_FIELDS = ("seed", "events", "kills", "hangs",
+                          "fault_plans", "resizes", "respawns",
+                          "reroutes", "worker_deaths", "requests",
+                          "completed")
+
 
 class SchemaError(ValueError):
     """A record failed validation.  ``field`` names the offending field
@@ -312,6 +328,9 @@ def validate_entry(entry: Any, ctx: str = "entry") -> None:
         elif kind == "perf_attr":
             validate_perf_attr_payload(payload,
                                        f"{ctx}: perf_attr payload")
+        elif kind == "chaos_campaign":
+            validate_chaos_campaign_payload(
+                payload, f"{ctx}: chaos_campaign payload")
         elif kind == "bench":
             validate_wire_byte_fields(payload, f"{ctx}: bench payload")
 
@@ -564,6 +583,25 @@ def validate_incident_payload(payload: Any,
             f"{ctx}: 'ref' must be a step/request id (string or number), "
             f"got {ref!r}", field="ref")
     _require_numeric_fields(payload, ("retries",), ctx)
+    validate_flight_ref(payload, ctx)
+
+
+def validate_chaos_campaign_payload(
+        payload: Any, ctx: str = "chaos_campaign payload") -> None:
+    """One seeded chaos campaign's invariant summary (tools/chaosd.py):
+    every count in ``_CHAOS_CAMPAIGN_FIELDS`` present and numeric, plus
+    ``bitwise_ok`` as a STRICT bool — the campaign's headline claim
+    ("every stream across every kill/hang/resize matched its
+    single-engine reference bit for bit") must be a verdict, not a
+    number that happens to be truthy.  A campaign record whose seed or
+    event counts went missing could not be re-derived and re-asserted
+    from the frozen record, which is the determinism contract the
+    driver exists to honor (docs/robustness.md, "Self-healing")."""
+    _require_numeric_fields(payload, _CHAOS_CAMPAIGN_FIELDS, ctx)
+    ok = require(payload, "bitwise_ok", ctx)
+    _expect(isinstance(ok, bool),
+            f"{ctx}: 'bitwise_ok' must be a bool, got {ok!r}",
+            field="bitwise_ok")
     validate_flight_ref(payload, ctx)
 
 

@@ -24,6 +24,16 @@ consecutive checks (one check every ``check_every`` steps) before a
 resize fires, and a resize resets the debounce — the supervisor's
 ``serve.resize`` fault site can still abort any individual resize,
 which the policy simply retries at a later check.
+
+Interaction with self-healing (ISSUE 19): a policy resize moves the
+supervisor's per-role TARGET, which is also what respawn restores
+toward after a worker death — so an elastic shrink that lands while a
+respawn spawn is in flight is settled at adoption time (the surplus
+newcomer is dismissed against the moved target, never double-adopted),
+and an explicit resize hands a crash-looping role a clean slate
+(breaker closed, backoff forgotten).  The policy reads ``alive``
+worker counts only, so a dead-but-not-yet-pruned worker never inflates
+the pool size a decision is based on.
 """
 
 from __future__ import annotations

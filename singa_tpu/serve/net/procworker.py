@@ -18,7 +18,8 @@ prefill or decode role, owned by a supervisor
    control channel, and
 5. serves the RPC loop: ``submit`` / ``resubmit`` / ``tick`` /
    ``handoff`` (probe, extract, inject) / ``drain`` / ``health`` /
-   ``resize`` / ``shutdown``.
+   ``heartbeat`` (liveness probe) / ``chaos`` (install a worker-side
+   fault plan) / ``resize`` / ``shutdown``.
 
 Per-process observability: the supervisor points ``SINGA_OBS`` at a
 per-worker sink file (``<base>.<worker>``), and every frame's ``trace``
@@ -247,13 +248,51 @@ class _WorkerServer:
                         "ttft_s": r.ttft_s})
         return {"ok": True, "reqs": out}
 
+    def _op_heartbeat(self, hdr: dict) -> dict:
+        """Liveness probe — header-only and engine-free by design: it
+        proves the RPC loop itself is being serviced.  The supervisor's
+        hang detector keys off THIS (and the per-op deadlines), never
+        off process existence — a SIGSTOPped or wedged worker has a
+        perfectly live pid and still fails this probe."""
+        return {"ok": True, "pid": os.getpid()}
+
+    def _op_chaos(self, hdr: dict) -> dict:
+        """Install (or clear) a fault plan inside THIS worker process —
+        the chaos campaign's worker-side seam.  ``plan`` is the
+        ``SINGA_FAULTS`` syntax (``FaultPlan.parse``); a worker-side
+        ``serve.transport`` hang, for instance, wedges the worker's
+        payload frames without killing the process, which is exactly
+        the hang-≠-crash case the liveness layer exists for.  Empty or
+        missing ``plan`` uninstalls."""
+        from singa_tpu import faults
+        from singa_tpu.faults.plan import FaultPlan
+        spec = hdr.get("plan")
+        try:
+            if spec:
+                faults.install(FaultPlan.parse(
+                    spec, seed=int(hdr.get("seed", 0))))
+            else:
+                faults.uninstall()
+        except ValueError as e:
+            return {"ok": False, "err": f"value_error: {e}"}
+        return {"ok": True, "plan": spec or None}
+
     def _op_health(self, hdr: dict) -> dict:
         m = self.engine.metrics
-        return {"ok": True, "pending": self.engine.pending,
-                "pid": os.getpid(), "role": self.role,
-                "snapshot": m.snapshot(),
-                "ttft_samples": list(m._ttft.samples),
-                "token_samples": list(m._token.samples)}
+        rep = {"ok": True, "pending": self.engine.pending,
+               "pid": os.getpid(), "role": self.role,
+               "snapshot": m.snapshot(),
+               "ttft_samples": list(m._ttft.samples),
+               "token_samples": list(m._token.samples)}
+        counts = getattr(self.engine, "compiled_counts", None)
+        if callable(counts):
+            # live jit-cache sizes — the campaign's program-set-fixed
+            # invariant reads these after every chaos event
+            rep["compiles"] = counts()
+            hc = getattr(self.engine, "handoff_compiled_count", None)
+            if callable(hc):
+                rep["handoff_compiles"] = hc()
+        return rep
 
     def _op_resize(self, hdr: dict) -> dict:
         if hdr.get("tick_hint_s") is not None:

@@ -13,9 +13,19 @@ handling, which is all the cross-process propagation there is.
 
 Verbs (handled in :mod:`.procworker`): ``hello``, ``ready``,
 ``submit``, ``resubmit``, ``tick``, ``handoff`` (probe / extract /
-inject), ``drain``, ``health``, ``resize``, ``shutdown``.  Replies
-echo ``op`` with ``ok`` set; errors ride back as ``{"ok": false,
-"err": ...}`` rather than killing the connection.
+inject), ``drain``, ``health``, ``heartbeat`` (header-only,
+engine-free liveness probe — the supervisor's hang detector, ISSUE
+19), ``chaos`` (install a worker-side fault plan — the campaign
+driver's seam), ``resize``, ``shutdown``.  Replies echo ``op`` with
+``ok`` set; errors ride back as ``{"ok": false, "err": ...}`` rather
+than killing the connection.
+
+Deadlines live one layer up: the supervisor resolves a per-op timeout
+from its table (``supervisor._OP_TIMEOUTS``, compile-aware) and a
+timed-out socket is POISONED there — this module's ``recv_frame``
+cannot tell a late reply from a fresh one (frames carry no request
+id), so the supervisor-side poisoning contract is what prevents a
+stale reply being misread as the answer to a newer request.
 
 Fault seams: frames WITH a binary payload are the KV wire transport,
 so both directions fire the ``serve.transport`` site before the bytes

@@ -37,6 +37,18 @@ processes:
   :class:`~singa_tpu.serve.net.elastic.ElasticPolicy` can drive it
   from queue-depth / parked-handoff signals.  ``serve.resize`` faults
   abort a resize cleanly without touching the worker set.
+* **self-healing** (ISSUE 19) — a supervisor-side liveness layer
+  (per-op RPC deadlines + ``heartbeat`` probes of quiet workers)
+  declares a HUNG worker dead as readily as a crashed one, and every
+  death funnels into the same path: in-flight requests replay bitwise
+  on survivors immediately, then a replacement is respawned in the
+  background toward the role's target size and adopted at a step
+  boundary exactly like elastic grow (``serve.respawn`` incident).
+  K deaths of one role inside a window trip a crash-loop circuit
+  breaker (``serve.crashloop`` incident): the tier stops respawning
+  that role and degrades to the surviving pools until an explicit
+  ``resize()`` closes the breaker.  See docs/robustness.md
+  "Self-healing".
 
 Observability: each worker writes its own event sink
 (``<base>.<worker>``) and every RPC frame carries the contextvar trace
@@ -79,9 +91,48 @@ __all__ = ["WorkerProc", "ProcHandle", "ProcRouter", "ProcTierMetrics",
 
 _POOL_SEQ = itertools.count()
 
-#: control-plane RPC timeout — generous because a worker's FIRST tick
-#: may pay a jit compile, and chaos hangs ride on top
-_CALL_TIMEOUT_S = 120.0
+#: Per-op RPC deadlines (seconds).  One blanket generous timeout (the
+#: old ``_CALL_TIMEOUT_S = 120``) meant a silently wedged worker could
+#: stall the tier for two minutes before anything noticed; each op now
+#: gets the deadline its work actually justifies:
+#:
+#: * ``heartbeat``/``health`` are header-only probes a healthy worker
+#:   answers in microseconds — seconds of allowance is pure scheduler
+#:   slack, so a hang is DECLARED in seconds, not minutes;
+#: * ``submit``/``resubmit``/``withdraw``/``chaos`` are queue/plan
+#:   mutations: host-side bookkeeping only, no device dispatch;
+#: * ``tick`` runs one engine round and ``handoff`` moves KV over the
+#:   wire — tens of seconds of honest compute on a loaded CPU box;
+#: * ``drain``/``shutdown`` bound how long an elastic scale-down or a
+#:   close waits before escalating to a kill.
+#:
+#: A worker's FIRST few ticks and FIRST handoff may pay a jit compile
+#: — those calls escalate to ``_COMPILE_TIMEOUT_S`` (see
+#: :meth:`WorkerProc.op_timeout`) instead of inflating every
+#: steady-state deadline.
+_OP_TIMEOUTS: Dict[str, float] = {
+    "heartbeat": 5.0,
+    "health": 10.0,
+    "submit": 15.0,
+    "resubmit": 15.0,
+    "withdraw": 15.0,
+    "chaos": 15.0,
+    "tick": 60.0,
+    "handoff": 60.0,
+    "drain": 60.0,
+    "shutdown": 30.0,
+}
+#: ops missing from the table (forward compatibility) keep the old
+#: blanket deadline
+_DEFAULT_TIMEOUT_S = 120.0
+#: first-dispatch escalation: jit compiles happen on a worker's first
+#: prefill/decode/handoff dispatches, NOT at ready (ready only proves
+#: the build), so early ticks/handoffs get the compile budget
+_COMPILE_TIMEOUT_S = 300.0
+#: how many ok ticks before a worker's tick deadline drops from the
+#: compile-aware budget to the steady-state one (the prefill, decode
+#: and spec program variants each compile on a different early tick)
+_WARMUP_TICKS = 4
 
 
 class WorkerDied(ConnectionError):
@@ -108,35 +159,83 @@ class WorkerProc:
         self.model_key: Optional[str] = None
         self.compiles: Optional[dict] = None
         self.ready_ms: Optional[float] = None
+        #: a timed-out / errored socket may sit mid-frame — the next
+        #: recv on it would misparse stale bytes as a fresh reply, so
+        #: the FIRST WorkerDied poisons the connection for good and
+        #: every later use fails fast without touching the socket
+        self.poisoned = False
+        #: monotonic time of the last successful round trip — the
+        #: host-side heartbeat age (``ProcRouter._check_liveness``)
+        self.last_ok = time.monotonic()
+        #: successful ticks / handoff ops so far — drives the
+        #: compile-aware deadline escalation in :meth:`op_timeout`
+        self.ok_ticks = 0
+        self.ok_handoffs = 0
         #: worker-local rid -> supervisor qid for every request this
         #: worker currently owns
         self.wrids: Dict[int, int] = {}
 
+    def op_timeout(self, op: str) -> float:
+        """The per-op deadline (``_OP_TIMEOUTS``), compile-aware: a
+        worker's early ticks and first handoff escalate to the fabric's
+        compile budget because jit compiles happen on first dispatch,
+        not at ready."""
+        t = self.fabric.op_timeouts.get(op, _DEFAULT_TIMEOUT_S)
+        if op == "tick" and self.ok_ticks < _WARMUP_TICKS:
+            return max(t, self.fabric.compile_timeout_s)
+        if op == "handoff" and self.ok_handoffs < 1:
+            return max(t, self.fabric.compile_timeout_s)
+        return t
+
+    def _usable(self) -> None:
+        if self.poisoned:
+            raise WorkerDied(
+                f"worker {self.name}: connection poisoned by an "
+                f"earlier timeout/socket error (stream may be "
+                f"mid-frame); refusing further RPC")
+
+    def _poison(self, e: BaseException) -> WorkerDied:
+        self.poisoned = True
+        return WorkerDied(
+            f"worker {self.name}: {type(e).__name__}: {e}")
+
     def call(self, header: Dict[str, Any], payload: bytes = b"", *,
-             timeout: float = _CALL_TIMEOUT_S
+             timeout: Optional[float] = None
              ) -> Tuple[Dict[str, Any], bytes]:
         """One RPC round trip; any socket-level failure is a
-        :class:`WorkerDied` (the caller escalates to worker death)."""
+        :class:`WorkerDied` (the caller escalates to worker death).
+        ``timeout=None`` resolves from the per-op table via
+        :meth:`op_timeout`."""
+        self._usable()
+        if timeout is None:
+            timeout = self.op_timeout(str(header.get("op", "")))
         try:
-            return rpc.call(self.sock, header, payload, timeout=timeout)
+            rep, data = rpc.call(self.sock, header, payload,
+                                 timeout=timeout)
         except (rpc.RPCError, socket.timeout, OSError) as e:
-            raise WorkerDied(
-                f"worker {self.name}: {type(e).__name__}: {e}") from e
+            raise self._poison(e) from e
+        self.last_ok = time.monotonic()
+        return rep, data
 
     def send(self, header: Dict[str, Any], payload: bytes = b"") -> None:
+        self._usable()
         try:
             rpc.send_frame(self.sock, header, payload)
         except OSError as e:
-            raise WorkerDied(
-                f"worker {self.name}: {type(e).__name__}: {e}") from e
+            raise self._poison(e) from e
 
-    def recv(self, *, timeout: float = _CALL_TIMEOUT_S
+    def recv(self, *, timeout: Optional[float] = None
              ) -> Tuple[Dict[str, Any], bytes]:
+        self._usable()
         try:
-            return rpc.recv_frame(self.sock, timeout=timeout)
+            rep, data = rpc.recv_frame(
+                self.sock,
+                timeout=_DEFAULT_TIMEOUT_S if timeout is None
+                else timeout)
         except (rpc.RPCError, socket.timeout, OSError) as e:
-            raise WorkerDied(
-                f"worker {self.name}: {type(e).__name__}: {e}") from e
+            raise self._poison(e) from e
+        self.last_ok = time.monotonic()
+        return rep, data
 
     def __repr__(self) -> str:
         return (f"WorkerProc({self.name!r}, {self.role}, "
@@ -152,7 +251,9 @@ class _Fabric:
 
     def __init__(self, worker_cfg: dict, *,
                  spawn_timeout_s: float = 300.0,
-                 faults_env: Optional[Dict[str, str]] = None):
+                 faults_env: Optional[Dict[str, str]] = None,
+                 op_timeouts: Optional[Dict[str, float]] = None,
+                 compile_timeout_s: float = _COMPILE_TIMEOUT_S):
         self.dir = tempfile.mkdtemp(prefix="singa-net-")
         self.sock_path = os.path.join(self.dir, "sup.sock")
         self.listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -161,7 +262,15 @@ class _Fabric:
         self.worker_cfg = worker_cfg
         self.spawn_timeout_s = spawn_timeout_s
         self.faults_env = dict(faults_env or {})
+        #: per-op RPC deadlines — the documented defaults with any
+        #: caller overrides on top (tests/chaos runs shrink them)
+        self.op_timeouts = {**_OP_TIMEOUTS, **(op_timeouts or {})}
+        self.compile_timeout_s = float(compile_timeout_s)
         self.obs_base: Optional[str] = None
+        #: every Popen this fabric ever spawned — the chaos driver's
+        #: no-orphan invariant audits this ledger (each entry must be
+        #: an adopted pool member or already reaped)
+        self.procs: List[subprocess.Popen] = []
         self._lock = threading.Lock()
         self._name_seq = {"prefill": itertools.count(),
                           "decode": itertools.count()}
@@ -209,6 +318,7 @@ class _Fabric:
                      "--sock", self.sock_path, "--name", name,
                      "--role", role, "--config", arg],
                     env=self._child_env())
+            self.procs.extend(procs.values())
             by_name: Dict[str, WorkerProc] = {}
             deadline = time.monotonic() + self.spawn_timeout_s
             roles = dict(specs)
@@ -228,28 +338,46 @@ class _Fabric:
                                    self)
                     w.pid = hello.get("pid")
                     by_name[name] = w
+                out = []
+                for name, _role in specs:
+                    w = by_name[name]
+                    ready, _ = w.recv(
+                        timeout=max(1.0, deadline - time.monotonic()))
+                    if ready.get("op") != "ready" or not ready.get("ok"):
+                        raise WorkerDied(
+                            f"worker {name} failed to become ready: "
+                            f"{ready}")
+                    w.model_key = ready.get("model_key")
+                    w.compiles = ready.get("compiles")
+                    w.ready_ms = ready.get("ready_ms")
+                    out.append(w)
+                return out
             except socket.timeout:
-                for p in procs.values():
-                    p.terminate()
+                self._reap(procs.values())
                 raise WorkerDied(
                     f"spawn timed out: {sorted(set(roles) - set(by_name))} "
                     f"never connected within {self.spawn_timeout_s:.0f}s"
                 ) from None
+            except BaseException:
+                # never leave half-spawned children behind: a failed
+                # batch is reaped wholesale (the no-orphan invariant)
+                self._reap(procs.values())
+                raise
             finally:
                 self.listener.settimeout(None)
-            out = []
-            for name, _role in specs:
-                w = by_name[name]
-                ready, _ = w.recv(
-                    timeout=max(1.0, deadline - time.monotonic()))
-                if ready.get("op") != "ready" or not ready.get("ok"):
-                    raise WorkerDied(
-                        f"worker {name} failed to become ready: {ready}")
-                w.model_key = ready.get("model_key")
-                w.compiles = ready.get("compiles")
-                w.ready_ms = ready.get("ready_ms")
-                out.append(w)
-            return out
+
+    @staticmethod
+    def _reap(procs) -> None:
+        for p in procs:
+            try:
+                p.kill()
+            except OSError:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=10.0)
+            except (subprocess.TimeoutExpired, OSError):
+                pass
 
     def close(self) -> None:
         with self._lock:
@@ -283,6 +411,8 @@ def build_proc_pools(model_spec, n_prefill: int, n_decode: int, *,
                      faults_env: Optional[Dict[str, str]] = None,
                      spawn_timeout_s: float = 300.0,
                      self_spec_k: int = 0,
+                     op_timeouts: Optional[Dict[str, float]] = None,
+                     compile_timeout_s: float = _COMPILE_TIMEOUT_S,
                      **engine_kwargs
                      ) -> Tuple[List[WorkerProc], List[WorkerProc]]:
     """(prefill_workers, decode_workers) as OS processes — the
@@ -296,7 +426,10 @@ def build_proc_pools(model_spec, n_prefill: int, n_decode: int, *,
     ``faults_env`` forwards a ``SINGA_FAULTS`` plan to the CHILDREN
     (worker-side chaos) — by default children are scrubbed of the
     supervisor's plan so one spec never injects on both sides of an
-    RPC."""
+    RPC.  ``op_timeouts`` overrides entries of the per-op RPC deadline
+    table (``_OP_TIMEOUTS``) and ``compile_timeout_s`` the
+    first-dispatch escalation budget — chaos tests shrink both so hang
+    detection is measured in seconds."""
     if n_prefill < 1 or n_decode < 1:
         raise ValueError(
             f"a tier needs at least one worker per pool, got "
@@ -313,7 +446,8 @@ def build_proc_pools(model_spec, n_prefill: int, n_decode: int, *,
                        record_store=record_store, **engine_kwargs),
     }
     fabric = _Fabric(worker_cfg, spawn_timeout_s=spawn_timeout_s,
-                     faults_env=faults_env)
+                     faults_env=faults_env, op_timeouts=op_timeouts,
+                     compile_timeout_s=compile_timeout_s)
     if obs_base is None:
         sink = events.get_sink()
         obs_base = getattr(sink, "path", None)
@@ -431,6 +565,8 @@ class ProcTierMetrics:
         self.door_rejected = 0
         self.quota_rejected = 0
         self.worker_deaths = 0
+        self.respawns = 0
+        self.crashloops = 0
         self.steps = 0
         self.resizes = 0
         self.resizes_aborted = 0
@@ -469,6 +605,14 @@ class ProcTierMetrics:
     def on_worker_death(self, worker: str) -> None:
         self.worker_deaths += 1
         events.counter("serve.worker_dead", 1, worker=worker)
+
+    def on_respawn(self, worker: str) -> None:
+        self.respawns += 1
+        events.counter("serve.respawn", 1, worker=worker)
+
+    def on_crashloop(self, role: str) -> None:
+        self.crashloops += 1
+        events.counter("serve.crashloop", 1, role=role)
 
     def on_resize(self, kind: str) -> None:
         self.resizes += 1
@@ -556,6 +700,7 @@ class ProcTierMetrics:
             "handoff_ms": self.handoff_summary(),
             "reroutes": self.reroutes,
             "worker_deaths": self.worker_deaths,
+            "respawns": self.respawns,
         }
 
 
@@ -577,7 +722,13 @@ class ProcRouter:
                  slo_classes: Optional[Dict[str, SLOClass]] = None,
                  record_store: Optional[str] = None,
                  run_id: Optional[str] = None,
-                 policy=None):
+                 policy=None,
+                 heartbeat_every_s: float = 2.0,
+                 respawn: bool = True,
+                 respawn_backoff_s: float = 0.5,
+                 respawn_backoff_cap_s: float = 30.0,
+                 breaker_k: int = 3,
+                 breaker_window_s: float = 60.0):
         self.prefill = list(prefill_workers)
         self.decode = list(decode_workers)
         if not self.prefill or not self.decode:
@@ -612,6 +763,35 @@ class ProcRouter:
         self._spawn_threads: List[threading.Thread] = []
         self._draining = False
         self._closed = False
+        # -- self-healing knobs + state (ISSUE 19) ------------------
+        #: probe an alive worker whose last successful RPC is older
+        #: than this (host half of the ``utils.failure.Heartbeat``
+        #: contract: beat age > deadline → dead, crash or no crash)
+        self.heartbeat_every_s = float(heartbeat_every_s)
+        #: automatic respawn of dead workers toward the role target
+        self.respawn = bool(respawn)
+        self.respawn_backoff_s = float(respawn_backoff_s)
+        self.respawn_backoff_cap_s = float(respawn_backoff_cap_s)
+        #: crash-loop circuit breaker: ``breaker_k`` deaths of one role
+        #: inside ``breaker_window_s`` → stop respawning that role
+        self.breaker_k = int(breaker_k)
+        self.breaker_window_s = float(breaker_window_s)
+        #: per-role pool-size goal — seeded from the constructor
+        #: pools, moved ONLY by :meth:`resize`; respawn restores
+        #: toward it and adoption dismisses any surplus beyond it
+        self._target = {"prefill": len(self.prefill),
+                        "decode": len(self.decode)}
+        #: in-flight background spawns per role (guarded by
+        #: ``_staged_lock``, like ``_staged`` — together they make the
+        #: "already on its way" count resize/respawn dedupe against)
+        self._spawning = {"prefill": 0, "decode": 0}
+        #: consecutive failed respawn attempts → exponential backoff
+        self._respawn_fails = {"prefill": 0, "decode": 0}
+        self._respawn_not_before = {"prefill": 0.0, "decode": 0.0}
+        #: recent death timestamps per role (breaker window evidence)
+        self._death_times: Dict[str, List[float]] = {"prefill": [],
+                                                     "decode": []}
+        self._breaker_open = {"prefill": False, "decode": False}
 
     # -- introspection -----------------------------------------------------
     def workers(self) -> List[WorkerProc]:
@@ -727,6 +907,7 @@ class ProcRouter:
         with events.span("serve.tier_step"):
             self._adopt_staged()
             self._prune()
+            self._check_liveness()
             decode_alive = [w for w in self.decode if w.alive]
             ready_map: Dict[str, List[dict]] = {}
             delivered += self._tick_pool(
@@ -743,6 +924,7 @@ class ProcRouter:
                 want = self.policy.decide(self)
                 if want:
                     self.resize(**want)
+            self._respawn_tick()
             dt = time.monotonic() - t0
             self._tick_ewma = dt if self._tick_ewma is None else \
                 0.8 * self._tick_ewma + 0.2 * dt
@@ -765,13 +947,14 @@ class ProcRouter:
             if not w.alive:
                 continue
             try:
-                rep, _ = w.recv()
+                rep, _ = w.recv(timeout=w.op_timeout("tick"))
             except WorkerDied as e:
                 self._worker_death(w, str(e))
                 continue
             if not rep.get("ok"):
                 self._worker_death(w, f"tick: {rep.get('err')}")
                 continue
+            w.ok_ticks += 1
             delivered += rep.get("delivered", 0)
             w.load = rep.get("pending", w.load)
             self._apply_delta(w, rep.get("delta", ()))
@@ -812,6 +995,7 @@ class ProcRouter:
         Idempotent."""
         if self._closed:
             return
+        self.respawn = False   # a closing tier never heals itself
         self.drain()
         self._closed = True
         for t in self._spawn_threads:
@@ -921,6 +1105,7 @@ class ProcRouter:
                     return
                 src.wrids.pop(ent["rid"], None)
                 src.load = max(0, src.load - 1)
+                src.ok_handoffs += 1
                 try:
                     rep2, _ = dst.call({"op": "handoff",
                                         "dir": "inject"}, wire)
@@ -945,6 +1130,7 @@ class ProcRouter:
         self._where[qid] = dst
         dst.wrids[rep2["rid"]] = qid
         dst.load += 1
+        dst.ok_handoffs += 1
         self.metrics.on_handoff(
             wait_ms, len(wire),
             float(rep.get("ser_ms", 0.0)) + float(rep2.get("deser_ms",
@@ -1027,8 +1213,18 @@ class ProcRouter:
         w.alive = False
         self.metrics.on_worker_death(w.name)
         try:
-            w.proc.terminate()
+            # SIGKILL, not SIGTERM: a HUNG worker (the liveness layer's
+            # whole reason to exist) may be wedged in a way that never
+            # services SIGTERM — e.g. SIGSTOPped, or spinning with
+            # signals blocked.  Kill is the only verdict that sticks,
+            # and the wait() reaps the zombie so the chaos driver's
+            # no-orphan audit sees a clean ledger.
+            w.proc.kill()
         except OSError:
+            pass
+        try:
+            w.proc.wait(timeout=10.0)
+        except (subprocess.TimeoutExpired, OSError):
             pass
         try:
             w.sock.close()
@@ -1050,6 +1246,167 @@ class ProcRouter:
                          count_reroute=True, incident=False, warn=False)
         self._incident("serve.router", "worker_death", w.name,
                        "rerouted", len(victims), flight_ref=ref)
+        self._on_death_respawn(w.role)
+
+    # -- self-healing: liveness, respawn, crash-loop breaker ---------------
+    def _check_liveness(self) -> None:
+        """Supervisor-side heartbeat (the host half of the
+        ``utils.failure.Heartbeat`` contract): any alive worker whose
+        last successful RPC is older than ``heartbeat_every_s`` gets a
+        header-only ``heartbeat`` probe on a fast deadline.  A worker
+        that cannot answer within seconds is declared dead even though
+        its PROCESS may still exist — a hang and a crash converge on
+        the same :class:`WorkerDied` funnel (``_worker_death``).  In a
+        busy tier every tick refreshes ``last_ok``, so probes only
+        ride when a worker has been quiet; a worker that hangs MID
+        tick is caught by the tick deadline instead."""
+        now = time.monotonic()
+        for w in self.workers():
+            if not w.alive or now - w.last_ok < self.heartbeat_every_s:
+                continue
+            try:
+                rep, _ = w.call({"op": "heartbeat"})
+            except WorkerDied as e:
+                self._worker_death(w, f"heartbeat: {e}")
+                continue
+            if not rep.get("ok"):
+                self._worker_death(w, f"heartbeat: {rep.get('err')}")
+
+    def _on_death_respawn(self, role: str) -> None:
+        """Death-path respawn bookkeeping: record the death for the
+        breaker window, trip the crash-loop breaker at ``breaker_k``
+        deaths in ``breaker_window_s`` (→ ``serve.crashloop`` incident,
+        the role degrades to the surviving pools instead of
+        spawn-spinning), else schedule a replacement immediately."""
+        if not self.respawn or self._closed or self._draining:
+            return
+        now = time.monotonic()
+        times = [t for t in self._death_times[role]
+                 if now - t <= self.breaker_window_s]
+        times.append(now)
+        self._death_times[role] = times
+        if self._breaker_open[role]:
+            return
+        if len(times) >= self.breaker_k:
+            self._breaker_open[role] = True
+            self.metrics.on_crashloop(role)
+            warnings.warn(
+                f"serve.net: {role} pool is crash-looping "
+                f"({len(times)} deaths in {self.breaker_window_s:.0f}s)"
+                f"; respawn breaker OPEN — the tier degrades to "
+                f"survivors until an explicit resize()", stacklevel=2)
+            self.flight.note("error", "serve.crashloop", role=role,
+                             deaths=len(times),
+                             window_s=self.breaker_window_s)
+            self._incident(
+                "serve.crashloop", "crash_loop", role, "degraded",
+                len(times),
+                flight_ref=self._flight_dump(
+                    "serve.crashloop",
+                    f"{role}: {len(times)} deaths in "
+                    f"{self.breaker_window_s:.0f}s"))
+            return
+        self._respawn_tick()
+
+    def _respawn_tick(self) -> None:
+        """Schedule background replacement spawns for any role below
+        its target.  Runs at every step boundary AND straight from the
+        death path, so a failed attempt is retried (after its capped
+        exponential backoff) without needing another death to notice
+        the deficit.  The spawn itself happens on a ``net-respawner``
+        thread — in-flight requests have ALREADY replayed on survivors
+        by the time this runs, so nothing waits on the slow spawn —
+        and the newcomer is adopted at a step boundary exactly like
+        elastic grow."""
+        if not self.respawn or self._closed or self._draining:
+            return
+        now = time.monotonic()
+        for role, pool in (("prefill", self.prefill),
+                           ("decode", self.decode)):
+            if self._breaker_open[role]:
+                continue
+            alive = sum(1 for w in pool if w.alive)
+            with self._staged_lock:
+                if now < self._respawn_not_before[role]:
+                    continue
+                staged = sum(1 for w in self._staged if w.role == role)
+                spawning = self._spawning[role]
+            deficit = self._target[role] - (alive + staged + spawning)
+            if deficit <= 0:
+                continue
+            try:
+                # the ``serve.respawn`` seam: an error here is a failed
+                # attempt (counts toward backoff), a hang delays the
+                # respawn decision — the spawn itself is exercised by
+                # killing the spawned worker, not by this site
+                faults.fire("serve.respawn", role=role, n=deficit)
+            except InjectedFault as e:
+                self._respawn_failed(role, e)
+                continue
+            self._respawn(role, deficit)
+
+    def _respawn(self, role: str, n: int) -> None:
+        specs = [(self.fabric.next_name(role), role) for _ in range(n)]
+        with self._staged_lock:
+            self._spawning[role] += n
+
+        def respawn() -> None:
+            workers, err = [], None
+            try:
+                workers = self.fabric.spawn_many(specs)
+            except (WorkerDied, RuntimeError, OSError) as e:
+                err = e
+            with self._staged_lock:
+                self._spawning[role] -= n
+                if err is None:
+                    self._respawn_fails[role] = 0
+                    self._respawn_not_before[role] = 0.0
+                    for w in workers:
+                        w.is_respawn = True
+                    self._staged.extend(workers)
+            if err is not None:
+                self._respawn_failed(role, err)
+
+        t = threading.Thread(target=respawn, name="net-respawner",
+                             daemon=True)
+        self._spawn_threads.append(t)
+        t.start()
+
+    def _respawn_failed(self, role: str, err: BaseException) -> None:
+        with self._staged_lock:
+            self._respawn_fails[role] += 1
+            fails = self._respawn_fails[role]
+            backoff = min(self.respawn_backoff_cap_s,
+                          self.respawn_backoff_s * 2.0 ** (fails - 1))
+            self._respawn_not_before[role] = time.monotonic() + backoff
+        warnings.warn(
+            f"serve.net: {role} respawn failed "
+            f"({type(err).__name__}: {err}); attempt {fails}, next "
+            f"retry backs off {backoff:.2f}s", stacklevel=2)
+
+    def breaker_state(self) -> Dict[str, bool]:
+        """Operations/test introspection: which roles the crash-loop
+        breaker has given up on (cleared by an explicit resize)."""
+        return dict(self._breaker_open)
+
+    def heal_state(self) -> dict:
+        """One consistent snapshot of the self-healing machinery —
+        what a chaos driver polls to decide the tier has settled:
+        per-role alive counts vs targets, staged-but-not-adopted and
+        in-flight spawn counts, and the breaker state."""
+        with self._staged_lock:
+            staged = {r: sum(1 for w in self._staged if w.role == r)
+                      for r in ("prefill", "decode")}
+            spawning = dict(self._spawning)
+        return {
+            "alive": {"prefill": sum(1 for w in self.prefill
+                                     if w.alive),
+                      "decode": sum(1 for w in self.decode if w.alive)},
+            "target": dict(self._target),
+            "staged": staged,
+            "spawning": spawning,
+            "breaker": dict(self._breaker_open),
+        }
 
     # -- elastic resize ----------------------------------------------------
     def resize(self, n_prefill: Optional[int] = None,
@@ -1078,9 +1435,25 @@ class ProcRouter:
             if want is None:
                 continue
             want = max(1, int(want))   # never below one worker per pool
+            self._target[role] = want
+            # an explicit resize is an operator decision: the role gets
+            # a clean slate — breaker closed, backoff forgotten
+            self._breaker_open[role] = False
+            self._death_times[role] = []
             alive = [w for w in pool if w.alive]
-            if want > len(alive):
-                self._grow(role, want - len(alive))
+            with self._staged_lock:
+                self._respawn_fails[role] = 0
+                self._respawn_not_before[role] = 0.0
+                staged = sum(1 for w in self._staged if w.role == role)
+                spawning = self._spawning[role]
+            # grow against everything already on its way (staged +
+            # in-flight spawns), not just the alive count — a shrink
+            # below that sum is settled at adoption time, where the
+            # target guard dismisses the surplus newcomer cleanly
+            # (the respawn-vs-shrink race cannot double-adopt)
+            have = len(alive) + staged + spawning
+            if want > have:
+                self._grow(role, want - have)
                 changed = True
             elif want < len(alive):
                 # drain the youngest first (oldest workers keep the
@@ -1096,16 +1469,21 @@ class ProcRouter:
 
     def _grow(self, role: str, n: int) -> None:
         specs = [(self.fabric.next_name(role), role) for _ in range(n)]
+        with self._staged_lock:
+            self._spawning[role] += n
 
         def spawn() -> None:
+            workers, err = [], None
             try:
                 workers = self.fabric.spawn_many(specs)
             except (WorkerDied, RuntimeError, OSError) as e:
-                warnings.warn(f"serve.net: grow spawn failed: {e}",
-                              stacklevel=2)
-                return
+                err = e
             with self._staged_lock:
+                self._spawning[role] -= n
                 self._staged.extend(workers)
+            if err is not None:
+                warnings.warn(f"serve.net: grow spawn failed: {err}",
+                              stacklevel=2)
 
         t = threading.Thread(target=spawn, name="net-spawner",
                              daemon=True)
@@ -1119,11 +1497,47 @@ class ProcRouter:
             if self._closed and not force:
                 continue
             pool = self.prefill if w.role == "prefill" else self.decode
+            alive = sum(1 for x in pool if x.alive)
+            if not force and alive >= self._target[w.role]:
+                # the target moved while this spawn was in flight (an
+                # elastic shrink racing a respawn/grow): the newcomer
+                # is surplus — dismiss it cleanly instead of
+                # double-adopting, and no process is orphaned
+                self._dismiss(w, "surplus to target after resize")
+                continue
             pool.append(w)
             events.counter("serve.worker_adopted", 1, worker=w.name,
                            role=w.role)
             self.flight.note("counter", "serve.worker_adopted",
                              worker=w.name, role=w.role)
+            if getattr(w, "is_respawn", False):
+                # the self-healing receipt: replacement adopted, pool
+                # back toward target — incident + flight evidence
+                self.metrics.on_respawn(w.name)
+                self._incident(
+                    "serve.respawn", "respawn", w.name, "respawned",
+                    0, flight_ref=self._flight_dump(
+                        "serve.respawn",
+                        f"worker {w.name} adopted as replacement"))
+
+    def _dismiss(self, w: WorkerProc, reason: str) -> None:
+        """Shut down a spawned-but-never-adopted worker cleanly (it
+        owns no requests — nothing to replay)."""
+        self.flight.note("counter", "serve.worker_dismissed",
+                         worker=w.name, reason=reason)
+        try:
+            w.call({"op": "shutdown"})
+        except WorkerDied:
+            pass
+        w.alive = False
+        try:
+            w.proc.wait(timeout=30.0)
+        except subprocess.TimeoutExpired:
+            w.proc.kill()
+        try:
+            w.sock.close()
+        except OSError:
+            pass
 
     def _drain_worker(self, w: WorkerProc, pool: List[WorkerProc]
                       ) -> None:
@@ -1175,6 +1589,13 @@ class ProcRouter:
                 self._handles.pop(qid, None)
                 self._where.pop(qid, None)
                 self._ready_at.pop(qid, None)
+        # dead workers leave the pool lists once their victims have
+        # replayed (which happened at death): respawn means pools churn
+        # for the tier's whole life, and tier_stats/resize must count
+        # the real population, not a graveyard
+        for pool in (self.prefill, self.decode):
+            if any(not w.alive for w in pool):
+                pool[:] = [w for w in pool if w.alive]
 
     def _flight_dump(self, site: str, reason: str) -> Optional[str]:
         return obs_flight.dump_for_store(self.flight, site,

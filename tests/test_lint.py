@@ -1357,15 +1357,18 @@ def test_ci_gate_picks_up_conclint_with_no_stage_renumbering():
     """tools/ci_gate.sh stage 1 is the bare `python -m tools.lint`
     full audit, which now includes the conc thread-model gate — so
     conclint rides in with NO extra stage (ISSUE 15 satellite): the
-    script declares a contiguous ladder (1/9..9/9 since ISSUE 18's
-    mp-smoke stage) and its stage-1 command is still the bare
+    script declares a contiguous ladder (1/10..10/10 since ISSUE 19's
+    chaos-smoke stage) and its stage-1 command is still the bare
     invocation."""
     sh = open(os.path.join(REPO, "tools", "ci_gate.sh")).read()
-    for n in range(1, 10):
-        assert f"stage {n}/9" in sh, f"stage {n}/9 vanished/renumbered"
-    assert "stage 10" not in sh
-    stage1 = sh.split("stage 2/9")[0]
+    for n in range(1, 11):
+        assert f"stage {n}/10" in sh, \
+            f"stage {n}/10 vanished/renumbered"
+    assert "stage 11" not in sh
+    stage1 = sh.split("stage 2/10")[0]
     assert "python -m tools.lint || exit 10" in stage1
+    # the chaos stage rides the ladder with its own exit code
+    assert "python -m tools.chaosd --smoke || exit 18" in sh
     # and the bare invocation really runs the conc gate (CLI contract)
     from tools.lint.__main__ import _AUDIT_MODES
     assert "conc" in _AUDIT_MODES

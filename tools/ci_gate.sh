@@ -45,7 +45,14 @@
 #             handoff over the digest-checked wire codec — process
 #             spawn, the wire transport, and donated-scatter injection
 #             end to end)
-#   stage 8  autotune     `python -m tools.autotune smoke` + the
+#   stage 8  chaos smoke  `python -m tools.chaosd --smoke`   exit 18
+#            (a fixed-seed self-healing campaign against a 2-process
+#             1:1 tier: one worker SIGKILLed and one SIGSTOPped
+#             mid-stream — both deaths detected (crash AND hang),
+#             every stream completes bitwise vs the single-engine
+#             reference, both replacements respawned and adopted, and
+#             no orphan worker process survives the run)
+#   stage 9  autotune     `python -m tools.autotune smoke` + the
 #            table-resolved consumers, exit 15
 #            (committed best.json + autotune_sweep records validate —
 #             incl. the stale-schema_version guard — then a real
@@ -59,7 +66,7 @@
 #             decode/prefill ratio band, achieved-fraction sanity —
 #             and `obsq diff perf_attr --assert-last` tripwires the
 #             committed record trajectory)
-#   stage 9  tier-1 tests  the ROADMAP.md tier-1 command     exit 20
+#   stage 10 tier-1 tests  the ROADMAP.md tier-1 command     exit 20
 #
 # Exit 0 = every stage green.  Intentional compiled-program changes are
 # re-baselined first via `python -m tools.lint --hlo --update-baselines`
@@ -67,43 +74,46 @@
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
-echo "== ci_gate stage 1/9: full audit (static + HLO structure + cost) =="
+echo "== ci_gate stage 1/10: full audit (static + HLO structure + cost) =="
 JAX_PLATFORMS=cpu python -m tools.lint || exit 10
 
-echo "== ci_gate stage 2/9: record validation =="
+echo "== ci_gate stage 2/10: record validation =="
 JAX_PLATFORMS=cpu python -m tools.lint --records || exit 11
 
-echo "== ci_gate stage 3/9: obsq SLO smoke (trace-derived vs committed fixture) =="
+echo "== ci_gate stage 3/10: obsq SLO smoke (trace-derived vs committed fixture) =="
 JAX_PLATFORMS=cpu python -m tools.obsq slo --check \
     --records tests/data/obsq/records.jsonl \
     --events tests/data/obsq/events.jsonl || exit 12
 
-echo "== ci_gate stage 4/9: disagg smoke (1:1 tier streams == single engine) =="
+echo "== ci_gate stage 4/10: disagg smoke (1:1 tier streams == single engine) =="
 JAX_PLATFORMS=cpu python -m tools.loadgen --disagg-smoke || exit 13
 
-echo "== ci_gate stage 5/9: spec smoke (self-speculation streams == generate()) =="
+echo "== ci_gate stage 5/10: spec smoke (self-speculation streams == generate()) =="
 JAX_PLATFORMS=cpu python -m tools.loadgen --spec-smoke || exit 14
 
-echo "== ci_gate stage 6/9: spill smoke (spill/restore streams == generate()) =="
+echo "== ci_gate stage 6/10: spill smoke (spill/restore streams == generate()) =="
 JAX_PLATFORMS=cpu python -m tools.loadgen --spill-smoke || exit 16
 
-echo "== ci_gate stage 7/9: mp smoke (2-process tier streams == single engine) =="
+echo "== ci_gate stage 7/10: mp smoke (2-process tier streams == single engine) =="
 JAX_PLATFORMS=cpu python -m tools.loadgen --mp-smoke || exit 17
 
-echo "== ci_gate stage 8/9: autotune smoke (sweep -> fit -> table -> consumers) =="
+echo "== ci_gate stage 8/10: chaos smoke (1 kill + 1 hang, streams bitwise, respawn) =="
+JAX_PLATFORMS=cpu python -m tools.chaosd --smoke || exit 18
+
+echo "== ci_gate stage 9/10: autotune smoke (sweep -> fit -> table -> consumers) =="
 JAX_PLATFORMS=cpu python -m tools.autotune smoke || exit 15
 JAX_PLATFORMS=cpu python -m tools.loadgen --requests 6 --rate 50 \
     --no-record || exit 15
 rm -f /tmp/_perf_attr.json
 JAX_PLATFORMS=cpu python bench.py --serve --no-record \
     --perf-attr /tmp/_perf_attr.json || exit 15
-echo "== ci_gate stage 8/9 (cont.): runtime-attribution sentinel (PERF00x) =="
+echo "== ci_gate stage 9/10 (cont.): runtime-attribution sentinel (PERF00x) =="
 JAX_PLATFORMS=cpu python -m tools.lint --perf /tmp/_perf_attr.json \
     || exit 15
 JAX_PLATFORMS=cpu python -m tools.obsq diff perf_attr \
     --assert-last "attributed_s<=+300%" || exit 15
 
-echo "== ci_gate stage 9/9: tier-1 test suite (ROADMAP.md budget) =="
+echo "== ci_gate stage 10/10: tier-1 test suite (ROADMAP.md budget) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -827,6 +827,16 @@ def main(argv=None) -> int:
                          "engine, with blocks spilled AND a prefix "
                          "re-hit served from the spill store; exits "
                          "non-zero on divergence")
+    ap.add_argument("--chaos-campaign", action="store_true",
+                    help="delegate to tools.chaosd: a seeded "
+                         "deterministic campaign of worker kills/"
+                         "hangs, fault plans and resizes against a "
+                         "live multi-process tier under this Poisson "
+                         "load shape, committing a chaos_campaign "
+                         "record (see python -m tools.chaosd --help "
+                         "for the full knob set)")
+    ap.add_argument("--chaos-events", type=int, default=6,
+                    help="with --chaos-campaign: schedule length")
     ap.add_argument("--kv-dtype", default=None,
                     choices=("f32", "int8"),
                     help="KV arena storage format (plain engine only; "
@@ -845,6 +855,20 @@ def main(argv=None) -> int:
         return spec_smoke()
     if args.spill_smoke:
         return spill_smoke()
+    if args.chaos_campaign:
+        from tools import chaosd
+        cargv = ["--seed", str(args.seed),
+                 "--events", str(args.chaos_events),
+                 "--rate", str(args.rate)]
+        if args.prefill_workers:
+            cargv += ["--prefill", str(args.prefill_workers)]
+        if args.decode_workers:
+            cargv += ["--decode", str(args.decode_workers)]
+        if args.store:
+            cargv += ["--store", args.store]
+        if args.no_record:
+            cargv += ["--no-record"]
+        return chaosd.main(cargv)
     if args.spec_k < 0:
         ap.error("--spec-k must be >= 0")
     if ((args.kv_dtype or args.spill_blocks) and

@@ -437,6 +437,69 @@ def _load_attr_payload(source: Optional[str],
             entry.get("payload", {}))
 
 
+def incidents_rows(store_path: str, last: int = 20
+                   ) -> Tuple[List[str], List[List[Any]]]:
+    """The newest ``last`` flight dumps under ``<store dir>/incidents/``
+    — site and timestamp parsed from the dump filename
+    (``<ts>-<site>-<pid>-<seq>.jsonl``), trace ids read from the dump's
+    event lines, and ``linked`` answering the REVERSE of the
+    ``lint --records`` flight_ref check: records are linted to point at
+    dumps that exist; this asks whether each dump on disk is pointed AT
+    by some record, so an orphaned dump (its record append failed, or
+    it predates the store) is visible instead of silently unreachable
+    from any postmortem."""
+    base = os.path.dirname(os.path.abspath(store_path))
+    inc_dir = os.path.join(base, "incidents")
+    if not os.path.isdir(inc_dir):
+        raise OSError(f"no incidents directory at {inc_dir} (nothing "
+                      f"has dumped next to {store_path})")
+    linked = set()
+    if os.path.exists(store_path):
+        with open(store_path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entry = json.loads(line)
+                except ValueError:
+                    continue
+                ref = (entry.get("payload") or {}).get("flight_ref")
+                if isinstance(ref, str) and ref:
+                    linked.add(os.path.normpath(ref))
+    names = sorted(n for n in os.listdir(inc_dir)
+                   if n.endswith(".jsonl"))     # ts prefix: chronological
+    header = ["dump", "site", "timestamp", "trace", "linked"]
+    rows: List[List[Any]] = []
+    for name in names[-max(0, last):]:
+        parts = name[:-len(".jsonl")].split("-")
+        # <%Y%m%d>-<%H%M%S>-<site>-<pid>-<seq>; site never contains "-"
+        # today, but join defensively rather than misparse a future one
+        site = "-".join(parts[2:-2]) if len(parts) >= 5 else "?"
+        ts = "-".join(parts[:2]) if len(parts) >= 5 else "?"
+        traces: List[str] = []
+        try:
+            with open(os.path.join(inc_dir, name),
+                      encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        tid = json.loads(line).get("trace")
+                    except ValueError:
+                        continue
+                    if tid and tid not in traces:
+                        traces.append(tid)
+        except OSError:
+            pass
+        shown = ("-" if not traces else
+                 traces[0] + (f" (+{len(traces) - 1})"
+                              if len(traces) > 1 else ""))
+        is_linked = os.path.normpath(
+            os.path.join("incidents", name)) in linked
+        rows.append([name, site, ts, shown,
+                     "yes" if is_linked else "NO"])
+    return header, rows
+
+
 def _render_table(header: List[str], rows: List[List[Any]]) -> str:
     def fmt(v: Any) -> str:
         if isinstance(v, float):
@@ -511,6 +574,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "(\"field<=+X%%\" / \"field>=-X%%\"); "
                              "trivially green with <2 records")
 
+    p_inc = sub.add_parser(
+        "incidents", help="list flight dumps under the store's "
+                          "incidents/ directory: site, timestamp, "
+                          "trace id, and whether any record's "
+                          "flight_ref links back (the reverse of the "
+                          "lint --records check)")
+    p_inc.add_argument("--last", type=int, default=20,
+                       help="newest N dumps (default 20)")
+    p_inc.add_argument("--records",
+                       default=os.path.join(_REPO, "runs",
+                                            "records.jsonl"))
+
     p_attr = sub.add_parser(
         "attr", help="runtime-attribution table of a perf_attr record "
                      "(default: newest in the store) or a payload dump "
@@ -572,6 +647,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                                      last=args.last, fields=fields,
                                      sweep=args.sweep)
             print(_render_table(header, rows))
+            return 0
+        if args.cmd == "incidents":
+            header, rows = incidents_rows(args.records, last=args.last)
+            print(_render_table(header, rows))
+            unlinked = sum(1 for r in rows if r[-1] == "NO")
+            if unlinked:
+                print(f"obsq: {unlinked}/{len(rows)} dumps have no "
+                      f"flight_ref back-link from "
+                      f"{os.path.basename(args.records)}",
+                      file=sys.stderr)
             return 0
         if args.cmd == "attr":
             label, payload = _load_attr_payload(args.source,
