@@ -19,7 +19,7 @@ prefill or decode role, owned by a supervisor
 5. serves the RPC loop: ``submit`` / ``resubmit`` / ``tick`` /
    ``handoff`` (probe, extract, inject) / ``drain`` / ``health`` /
    ``heartbeat`` (liveness probe) / ``chaos`` (install a worker-side
-   fault plan) / ``resize`` / ``shutdown``.
+   fault plan) / ``shutdown``.
 
 Per-process observability: the supervisor points ``SINGA_OBS`` at a
 per-worker sink file (``<base>.<worker>``), and every frame's ``trace``
@@ -293,13 +293,6 @@ class _WorkerServer:
             if callable(hc):
                 rep["handoff_compiles"] = hc()
         return rep
-
-    def _op_resize(self, hdr: dict) -> dict:
-        if hdr.get("tick_hint_s") is not None:
-            self.engine.tick_hint_s = float(hdr["tick_hint_s"])
-        if hdr.get("admit") is not None:
-            self._draining = not bool(hdr["admit"])
-        return {"ok": True}
 
     # -- the loop ----------------------------------------------------------
     def serve(self) -> int:

@@ -16,7 +16,7 @@ Verbs (handled in :mod:`.procworker`): ``hello``, ``ready``,
 inject), ``drain``, ``health``, ``heartbeat`` (header-only,
 engine-free liveness probe — the supervisor's hang detector, ISSUE
 19), ``chaos`` (install a worker-side fault plan — the campaign
-driver's seam), ``resize``, ``shutdown``.  Replies echo ``op`` with
+driver's seam), ``shutdown``.  Replies echo ``op`` with
 ``ok`` set; errors ride back as ``{"ok": false, "err": ...}`` rather
 than killing the connection.
 

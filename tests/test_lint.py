@@ -44,7 +44,7 @@ def test_catalogue_covers_the_invariants():
     assert set(RULES) >= {"SGL001", "SGL002", "SGL003",
                           "SGL005", "SGL006", "SGL007", "SGL008",
                           "SGL009", "SGL010", "SGL011", "SGL012",
-                          "SGL013"}
+                          "SGL013", "SGL015", "SGL017"}
     # SGL004 (thread-seam) is RETIRED: folded into SGL010 (conclint);
     # the code stays reserved as a documented alias that fails loudly
     assert "SGL004" not in RULES
@@ -1118,6 +1118,10 @@ class TestOutputAndCli:
             lint_main(["singa_tpu", "--hlo"])
         with pytest.raises(SystemExit):
             lint_main(["--hlo", "--records"])
+        with pytest.raises(SystemExit):
+            lint_main(["singa_tpu", "--proc"])
+        with pytest.raises(SystemExit):
+            lint_main(["--proc", "--conc"])
 
     def test_cli_select_covers_audit_modes(self, tmp_path, monkeypatch):
         """--select enumerates/filters audit modes alongside SGL codes:
@@ -1195,7 +1199,7 @@ class TestOutputAndCli:
         out = capsys.readouterr().out
         for code in RULES:
             assert code in out
-        for mode in ("records", "ckpt", "conc", "hlo", "cost"):
+        for mode in ("records", "ckpt", "conc", "proc", "hlo", "cost"):
             assert f"\n  {mode}" in out
         for code in HLO_CODES:
             assert code in out
@@ -1204,6 +1208,9 @@ class TestOutputAndCli:
         # conclint: the thread-model gate code and the retired alias
         assert "SGL014" in out
         assert "SGL004" in out and "retired" in out
+        # proclint: the process-model + RPC-protocol gate codes
+        assert "SGL016" in out
+        assert "SGL019" in out
 
     def test_cli_json(self, tmp_path, capsys):
         bad = tmp_path / "bad.py"
@@ -1375,6 +1382,609 @@ def test_ci_gate_picks_up_conclint_with_no_stage_renumbering():
 
 
 # ---------------------------------------------------------------------------
+# proclint (SGL015/SGL016/SGL017/SGL019) — the process-mesh audit
+# ---------------------------------------------------------------------------
+
+class TestResourceLifecycle:
+    """SGL015: acquire/release pairing on the exception path."""
+
+    def test_never_released_socket(self):
+        out = lint("""
+            import socket
+
+            def probe(host):
+                s = socket.socket()
+                s.connect(host)
+                return 1
+            """, "SGL015")
+        assert codes_of(out) == ["SGL015"]
+        assert "never released in probe()" in out[0].message
+
+    def test_straight_line_release_only(self):
+        out = lint("""
+            import socket
+
+            def probe(host):
+                s = socket.socket()
+                s.connect(host)
+                s.close()
+            """, "SGL015")
+        assert codes_of(out) == ["SGL015"]
+        assert "released only on the straight-line path" \
+            in out[0].message
+
+    def test_discarded_popen_result(self):
+        out = lint("""
+            import subprocess
+
+            def fire(cmd):
+                subprocess.Popen(cmd, env={})
+            """, "SGL015")
+        assert codes_of(out) == ["SGL015"]
+        assert "result discarded" in out[0].message
+
+    def test_self_attr_with_no_releasing_method(self):
+        out = lint("""
+            import socket
+
+            class Hub:
+                def __init__(self):
+                    self.sock = socket.socket()
+            """, "SGL015")
+        assert codes_of(out) == ["SGL015"]
+        assert "no method of Hub releases it" in out[0].message
+
+    def test_temp_dir_leak(self):
+        out = lint("""
+            import tempfile
+
+            def scratch(do):
+                d = tempfile.mkdtemp()
+                do(d)
+            """, "SGL015")
+        assert codes_of(out) == ["SGL015"]
+        assert "temp dir" in out[0].message
+
+    def test_clean_try_finally(self):
+        assert lint("""
+            import socket
+
+            def probe(host):
+                s = socket.socket()
+                try:
+                    s.connect(host)
+                finally:
+                    s.close()
+            """, "SGL015") == []
+
+    def test_clean_with_block(self):
+        assert lint("""
+            import socket
+
+            def probe(host):
+                with socket.socket() as s:
+                    s.connect(host)
+            """, "SGL015") == []
+
+    def test_clean_owning_class_release(self):
+        assert lint("""
+            import socket
+
+            class Hub:
+                def __init__(self):
+                    self.sock = socket.socket()
+
+                def close(self):
+                    self.sock.close()
+            """, "SGL015") == []
+
+    def test_clean_registered_cleanup(self):
+        assert lint("""
+            import atexit
+            import tempfile
+
+            def scratch(use, cleanup):
+                d = tempfile.mkdtemp()
+                atexit.register(cleanup, d)
+                return use(d)
+            """, "SGL015") == []
+
+    def test_clean_escape_to_ledger(self):
+        assert lint("""
+            import subprocess
+
+            class Pool:
+                def spawn(self, cmd):
+                    p = subprocess.Popen(cmd, env={})
+                    self.procs.append(p)
+            """, "SGL015") == []
+
+    def test_clean_helper_release_on_except_path(self):
+        # the one-helper-level closure: self._reap releases its param
+        assert lint("""
+            import subprocess
+
+            class Pool:
+                def spawn(self, cmd):
+                    p = subprocess.Popen(cmd, env={})
+                    try:
+                        self._adopt(p)
+                    except Exception:
+                        self._reap([p])
+                        raise
+
+                def _adopt(self, p):
+                    self.procs.append(p)
+
+                def _reap(self, procs):
+                    for q in procs:
+                        q.kill()
+                        q.wait()
+            """, "SGL015") == []
+
+    def test_clean_wait_consumed_in_place(self):
+        assert lint("""
+            import subprocess
+
+            def run(cmd):
+                subprocess.Popen(cmd, env={}).wait()
+            """, "SGL015") == []
+
+    def test_suppression_with_reason_honored(self):
+        assert lint("""
+            import socket
+
+            def probe(host):
+                s = socket.socket()  # singalint: disable=SGL015 probe socket is process-lifetime by design
+                s.connect(host)
+            """, "SGL015") == []
+
+
+class TestEnvContract:
+    """SGL017: the child-env scrub seam around subprocess.Popen."""
+
+    def test_popen_without_env_double_fires(self):
+        out = lint("""
+            import subprocess
+
+            def fire(cmd):
+                return subprocess.Popen(cmd)
+            """, "SGL017")
+        assert codes_of(out) == ["SGL017"]
+        assert "without a scrubbed env=" in out[0].message
+
+    def test_dropped_scrub_is_a_named_finding(self):
+        # the seeded regression: the scrub seam lost two of its pops
+        out = lint("""
+            import os
+            import subprocess
+
+            def fire(cmd):
+                env = dict(os.environ)
+                env.pop("SINGA_OBS", None)
+                return subprocess.Popen(cmd, env=env)
+            """, "SGL017")
+        assert codes_of(out) == ["SGL017"]
+        assert "does not scrub" in out[0].message
+        assert "SINGA_FAULTS" in out[0].message
+
+    def test_env_write_outside_seam(self):
+        out = lint("""
+            import os
+
+            def arm(plan):
+                os.environ["SINGA_FAULTS"] = plan
+            """, "SGL017")
+        assert codes_of(out) == ["SGL017"]
+        assert "outside the child-env scrub seam" in out[0].message
+
+    def test_clean_loop_form_scrub_seam(self):
+        # the supervisor's actual seam shape
+        assert lint("""
+            import os
+            import subprocess
+
+            def fire(cmd):
+                env = dict(os.environ)
+                for k in ("SINGA_FAULTS", "SINGA_FAULTS_SEED",
+                          "SINGA_OBS"):
+                    env.pop(k, None)
+                return subprocess.Popen(cmd, env=env)
+            """, "SGL017") == []
+
+    def test_clean_write_inside_seam(self):
+        # the seam itself MAY set fault vars — that is what it is for
+        assert lint("""
+            import os
+
+            def child_env(plan):
+                env = dict(os.environ)
+                for k in ("SINGA_FAULTS", "SINGA_FAULTS_SEED",
+                          "SINGA_OBS"):
+                    env.pop(k, None)
+                env["SINGA_FAULTS"] = plan
+                return env
+            """, "SGL017") == []
+
+    def test_clean_scratch_dict_env(self):
+        # a from-scratch literal env inherits nothing
+        assert lint("""
+            import subprocess
+
+            def fire(cmd):
+                return subprocess.Popen(cmd, env={"PATH": "/usr/bin"})
+            """, "SGL017") == []
+
+    def test_clean_helper_seam(self):
+        assert lint("""
+            import os
+            import subprocess
+
+            class Fab:
+                def _child_env(self):
+                    env = dict(os.environ)
+                    for k in ("SINGA_FAULTS", "SINGA_FAULTS_SEED",
+                              "SINGA_OBS"):
+                        env.pop(k, None)
+                    return env
+
+                def spawn(self, cmd):
+                    return subprocess.Popen(
+                        cmd, env=self._child_env())
+            """, "SGL017") == []
+
+
+_PROTO_WORKER = '''\
+class Worker:
+    def _op_submit(self, hdr):
+        return {"ok": True}
+
+    def _op_tick(self, hdr):
+        return {"ok": True}
+
+    def serve(self, op, hdr):
+        if op == "shutdown":
+            return {"ok": True}
+        return getattr(self, "_op_" + op)(hdr)
+'''
+
+_PROTO_WORKER_ONE_SIDED = _PROTO_WORKER + '''
+
+class WorkerWithDeadOp(Worker):
+    def _op_submit(self, hdr):
+        return {"ok": True}
+
+    def _op_resize(self, hdr):
+        return {"ok": True}
+'''
+
+_PROTO_DRIVER = '''\
+_OP_TIMEOUTS = {"submit": 5.0, "tick": 1.0, "shutdown": 3.0}
+
+
+def drive(w):
+    w.call({"op": "submit"})
+    w.send({"op": "tick"})
+    w.call({"op": "shutdown"})
+'''
+
+
+class TestRpcProtocol:
+    """SGL016: dispatch table vs. call sites vs. _OP_TIMEOUTS."""
+
+    def _proto(self, tmp_path, worker, driver):
+        from tools.lint import proc
+        (tmp_path / "worker.py").write_text(worker)
+        (tmp_path / "driver.py").write_text(driver)
+        return proc.protocol_findings(paths=[str(tmp_path)],
+                                      root=str(tmp_path))
+
+    def test_conformant_protocol_is_clean(self, tmp_path):
+        assert self._proto(tmp_path, _PROTO_WORKER,
+                           _PROTO_DRIVER) == []
+
+    def test_one_sided_handled_op_fails_loudly(self, tmp_path):
+        # the seeded regression: a handler nobody calls
+        out = self._proto(tmp_path, _PROTO_WORKER_ONE_SIDED,
+                          _PROTO_DRIVER)
+        assert out and set(codes_of(out)) == {"SGL016"}
+        assert any("'resize'" in f.message and
+                   "never sent" in f.message for f in out)
+        # ...and the same op is missing its deadline row
+        assert any("'resize'" in f.message and
+                   "no _OP_TIMEOUTS deadline entry" in f.message
+                   for f in out)
+
+    def test_called_but_unhandled_op(self, tmp_path):
+        out = self._proto(
+            tmp_path, _PROTO_WORKER,
+            _PROTO_DRIVER + '\n\ndef extra(w):\n'
+            '    w.call({"op": "status"})\n')
+        assert codes_of(out) == ["SGL016"]
+        assert "no worker handler" in out[0].message
+
+    def test_handled_op_without_deadline(self, tmp_path):
+        out = self._proto(
+            tmp_path, _PROTO_WORKER,
+            _PROTO_DRIVER.replace('"tick": 1.0, ', ""))
+        assert codes_of(out) == ["SGL016"]
+        assert "'tick'" in out[0].message
+        assert "no _OP_TIMEOUTS deadline entry" in out[0].message
+
+    def test_stale_deadline_row(self, tmp_path):
+        out = self._proto(
+            tmp_path, _PROTO_WORKER,
+            _PROTO_DRIVER.replace('"submit": 5.0',
+                                  '"submit": 5.0, "flush": 2.0'))
+        assert codes_of(out) == ["SGL016"]
+        assert "'flush'" in out[0].message
+        assert "names an op no worker handles" in out[0].message
+
+    def test_codec_version_skew(self, tmp_path):
+        out = self._proto(tmp_path, '''\
+MAGIC = b"SGKV"
+WIRE_VERSION = 2
+
+
+def encode_pkg(x):
+    return MAGIC + bytes([WIRE_VERSION]) + x
+
+
+def decode_pkg(data):
+    if data[:4] != MAGIC:
+        raise ValueError("bad magic")
+    version = data[4]
+    if version != 1:
+        raise ValueError("bad version")
+    return data[5:]
+''', "")
+        assert codes_of(out) == ["SGL016"]
+        assert "wire-version skew" in out[0].message
+
+    def test_codec_magic_skew(self, tmp_path):
+        out = self._proto(tmp_path, '''\
+def encode_pkg(x):
+    return b"SGKV" + x
+
+
+def decode_pkg(data):
+    if data[:4] != b"SGKW":
+        raise ValueError("bad magic")
+    return data[4:]
+''', "")
+        assert codes_of(out) == ["SGL016"]
+        assert "magic skew" in out[0].message
+
+    def test_codec_shared_constants_clean(self, tmp_path):
+        assert self._proto(tmp_path, '''\
+MAGIC = b"SGKV"
+WIRE_VERSION = 2
+
+
+def encode_pkg(x):
+    return MAGIC + bytes([WIRE_VERSION]) + x
+
+
+def decode_pkg(data):
+    if data[:4] != MAGIC:
+        raise ValueError("bad magic")
+    version = data[4]
+    if version != WIRE_VERSION:
+        raise ValueError("bad version")
+    return data[5:]
+''', "") == []
+
+
+class TestProcessModel:
+    """SGL019: the committed process-model baseline gate."""
+
+    FABRIC = '''\
+import os
+import signal
+import socket
+import subprocess
+
+
+class Fabric:
+    def __init__(self):
+        self.listener = socket.socket()
+        self.procs = []
+
+    def spawn(self, cmd):
+        env = dict(os.environ)
+        for k in ("SINGA_FAULTS", "SINGA_FAULTS_SEED", "SINGA_OBS"):
+            env.pop(k, None)
+        p = subprocess.Popen(cmd, env=env)
+        self.procs.append(p)
+        conn, _ = self.listener.accept()
+        return conn
+
+    def reap(self, p):
+        p.kill()
+        p.wait(timeout=5.0)
+        self.procs.remove(p)
+
+    def pause(self, p):
+        os.kill(p.pid, signal.SIGSTOP)
+
+    def close(self):
+        self.listener.close()
+'''
+
+    def _ptree(self, tmp_path):
+        (tmp_path / "singa_tpu").mkdir()
+        (tmp_path / "tools").mkdir()
+        (tmp_path / "singa_tpu" / "w.py").write_text(self.FABRIC)
+        (tmp_path / "tools" / "t.py").write_text(
+            "def boot(fabric):\n    fabric.spawn_many(2)\n")
+        return [str(tmp_path / "singa_tpu"), str(tmp_path / "tools")]
+
+    def test_discovery(self, tmp_path):
+        from tools.lint import proc
+        paths = self._ptree(tmp_path)
+        model = proc.discover_model(paths=paths, root=str(tmp_path))
+        assert model["roots"] == {
+            "singa_tpu/w.py::Fabric.spawn": "popen",
+            "tools/t.py::boot": "spawn-call"}
+        # the kill next to its wait is reaped; the bare SIGSTOP is not
+        assert model["signals"] == {
+            "singa_tpu/w.py::Fabric.reap": "SIGKILL",
+            "singa_tpu/w.py::Fabric.pause": "SIGSTOP!noreap"}
+        assert model["reaps"] == {
+            "singa_tpu/w.py::Fabric.reap": "ledger+wait"}
+        assert model["sockets"] == {
+            "singa_tpu/w.py::Fabric.__init__": "socket",
+            "singa_tpu/w.py::Fabric.spawn": "accept"}
+        assert model["hash"] == proc.model_hash(model)
+
+    def test_missing_baseline_fails_loudly(self, tmp_path):
+        from tools.lint import proc
+        paths = self._ptree(tmp_path)
+        out = proc.gate_findings(
+            paths=paths, baseline_path=str(tmp_path / "model.json"),
+            root=str(tmp_path))
+        assert codes_of(out) == ["SGL019"]
+        assert "no committed process-model baseline" in out[0].message
+        assert "--update-baselines" in out[0].message
+
+    def test_baseline_round_trip(self, tmp_path):
+        from tools.lint import proc
+        paths = self._ptree(tmp_path)
+        base = str(tmp_path / "model.json")
+        diff = proc.update_model_baseline(
+            paths=paths, baseline_path=base, root=str(tmp_path))
+        assert "+ root singa_tpu/w.py::Fabric.spawn: popen" in diff
+        assert "+ signal singa_tpu/w.py::Fabric.pause: " \
+               "SIGSTOP!noreap" in diff
+        assert proc.gate_findings(paths=paths, baseline_path=base,
+                                  root=str(tmp_path)) == []
+        # a second update with no tree change is a no-op
+        assert "process model unchanged" in proc.update_model_baseline(
+            paths=paths, baseline_path=base, root=str(tmp_path))
+
+    def test_new_spawn_root_fails_loudly(self, tmp_path):
+        from tools.lint import proc
+        paths = self._ptree(tmp_path)
+        base = str(tmp_path / "model.json")
+        proc.update_model_baseline(paths=paths, baseline_path=base,
+                                   root=str(tmp_path))
+        (tmp_path / "singa_tpu" / "w.py").write_text(
+            self.FABRIC + "\n\nclass Sneaky:\n"
+            "    def go(self, cmd):\n"
+            "        self.p = subprocess.Popen(cmd, env={})\n")
+        out = proc.gate_findings(paths=paths, baseline_path=base,
+                                 root=str(tmp_path))
+        assert out and set(codes_of(out)) == {"SGL019"}
+        assert any("NEW process root" in f.message and
+                   "Sneaky.go" in f.message and
+                   "--update-baselines" in f.message for f in out)
+
+    def test_deleted_reap_site_fails_loudly(self, tmp_path):
+        # the seeded regression: the kill keeps firing but its reap
+        # (and the ledger removal) are gone — zombie processes
+        from tools.lint import proc
+        paths = self._ptree(tmp_path)
+        base = str(tmp_path / "model.json")
+        proc.update_model_baseline(paths=paths, baseline_path=base,
+                                   root=str(tmp_path))
+        (tmp_path / "singa_tpu" / "w.py").write_text(
+            self.FABRIC.replace("        p.wait(timeout=5.0)\n"
+                                "        self.procs.remove(p)\n", ""))
+        out = proc.gate_findings(paths=paths, baseline_path=base,
+                                 root=str(tmp_path))
+        assert out and set(codes_of(out)) == {"SGL019"}
+        # the kill LOST its reap path: a value change, not silence
+        assert any("SIGKILL -> SIGKILL!noreap" in f.message
+                   for f in out)
+        # and the reap site itself vanished from the mesh
+        assert any("was not discovered" in f.message and
+                   "zombie" in f.message for f in out)
+
+    def test_hand_edited_baseline_fails_loudly(self, tmp_path):
+        from tools.lint import proc
+        paths = self._ptree(tmp_path)
+        base = str(tmp_path / "model.json")
+        proc.update_model_baseline(paths=paths, baseline_path=base,
+                                   root=str(tmp_path))
+        doc = json.load(open(base))
+        doc["signals"] = {}    # edit sections, keep the stale hash
+        json.dump(doc, open(base, "w"))
+        out = proc.gate_findings(paths=paths, baseline_path=base,
+                                 root=str(tmp_path))
+        assert codes_of(out) == ["SGL019"]
+        assert "hand-edited" in out[0].message
+
+    def test_schema_mismatch_fails_loudly(self, tmp_path):
+        from tools.lint import proc
+        paths = self._ptree(tmp_path)
+        base = str(tmp_path / "model.json")
+        proc.update_model_baseline(paths=paths, baseline_path=base,
+                                   root=str(tmp_path))
+        doc = json.load(open(base))
+        doc["schema"] = 99
+        json.dump(doc, open(base, "w"))
+        out = proc.gate_findings(paths=paths, baseline_path=base,
+                                 root=str(tmp_path))
+        assert codes_of(out) == ["SGL019"]
+        assert "schema" in out[0].message
+
+
+def test_cli_proc_gate_drives_exit_codes(tmp_path, monkeypatch,
+                                         capsys):
+    """`python -m tools.lint --proc` end to end: missing baseline ->
+    exit 1 with SGL019; `--update-baselines` writes the reviewed
+    model; a one-sided RPC op -> exit 1 with SGL016."""
+    from tools.lint import proc
+    (tmp_path / "singa_tpu").mkdir()
+    (tmp_path / "tools").mkdir()
+    (tmp_path / "singa_tpu" / "worker.py").write_text(_PROTO_WORKER)
+    (tmp_path / "singa_tpu" / "driver.py").write_text(_PROTO_DRIVER)
+    monkeypatch.setattr(proc, "_REPO_ROOT", str(tmp_path))
+    monkeypatch.setattr(proc, "MODEL_PATH",
+                        str(tmp_path / "model.json"))
+    assert lint_main(["--proc"]) == 1
+    out = capsys.readouterr().out
+    assert "SGL019" in out and "proclint:" in out
+    assert lint_main(["--proc", "--update-baselines"]) == 0
+    out = capsys.readouterr().out
+    assert "process model" in out and "model.json" in out
+    assert lint_main(["--proc"]) == 0
+    assert "clean" in capsys.readouterr().out
+    (tmp_path / "singa_tpu" / "worker.py").write_text(
+        _PROTO_WORKER_ONE_SIDED)
+    assert lint_main(["--proc"]) == 1
+    out = capsys.readouterr().out
+    assert "SGL016" in out and "resize" in out
+
+
+def test_ci_gate_picks_up_proclint_with_no_stage_renumbering():
+    """proclint rides ci_gate stage 1 (the bare full audit) with NO
+    extra stage (ISSUE 20 satellite): the ladder is still 1/10..10/10
+    and the stage-1 comment names the process-mesh gate."""
+    sh = open(os.path.join(REPO, "tools", "ci_gate.sh")).read()
+    for n in range(1, 11):
+        assert f"stage {n}/10" in sh, \
+            f"stage {n}/10 vanished/renumbered"
+    assert "stage 11" not in sh
+    stage1 = sh.split("stage 2/10")[0]
+    assert "python -m tools.lint || exit 10" in stage1
+    assert "proclint" in stage1
+    from tools.lint.__main__ import _AUDIT_MODES
+    assert "proc" in _AUDIT_MODES
+
+
+def test_chaosd_and_serve_net_covered_by_wallclock_and_fault_rules():
+    """SGL005 (unbounded waits) and SGL007 (fault-seam hygiene)
+    explicitly cover the chaos driver and the serve/net tier (ISSUE 20
+    satellite) — and both are clean."""
+    findings = run_paths(
+        [os.path.join(REPO, "tools", "chaosd.py"),
+         os.path.join(REPO, "singa_tpu", "serve", "net")],
+        codes=["SGL005", "SGL007"])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ---------------------------------------------------------------------------
 # the tier-1 gate: the repo itself is clean
 # ---------------------------------------------------------------------------
 
@@ -1397,4 +2007,18 @@ def test_repo_thread_model_is_clean():
     diff it prints (docs/static-analysis.md, "Concurrency audit")."""
     from tools.lint import conc
     findings = conc.gate_findings()
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_repo_process_model_is_clean():
+    """The committed tools/lint/data/proc/model.json matches the
+    tree's discovered process mesh exactly — every spawn site, signal
+    send, reap site, and socket in HEAD has been reviewed — and the
+    RPC protocol's three views (dispatch table, call sites,
+    _OP_TIMEOUTS) agree.  A finding here means: review the change,
+    then run `python -m tools.lint --proc --update-baselines` and
+    commit the diff it prints (docs/static-analysis.md,
+    "Process-mesh audit")."""
+    from tools.lint import proc
+    findings = proc.audit_findings()
     assert findings == [], "\n".join(f.render() for f in findings)

@@ -6,7 +6,9 @@
 # without log spelunking:
 #
 #   stage 1  full audit   `python -m tools.lint`            exit 10
-#            (static SGL rules + HLO structure gate + cost gate over
+#            (static SGL rules + conclint thread-model gate + proclint
+#             process-mesh/RPC-protocol gate + HLO structure gate +
+#             cost gate over
 #             the EIGHT flagship programs — train_step, train_step_dp2,
 #             train_step_dp2_int8 (the int8-ring wire-bytes win,
 #             COST005-gated vs the f32 DP baseline), prefill_chunk,

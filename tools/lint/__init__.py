@@ -24,3 +24,4 @@ from .framework import (  # noqa: F401
 )
 from . import rules  # noqa: F401  (importing registers every rule)
 from . import conc  # noqa: F401  (registers SGL010-SGL013, conclint)
+from . import proc  # noqa: F401  (registers SGL015/SGL017, proclint)

@@ -9,8 +9,9 @@ Static (explicit paths)::
 
 Full audit (no paths, no mode flags): static rules over the repo's own
 trees (``singa_tpu``, ``tools``), the concurrency thread-model gate
-(conclint, ``tools/lint/conc.py``), AND the compiled-program gates —
-HLO structure (hloaudit) plus cost/memory (hlocost), off ONE shared
+(conclint, ``tools/lint/conc.py``), the process-mesh gate (proclint,
+``tools/lint/proc.py``), AND the compiled-program gates — HLO
+structure (hloaudit) plus cost/memory (hlocost), off ONE shared
 lowering::
 
     python -m tools.lint
@@ -23,6 +24,8 @@ Dynamic audits (same checks the old standalone CLIs ran)::
     python -m tools.lint --hlo --update-baselines # reviewed re-baseline
     python -m tools.lint --conc                   # thread-model gate
     python -m tools.lint --conc --update-baselines  # reviewed re-model
+    python -m tools.lint --proc                   # process-mesh gate
+    python -m tools.lint --proc --update-baselines  # reviewed re-model
     python -m tools.lint --perf PATH              # runtime-attribution
     python -m tools.lint --perf PATH --update-baselines  # sentinel
 
@@ -58,6 +61,11 @@ _AUDIT_MODES = {
             "discovered thread roots + cross-thread attribute table "
             "against tools/lint/data/conc/model.json — also via "
             "--conc (re-baseline with --conc --update-baselines)",
+    "proc": "process-mesh gate (proclint): diff the discovered spawn/"
+            "signal/reap/socket model against tools/lint/data/proc/"
+            "model.json AND cross-check the worker RPC dispatch table "
+            "vs. call sites vs. _OP_TIMEOUTS — also via --proc "
+            "(re-baseline with --proc --update-baselines)",
     "hlo": "compiled-program structural gate: lower the flagship train/"
            "prefill/decode programs and diff fusions, collectives, "
            "donation vs tools/lint/data/hlo/ — also via --hlo (which "
@@ -81,6 +89,7 @@ _DEFAULT_TREES = ("singa_tpu", "tools")
 def _list_rules() -> str:
     from .conc import CONC_GATE_CODES
     from .cost import COST_CODES
+    from .proc import PROC_GATE_CODES
     from .framework import RETIRED_CODES
     from .hlo import HLO_CODES
     lines = ["singalint rules:"]
@@ -97,6 +106,12 @@ def _list_rules() -> str:
                  "baseline, tools/lint/conc.py; re-baseline via "
                  "--conc --update-baselines):")
     for code, (name, desc) in CONC_GATE_CODES.items():
+        lines.append(f"  {code}  {name:<21} {desc}")
+    lines.append("proc gate finding codes (the committed process-model "
+                 "baseline + RPC-protocol cross-check, "
+                 "tools/lint/proc.py; re-baseline via "
+                 "--proc --update-baselines):")
+    for code, (name, desc) in PROC_GATE_CODES.items():
         lines.append(f"  {code}  {name:<21} {desc}")
     lines.append("audit modes (run via their flag, or --select MODE):")
     for mode, desc in _AUDIT_MODES.items():
@@ -153,6 +168,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="run the concurrency thread-model gate "
                              "(conclint) against "
                              "tools/lint/data/conc/model.json")
+    parser.add_argument("--proc", action="store_true",
+                        help="run the process-mesh gate (proclint): "
+                             "spawn/signal/reap/socket model vs "
+                             "tools/lint/data/proc/model.json, plus "
+                             "the RPC-protocol cross-check")
     parser.add_argument("--perf", metavar="PATH", default=None,
                         help="gate a perf_attr payload dump (bench.py "
                              "--serve --perf-attr PATH) against the "
@@ -169,12 +189,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.list_rules:
         print(_list_rules())
         return 0
-    if args.update_baselines and not (args.conc or args.perf):
+    if args.update_baselines and not (args.conc or args.perf
+                                      or args.proc):
         args.hlo = True
     mode_flags = [f for f, on in (("--records", args.records is not None),
                                   ("--ckpt", args.ckpt is not None),
                                   ("--hlo", args.hlo),
                                   ("--conc", args.conc),
+                                  ("--proc", args.proc),
                                   ("--perf", args.perf is not None)) if on]
     if len(mode_flags) > 1:
         parser.error(f"{' and '.join(mode_flags)} are separate audit "
@@ -235,6 +257,18 @@ def main(argv: Optional[List[str]] = None) -> int:
               else render_human(findings).replace("singalint:",
                                                   "conclint:"))
         return 1 if findings else 0
+    if args.proc:
+        from . import proc
+        if args.update_baselines:
+            print(proc.update_model_baseline())
+            print(f"proclint: process-model baseline updated at "
+                  f"{proc.MODEL_PATH} — review the diff above")
+            return 0
+        findings = proc.audit_findings()
+        print(render_json(findings) if args.json
+              else render_human(findings).replace("singalint:",
+                                                  "proclint:"))
+        return 1 if findings else 0
     if args.hlo:
         from .hlo import hlo_main
         try:
@@ -245,14 +279,16 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if not args.paths:
         # the full audit: static rules over the repo trees + the
-        # concurrency thread-model gate (conclint) + the
-        # compiled-program gates (or the --select'ed subset) — the
-        # structure and cost gates always share ONE lowering pass,
-        # and the conc gate reuses the static pass's parse cache
+        # concurrency thread-model gate (conclint) + the process-mesh
+        # gate (proclint) + the compiled-program gates (or the
+        # --select'ed subset) — the structure and cost gates always
+        # share ONE lowering pass, and the conc/proc gates reuse the
+        # static pass's parse cache
         run_static = codes is None or bool(codes)
         run_hlo = not args.select or "hlo" in selected_modes
         run_cost = not args.select or "cost" in selected_modes
         run_conc = not args.select or "conc" in selected_modes
+        run_proc = not args.select or "proc" in selected_modes
         run_records = "records" in selected_modes
         rc = 0
         findings = []
@@ -268,7 +304,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             findings = sorted(
                 findings + conc.gate_findings(),
                 key=lambda f: (f.path, f.line, f.col, f.code))
-        if run_static or run_conc:
+        if run_proc:
+            from . import proc
+            findings = sorted(
+                findings + proc.audit_findings(),
+                key=lambda f: (f.path, f.line, f.col, f.code))
+        if run_static or run_conc or run_proc:
             # with --json AND a gate half, the static findings merge
             # into the gate's single document — stdout must stay ONE
             # parseable JSON object

@@ -1,0 +1,1092 @@
+"""proclint — the process-mesh, resource-lifecycle, and wire-protocol
+audit (ISSUE 20).
+
+PRs 18–19 made the serve tier a real multi-process system — spawned
+worker processes, SIGKILL/SIGSTOP signal traffic, AF_UNIX sockets, a
+hand-rolled framed RPC, env-scrub contracts, respawn/breaker
+supervision — but conclint's committed thread model stops at the
+process boundary.  proclint extends the same "committed baseline +
+named finding + reviewed diff" discipline to the process mesh itself:
+
+1. **a committed process-model baseline** (gate code **SGL019**,
+   ``tools/lint/data/proc/model.json``): every process root
+   (``subprocess.Popen`` / ``multiprocessing.Process`` construction
+   and ``spawn_many`` call sites), every signal send (``os.kill`` with
+   an explicit signal, ``.kill()``/``.terminate()``), every reap site
+   (``.wait()``/``.join()``/fabric-ledger removal), and every
+   socket/socketpair/accept, keyed line-free like conclint's roots.  A
+   kill site with no reap reachable in its function (one self-helper
+   level deep) carries a ``!noreap`` tag, so a kill LOSING its reap is
+   a value change, not silence.  The baseline records a content hash
+   of its own sections, so a hand-edited model.json fails the gate
+   instead of silently redefining "reviewed".
+2. **SGL015 resource-lifecycle**: every socket, spawned process, temp
+   file/dir, and opened sink must have a release reachable on the
+   exception path — a ``with`` block, a ``try/finally``/``except``
+   release, a registered cleanup (``atexit.register`` /
+   ``weakref.finalize``), class ownership with a releasing method, or
+   an escape (returned, stashed in a ledger).  A release that only
+   runs on the straight-line path, or none at all, is a finding —
+   with the conclint-style one-helper-level closure
+   (``self._reap(procs)`` counts when ``_reap`` releases its param).
+3. **SGL016 RPC-protocol conformance**: the worker dispatch table
+   (``_op_*`` methods + inline ``op == "..."`` dispatch), the
+   supervisor/tool/test call sites (``.call({"op": ...})`` /
+   ``.send({"op": ...})``), and the ``_OP_TIMEOUTS`` deadline table
+   must agree EXACTLY — an op handled but never called, called but
+   never handled, or missing a deadline entry is a named finding, as
+   is a codec magic/version literal that differs between the
+   ``encode_*`` and ``decode_*`` sides of a wire codec module.
+4. **SGL017 child-env contract**: ``subprocess.Popen`` must pass an
+   ``env=`` built through a scrub seam that pops ``SINGA_FAULTS``,
+   ``SINGA_FAULTS_SEED`` and ``SINGA_OBS`` (the double-fire chaos bug
+   class PR 18 fixed by convention), and no code outside such a seam
+   may write those vars into an environment mapping.
+
+Scope limits (same contract as conclint, documented in
+docs/static-analysis.md): analysis is AST-level, module-local and
+name-based — no runtime fd tracking, no cross-module dataflow.  An
+env dict mutated through ``env.update(other_mapping)`` is invisible
+(only literal keys are seen); ``multiprocessing.Process`` children
+inherit by fork/spawn and have no ``env=`` seam to check; a resource
+passed as a bare call argument is treated as an ownership transfer.
+The chaos campaigns and ``tests/test_net.py`` cover the runtime half.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .framework import Finding, Rule, register, iter_python_files, \
+    parse_file
+from .conc import _helper_bodies, _load_baseline, _root_file_line, \
+    _scope_name, _sync_vars
+from .rules import (_class_of, _collect_defs, _methods, _self_method,
+                    build_parents, dotted_name, import_map,
+                    module_nodes, resolve)
+
+__all__ = ["discover_model", "gate_findings", "protocol_findings",
+           "audit_findings", "update_model_baseline", "model_hash",
+           "MODEL_PATH", "PROC_SCHEMA", "PROC_GATE_CODES",
+           "DEFAULT_TREES", "PROTOCOL_TREES"]
+
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+#: the committed process-model baseline — the reviewed record of every
+#: spawn site, signal send, reap site, and socket in the audited trees
+MODEL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "data", "proc", "model.json")
+
+#: model format version — bump on incompatible shape changes; a
+#: baseline with another version fails the gate instead of diffing
+#: garbage (same contract as the conc/HLO schemas)
+PROC_SCHEMA = 1
+
+#: the trees the process model covers — the same set the bare full
+#: audit lints (tools/lint/__main__._DEFAULT_TREES)
+DEFAULT_TREES = ("singa_tpu", "tools")
+
+#: the trees the SGL016 protocol cross-check derives CALL SITES from:
+#: tests drive ops (``chaos``) that production code deliberately never
+#: sends, so a worker handler exercised only by the chaos campaign is
+#: protocol surface, not dead code
+PROTOCOL_TREES = ("singa_tpu", "tools", "tests")
+
+#: the model sections, in the order the update diff prints them
+_SECTIONS = ("roots", "signals", "reaps", "sockets")
+
+#: the gate's finding codes, enumerated by --list-rules next to the
+#: conc/HLO/COST families (gate codes, not per-module rules — they
+#: cannot be inline-suppressed; the baseline IS the review mechanism)
+PROC_GATE_CODES = {
+    "SGL016": ("rpc-protocol", "the worker dispatch table (_op_* "
+               "methods + inline op dispatch), the supervisor/tool/"
+               "test call sites, and the _OP_TIMEOUTS deadline table "
+               "must agree exactly — a one-sided op or a missing "
+               "deadline is a named finding, as is codec magic/"
+               "version skew between encode and decode"),
+    "SGL019": ("process-model", "the discovered process roots, signal "
+               "sends, reap sites, and sockets match the committed "
+               "baseline tools/lint/data/proc/model.json — a new "
+               "spawn site, a vanished reap, or a kill losing its "
+               "reap path fails loudly until '--proc "
+               "--update-baselines' is run and the diff reviewed"),
+}
+
+_UPDATE_HINT = ("run 'python -m tools.lint --proc --update-baselines' "
+                "and review the diff it prints")
+
+
+def _enclosing_function(node: ast.AST,
+                        parents: Dict[ast.AST, ast.AST]
+                        ) -> Optional[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        cur = parents.get(cur)
+    return cur
+
+
+def _on_exception_path(node: ast.AST,
+                       parents: Dict[ast.AST, ast.AST],
+                       stop: ast.AST) -> bool:
+    """True when ``node`` sits in a ``finally`` block or an except
+    handler inside ``stop`` — i.e. it still runs when the straight-line
+    path raises."""
+    cur: Optional[ast.AST] = node
+    while cur is not None and cur is not stop:
+        p = parents.get(cur)
+        if isinstance(cur, ast.ExceptHandler):
+            return True
+        if isinstance(p, ast.Try) and \
+                any(cur is s for s in p.finalbody):
+            return True
+        cur = p
+    return False
+
+
+def _mentions(node: ast.AST, name: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == name
+               for n in ast.walk(node))
+
+
+# ---------------------------------------------------------------------------
+# SGL015 proc-resource-lifecycle
+# ---------------------------------------------------------------------------
+
+#: resolved constructor -> what it acquires (the audit's resource set:
+#: exactly the kinds the serve/net process mesh leaks when mishandled)
+_ACQUIRE_CTORS = {
+    "socket.socket": "socket",
+    "socket.socketpair": "socket pair",
+    "socket.create_connection": "socket",
+    "subprocess.Popen": "child process",
+    "multiprocessing.Process": "child process",
+    "tempfile.mkdtemp": "temp dir",
+    "tempfile.mkstemp": "temp file",
+    "tempfile.NamedTemporaryFile": "temp file",
+    "tempfile.TemporaryDirectory": "temp dir",
+    "open": "file handle",
+}
+
+#: method names that release (or reap) the resource they are called on
+_RELEASE_METHODS = frozenset({
+    "close", "kill", "terminate", "wait", "shutdown", "cleanup",
+    "stop", "join", "release", "detach", "unlink",
+})
+
+#: module functions that release a resource passed as an argument
+_RELEASE_FUNCS = frozenset({
+    "os.close", "os.unlink", "os.remove", "os.rmdir", "os.removedirs",
+    "shutil.rmtree",
+})
+
+#: registering a cleanup makes the release exception-safe by contract
+_CLEANUP_REGISTRARS = frozenset({"atexit.register", "weakref.finalize"})
+
+#: receiver methods that stash the resource in a longer-lived owner
+_ESCAPE_STASH_METHODS = frozenset({
+    "append", "extend", "add", "put", "insert", "register",
+    "setdefault", "update",
+})
+
+
+def _recv_base(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return dotted_name(call.func.value)
+    return None
+
+
+def _helper_releases_params(hfn: ast.AST) -> bool:
+    """One helper level of the release closure: the helper's body
+    releases one of its own params — directly, or through a for-loop
+    target iterating a param (``_reap(procs)``: ``for p in procs:
+    p.wait()``) or a ``.values()`` view of one."""
+    if not isinstance(hfn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    aliases = {a.arg for a in hfn.args.args if a.arg != "self"}
+    if not aliases:
+        return False
+    for sub in ast.walk(hfn):
+        if isinstance(sub, ast.For):
+            it = sub.iter
+            base = None
+            if isinstance(it, ast.Call) and \
+                    isinstance(it.func, ast.Attribute):
+                base = dotted_name(it.func.value)
+            else:
+                base = dotted_name(it)
+            if base and base.split(".")[0] in aliases:
+                for el in ([sub.target] if isinstance(sub.target, ast.Name)
+                           else list(getattr(sub.target, "elts", []))):
+                    if isinstance(el, ast.Name):
+                        aliases.add(el.id)
+    for sub in ast.walk(hfn):
+        if not isinstance(sub, ast.Call):
+            continue
+        if isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr in _RELEASE_METHODS:
+            recv = dotted_name(sub.func.value)
+            if recv and recv.split(".")[0] in aliases:
+                return True
+        d = dotted_name(sub.func)
+        if d in _RELEASE_FUNCS and any(
+                isinstance(n, ast.Name) and n.id in aliases
+                for a in sub.args for n in ast.walk(a)):
+            return True
+    return False
+
+
+def _class_releases(cls: ast.ClassDef, attr: str,
+                    imports: Dict[str, str]) -> bool:
+    """Some method of ``cls`` releases ``self.<attr>`` — the class owns
+    the resource and its close()/shutdown() is the lifecycle."""
+    target = f"self.{attr}"
+    for body in _methods(cls).values():
+        for sub in ast.walk(body):
+            if not isinstance(sub, ast.Call):
+                continue
+            if isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr in _RELEASE_METHODS:
+                recv = dotted_name(sub.func.value)
+                if recv and (recv == target or
+                             recv.startswith(target + ".")):
+                    return True
+            full = resolve(sub.func, imports) or ""
+            if full in _RELEASE_FUNCS and any(
+                    (dotted_name(a) or "").startswith(target)
+                    for a in sub.args):
+                return True
+    return False
+
+
+@register
+class ResourceLifecycleRule(Rule):
+    code = "SGL015"
+    name = "proc-resource-lifecycle"
+    description = ("sockets, spawned processes, temp files/dirs, and "
+                   "opened sinks must have a release reachable on the "
+                   "exception path (with block, try/finally, except-"
+                   "path release, registered cleanup, owning-class "
+                   "release method, or an escape to a longer-lived "
+                   "owner) — one helper level deep; a straight-line-"
+                   "only release leaks on the first raise")
+
+    def _local_lifecycle(self, name: str, fn: ast.AST,
+                         parents: Dict[ast.AST, ast.AST],
+                         imports: Dict[str, str],
+                         methods: Dict[str, ast.FunctionDef],
+                         defs: Dict[str, List[ast.FunctionDef]]) -> str:
+        """'exception-safe' | 'escapes' | 'straight-line' | 'none' for
+        a locally-bound resource ``name`` inside ``fn``."""
+        releases: List[Tuple[ast.AST, bool]] = []
+        escapes = False
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Return) and sub.value is not None \
+                    and _mentions(sub.value, name):
+                escapes = True
+            elif isinstance(sub, ast.Assign):
+                if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                       for t in sub.targets) and \
+                        _mentions(sub.value, name):
+                    escapes = True
+            elif isinstance(sub, ast.With):
+                for item in sub.items:
+                    if _mentions(item.context_expr, name):
+                        releases.append((sub, True))
+            elif isinstance(sub, ast.Call):
+                full = resolve(sub.func, imports) or ""
+                argvals = list(sub.args) + \
+                    [kw.value for kw in sub.keywords]
+                recv = _recv_base(sub)
+                if recv and (recv == name or
+                             recv.startswith(name + ".")) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr in _RELEASE_METHODS:
+                    releases.append(
+                        (sub, _on_exception_path(sub, parents, fn)))
+                elif full in _RELEASE_FUNCS and \
+                        any(_mentions(a, name) for a in argvals):
+                    releases.append(
+                        (sub, _on_exception_path(sub, parents, fn)))
+                elif full in _CLEANUP_REGISTRARS and \
+                        any(_mentions(a, name) for a in argvals):
+                    return "exception-safe"
+                elif isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr in _ESCAPE_STASH_METHODS and \
+                        any(_mentions(a, name) for a in sub.args):
+                    escapes = True
+                elif any(_mentions(a, name) for a in sub.args):
+                    for h in _helper_bodies(sub, methods, defs):
+                        if _helper_releases_params(h):
+                            releases.append(
+                                (sub, _on_exception_path(
+                                    sub, parents, fn)))
+                            break
+        if any(safe for _, safe in releases):
+            return "exception-safe"
+        if escapes:
+            return "escapes"
+        return "straight-line" if releases else "none"
+
+    def check(self, tree: ast.Module, src: str,
+              path: str) -> Iterable[Finding]:
+        imports = import_map(tree)
+        parents = build_parents(tree)
+        defs = _collect_defs(tree)
+        for node in module_nodes(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            full = resolve(node.func, imports) or ""
+            kind = _ACQUIRE_CTORS.get(full)
+            if kind is None:
+                continue
+            p = parents.get(node)
+            if isinstance(p, ast.withitem):
+                continue    # context manager owns the release
+            if isinstance(p, (ast.Call, ast.Return, ast.Yield,
+                              ast.Await)):
+                continue    # ownership transferred to callee/caller
+            if isinstance(p, ast.Attribute):
+                gp = parents.get(p)
+                if p.attr in _RELEASE_METHODS and \
+                        isinstance(gp, ast.Call):
+                    continue    # Popen(...).wait() — consumed in place
+                yield self.finding(
+                    path, node,
+                    f"{full}() acquires a {kind} that is dereferenced "
+                    f"without keeping a handle — nothing can release "
+                    f"it; bind it and release it on all paths")
+                continue
+            if isinstance(p, ast.Expr):
+                yield self.finding(
+                    path, node,
+                    f"{full}() result discarded: the {kind} it "
+                    f"acquires can never be released — bind it and "
+                    f"release it on all paths (try/finally or a with "
+                    f"block), or suppress with why the leak is the "
+                    f"design")
+                continue
+            if not isinstance(p, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = p.targets if isinstance(p, ast.Assign) \
+                else [p.target]
+            names: List[str] = []
+            owned_elsewhere = False
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    names.append(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    names.extend(el.id for el in t.elts
+                                 if isinstance(el, ast.Name))
+                elif isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    cls = _class_of(node, parents)
+                    if cls is not None and \
+                            not _class_releases(cls, t.attr, imports):
+                        yield self.finding(
+                            path, node,
+                            f"self.{t.attr} holds a {kind} acquired "
+                            f"here but no method of {cls.name} "
+                            f"releases it — add a close()/shutdown "
+                            f"path, or suppress with why the resource "
+                            f"lives for the process")
+                    owned_elsewhere = True
+                else:
+                    owned_elsewhere = True  # subscript/attr: escapes
+            fn = _enclosing_function(node, parents)
+            if not names or fn is None:
+                # module-level binding: a process-lifetime singleton
+                # (and owned_elsewhere targets were handled above)
+                del owned_elsewhere
+                continue
+            cls = _class_of(node, parents)
+            methods = _methods(cls) if cls is not None else {}
+            for name in names:
+                verdict = self._local_lifecycle(
+                    name, fn, parents, imports, methods, defs)
+                if verdict in ("exception-safe", "escapes"):
+                    continue
+                if verdict == "straight-line":
+                    yield self.finding(
+                        path, node,
+                        f"{kind} '{name}' ({full}()) is released only "
+                        f"on the straight-line path — an exception "
+                        f"between acquire and release leaks it; wrap "
+                        f"the release in try/finally or a with block, "
+                        f"or suppress with why the path cannot raise")
+                else:
+                    yield self.finding(
+                        path, node,
+                        f"{kind} '{name}' ({full}()) is never "
+                        f"released in {getattr(fn, 'name', '<fn>')}() "
+                        f"and does not escape to a longer-lived owner "
+                        f"— release it on all paths, or suppress with "
+                        f"why the leak is bounded")
+
+
+# ---------------------------------------------------------------------------
+# SGL017 proc-env-contract
+# ---------------------------------------------------------------------------
+
+#: the fault/observability vars a spawned child MUST NOT inherit: a
+#: parent fault plan re-firing inside the child is the double-fire
+#: chaos bug class PR 18 fixed by convention (supervisor._child_env)
+_SCRUB_VARS = ("SINGA_FAULTS", "SINGA_FAULTS_SEED", "SINGA_OBS")
+
+
+def _is_scrub_key(value: object) -> bool:
+    return isinstance(value, str) and (
+        value in _SCRUB_VARS or value.startswith("SINGA_FAULTS"))
+
+
+def _env_receiver(expr: ast.AST) -> Optional[str]:
+    """Dotted name of an environment-mapping receiver (``os.environ``,
+    a local ``env`` dict) — the name-based half of the write ban."""
+    d = dotted_name(expr)
+    if d is None:
+        return None
+    leaf = d.split(".")[-1]
+    return d if leaf in ("environ", "env") else None
+
+
+def _scrubbed_in(fn: ast.AST,
+                 recv: Optional[str] = None) -> Set[str]:
+    """Var names popped/deleted in ``fn`` — through the literal form
+    (``env.pop("SINGA_OBS", None)``), the loop form (``for k in
+    ("SINGA_FAULTS", ...): env.pop(k, None)`` — supervisor's actual
+    seam), and ``del env["..."]``.  ``recv`` restricts to one
+    receiver name."""
+    loop_vars: Dict[str, Set[str]] = {}
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.For) and \
+                isinstance(sub.target, ast.Name) and \
+                isinstance(sub.iter, (ast.Tuple, ast.List)):
+            vals = {e.value for e in sub.iter.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)}
+            loop_vars.setdefault(sub.target.id, set()).update(vals)
+    out: Set[str] = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr == "pop" and sub.args:
+            r = dotted_name(sub.func.value)
+            if recv is not None and r != recv:
+                continue
+            a0 = sub.args[0]
+            if isinstance(a0, ast.Constant) and \
+                    isinstance(a0.value, str):
+                out.add(a0.value)
+            elif isinstance(a0, ast.Name) and a0.id in loop_vars:
+                out.update(loop_vars[a0.id])
+        elif isinstance(sub, ast.Delete):
+            for t in sub.targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.slice, ast.Constant) and \
+                        isinstance(t.slice.value, str):
+                    if recv is not None and \
+                            dotted_name(t.value) != recv:
+                        continue
+                    out.add(t.slice.value)
+    return out
+
+
+def _is_scrub_seam(fn: Optional[ast.AST]) -> bool:
+    """The designated seam: a function that pops ALL the scrub vars
+    (``_Fabric._child_env``) may also write fault vars into the env it
+    is building — that is what the seam is FOR."""
+    return fn is not None and set(_SCRUB_VARS) <= _scrubbed_in(fn)
+
+
+@register
+class ChildEnvContractRule(Rule):
+    code = "SGL017"
+    name = "proc-env-contract"
+    description = ("subprocess.Popen must pass env= built through a "
+                   "scrub seam that pops SINGA_FAULTS, "
+                   "SINGA_FAULTS_SEED and SINGA_OBS before the child "
+                   "starts (a parent fault plan double-fires in the "
+                   "child otherwise), and no code outside such a seam "
+                   "may write those vars into an environment mapping")
+
+    def _env_scrubs(self, expr: ast.AST, node: ast.Call,
+                    parents: Dict[ast.AST, ast.AST],
+                    defs: Dict[str, List[ast.FunctionDef]]
+                    ) -> Set[str]:
+        """The scrub-var set provably popped on the way to this
+        ``env=`` value: a helper call (``env=self._child_env()``), or
+        a local name with in-function pops / helper assignment."""
+        if isinstance(expr, ast.Dict):
+            if any(k is None for k in expr.keys):
+                return set()    # **spread: contents unknown
+            # built from scratch — nothing inherited; an explicit
+            # scrub-var key still reads as unscrubbed (the child
+            # receives it)
+            present = {k.value for k in expr.keys
+                       if isinstance(k, ast.Constant)
+                       and _is_scrub_key(k.value)}
+            return set(_SCRUB_VARS) - present
+        if isinstance(expr, ast.Call):
+            if isinstance(expr.func, ast.Name) and \
+                    expr.func.id == "dict" and not expr.args:
+                return set(_SCRUB_VARS)    # dict(K=..): from scratch
+            body = self._callee_body(expr, node, parents, defs)
+            return _scrubbed_in(body) if body is not None else set()
+        if isinstance(expr, ast.Name):
+            fn = _enclosing_function(node, parents)
+            if fn is None:
+                return set()
+            out = _scrubbed_in(fn, recv=expr.id)
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Assign) and \
+                        isinstance(sub.value, ast.Call) and any(
+                            isinstance(t, ast.Name) and t.id == expr.id
+                            for t in sub.targets):
+                    body = self._callee_body(sub.value, node,
+                                             parents, defs)
+                    if body is not None:
+                        out |= _scrubbed_in(body)
+            return out
+        return set()
+
+    def _callee_body(self, call: ast.Call, site: ast.AST,
+                     parents: Dict[ast.AST, ast.AST],
+                     defs: Dict[str, List[ast.FunctionDef]]
+                     ) -> Optional[ast.AST]:
+        m = _self_method(call.func)
+        if m is not None:
+            cls = _class_of(site, parents)
+            if cls is not None:
+                return _methods(cls).get(m)
+            return None
+        if isinstance(call.func, ast.Name) and call.func.id in defs:
+            return defs[call.func.id][0]
+        return None
+
+    def check(self, tree: ast.Module, src: str,
+              path: str) -> Iterable[Finding]:
+        imports = import_map(tree)
+        parents = build_parents(tree)
+        defs = _collect_defs(tree)
+        for node in module_nodes(tree):
+            if isinstance(node, ast.Call):
+                full = resolve(node.func, imports) or ""
+                if full == "subprocess.Popen":
+                    env_kw = next((kw for kw in node.keywords
+                                   if kw.arg == "env"), None)
+                    if env_kw is None or (
+                            isinstance(env_kw.value, ast.Constant)
+                            and env_kw.value.value is None):
+                        yield self.finding(
+                            path, node,
+                            f"subprocess.Popen without a scrubbed "
+                            f"env=: the child inherits the parent's "
+                            f"environment including "
+                            f"{'/'.join(_SCRUB_VARS)}, so a parent "
+                            f"fault plan double-fires in the child — "
+                            f"build env through the scrub seam")
+                        continue
+                    missing = [v for v in _SCRUB_VARS
+                               if v not in self._env_scrubs(
+                                   env_kw.value, node, parents, defs)]
+                    if missing:
+                        yield self.finding(
+                            path, node,
+                            f"child env passed to subprocess.Popen "
+                            f"does not scrub {', '.join(missing)} — "
+                            f"pop them in the env-building seam "
+                            f"before the child starts, or suppress "
+                            f"with why inheritance is safe")
+                elif full == "os.putenv" and node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        _is_scrub_key(node.args[0].value) and \
+                        not _is_scrub_seam(
+                            _enclosing_function(node, parents)):
+                    yield self.finding(
+                        path, node,
+                        f"os.putenv({node.args[0].value!r}, ...) "
+                        f"outside the child-env scrub seam: fault/"
+                        f"obs vars may only be written where all of "
+                        f"{'/'.join(_SCRUB_VARS)} are popped first")
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "setdefault" and \
+                        node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        _is_scrub_key(node.args[0].value) and \
+                        _env_receiver(node.func.value) is not None \
+                        and not _is_scrub_seam(
+                            _enclosing_function(node, parents)):
+                    yield self.finding(
+                        path, node,
+                        f"writes {node.args[0].value} into "
+                        f"{_env_receiver(node.func.value)} outside "
+                        f"the child-env scrub seam — the designated "
+                        f"seam (which pops {'/'.join(_SCRUB_VARS)}) "
+                        f"is the only place fault/obs vars may be "
+                        f"set")
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) and \
+                            isinstance(t.slice, ast.Constant) and \
+                            _is_scrub_key(t.slice.value) and \
+                            _env_receiver(t.value) is not None and \
+                            not _is_scrub_seam(
+                                _enclosing_function(node, parents)):
+                        yield self.finding(
+                            path, node,
+                            f"writes {t.slice.value} into "
+                            f"{_env_receiver(t.value)} outside the "
+                            f"child-env scrub seam — the designated "
+                            f"seam (which pops "
+                            f"{'/'.join(_SCRUB_VARS)}) is the only "
+                            f"place fault/obs vars may be set")
+
+
+# ---------------------------------------------------------------------------
+# SGL016 rpc-protocol conformance (a cross-file audit, not a per-module
+# rule: the dispatch table, the call sites, and the deadline table live
+# in different files — and the call-site scan includes tests/)
+# ---------------------------------------------------------------------------
+
+def _dict_op(d: ast.Dict) -> Optional[str]:
+    for k, v in zip(d.keys, d.values):
+        if isinstance(k, ast.Constant) and k.value == "op" and \
+                isinstance(v, ast.Constant) and \
+                isinstance(v.value, str):
+            return v.value
+    return None
+
+
+def _codec_findings(path: str, tree: ast.Module) -> List[Finding]:
+    """Magic/version literal skew between a wire codec's encode and
+    decode sides (modules defining both an ``encode_*`` and a
+    ``decode_*`` top-level function)."""
+    fns = [n for n in tree.body
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    enc = [f for f in fns if f.name.startswith("encode")]
+    dec = [f for f in fns if f.name.startswith("decode")]
+    if not enc or not dec:
+        return []
+    bytes_consts: Dict[str, bytes] = {}
+    version_consts: Dict[str, int] = {}
+    for n in tree.body:
+        if isinstance(n, ast.Assign) and \
+                isinstance(n.value, ast.Constant):
+            for t in n.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if isinstance(n.value.value, bytes):
+                    bytes_consts[t.id] = n.value.value
+                elif isinstance(n.value.value, int) and \
+                        "VERSION" in t.id.upper():
+                    version_consts[t.id] = n.value.value
+
+    def magics(side: List[ast.AST]) -> Set[bytes]:
+        out: Set[bytes] = set()
+        for f in side:
+            for sub in ast.walk(f):
+                if isinstance(sub, ast.Constant) and \
+                        isinstance(sub.value, bytes) and sub.value:
+                    out.add(sub.value)
+                elif isinstance(sub, ast.Name) and \
+                        sub.id in bytes_consts:
+                    out.add(bytes_consts[sub.id])
+        return out
+
+    def versions(side: List[ast.AST]) -> Set[int]:
+        out: Set[int] = set()
+        for f in side:
+            for sub in ast.walk(f):
+                if isinstance(sub, ast.Name) and \
+                        sub.id in version_consts:
+                    out.add(version_consts[sub.id])
+                elif isinstance(sub, ast.Compare):
+                    sides = [sub.left] + list(sub.comparators)
+                    named = any("version" in (dotted_name(s) or "")
+                                .lower() for s in sides)
+                    if named:
+                        out.update(
+                            s.value for s in sides
+                            if isinstance(s, ast.Constant)
+                            and isinstance(s.value, int))
+        return out
+
+    findings: List[Finding] = []
+    em, dm = magics(enc), magics(dec)
+    if em and dm and not (em & dm):
+        findings.append(Finding(
+            path, dec[0].lineno, dec[0].col_offset, "SGL016",
+            f"codec magic skew: encode writes {sorted(em)} but decode "
+            f"accepts {sorted(dm)} — every frame one side produces, "
+            f"the other rejects; share one module-level constant"))
+    ev, dv = versions(enc), versions(dec)
+    if ev and dv and not (ev & dv):
+        findings.append(Finding(
+            path, dec[0].lineno, dec[0].col_offset, "SGL016",
+            f"codec wire-version skew: encode stamps {sorted(ev)} but "
+            f"decode accepts {sorted(dv)} — every frame one side "
+            f"produces, the other rejects; share one module-level "
+            f"constant"))
+    return findings
+
+
+def protocol_findings(paths: Optional[Iterable[str]] = None,
+                      root: Optional[str] = None) -> List[Finding]:
+    """The SGL016 cross-check: worker dispatch vs. call sites vs. the
+    deadline table, plus per-module codec magic/version skew.  [] when
+    the three views of the protocol agree exactly (or no worker
+    dispatch table exists in the scanned trees)."""
+    root = root or _REPO_ROOT
+    if paths is None:
+        paths = [os.path.join(root, t) for t in PROTOCOL_TREES
+                 if os.path.isdir(os.path.join(root, t))]
+    handled: Dict[str, Tuple[str, ast.AST]] = {}
+    called: Dict[str, Tuple[str, ast.AST]] = {}
+    timeouts: Dict[str, Tuple[str, ast.AST]] = {}
+    timeout_anchor: Optional[Tuple[str, ast.AST]] = None
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        parsed = parse_file(path)
+        if parsed is None:
+            continue
+        tree, _src = parsed
+        findings.extend(_codec_findings(path, tree))
+        worker_classes = [
+            n for n in module_nodes(tree) if isinstance(n, ast.ClassDef)
+            and sum(m.startswith("_op_") for m in _methods(n)) >= 2]
+        for cls in worker_classes:
+            for m, fn in _methods(cls).items():
+                if m.startswith("_op_"):
+                    handled.setdefault(m[len("_op_"):], (path, fn))
+        for node in module_nodes(tree):
+            if worker_classes and isinstance(node, ast.Compare) and \
+                    isinstance(node.left, ast.Name) and \
+                    node.left.id == "op":
+                # inline dispatch (`if op == "shutdown": ...`)
+                for comp in node.comparators:
+                    if isinstance(comp, ast.Constant) and \
+                            isinstance(comp.value, str):
+                        handled.setdefault(comp.value, (path, node))
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("call", "send") and \
+                    node.args and isinstance(node.args[0], ast.Dict):
+                op = _dict_op(node.args[0])
+                if op is not None:
+                    called.setdefault(op, (path, node))
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Dict) and any(
+                        isinstance(t, ast.Name) and
+                        t.id == "_OP_TIMEOUTS" for t in node.targets):
+                timeout_anchor = (path, node)
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str):
+                        timeouts.setdefault(k.value, (path, node))
+    if not handled:
+        return sorted(findings,
+                      key=lambda f: (f.path, f.line, f.message))
+    for op in sorted(set(handled) - set(called)):
+        p, n = handled[op]
+        findings.append(Finding(
+            p, n.lineno, n.col_offset, "SGL016",
+            f"RPC op '{op}' is handled by the worker dispatch table "
+            f"but never sent by any supervisor/tool/test call site — "
+            f"dead protocol surface; remove the handler or add the "
+            f"caller"))
+    for op in sorted(set(called) - set(handled)):
+        p, n = called[op]
+        findings.append(Finding(
+            p, n.lineno, n.col_offset, "SGL016",
+            f"RPC op '{op}' is sent at this call site but no worker "
+            f"handler (_op_{op} or inline dispatch) exists — the "
+            f"worker answers it with an unknown-op error at runtime"))
+    if timeout_anchor is not None:
+        tp, tn = timeout_anchor
+        for op in sorted(set(handled) - set(timeouts)):
+            findings.append(Finding(
+                tp, tn.lineno, tn.col_offset, "SGL016",
+                f"RPC op '{op}' has no _OP_TIMEOUTS deadline entry — "
+                f"a hung worker turns that call into an unbounded "
+                f"stall; add a deadline row"))
+        for op in sorted(set(timeouts) - set(handled)):
+            findings.append(Finding(
+                tp, tn.lineno, tn.col_offset, "SGL016",
+                f"_OP_TIMEOUTS entry '{op}' names an op no worker "
+                f"handles — stale deadline row; remove it or restore "
+                f"the handler"))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.message))
+
+
+# ---------------------------------------------------------------------------
+# process-model discovery (the SGL019 baseline's content)
+# ---------------------------------------------------------------------------
+
+def _has_reap(fn: ast.AST, sync: Dict[str, str],
+              methods: Dict[str, ast.FunctionDef],
+              defs: Dict[str, List[ast.FunctionDef]]) -> bool:
+    """A reap (``.wait()``/``.join()``) is reachable inside ``fn`` —
+    directly, or one self-helper/local-def level down (``_reap()``)."""
+
+    def direct(body: ast.AST) -> bool:
+        for sub in ast.walk(body):
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr in ("wait", "join"):
+                recv = dotted_name(sub.func.value)
+                if recv is None or recv in sync:
+                    continue
+                if sub.func.attr == "join" and sub.args:
+                    continue    # str.join / os.path.join
+                return True
+        return False
+
+    if direct(fn):
+        return True
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call):
+            for h in _helper_bodies(sub, methods, defs):
+                if direct(h):
+                    return True
+    return False
+
+
+def _module_proc(tree: ast.Module,
+                 relpath: str) -> Dict[str, Dict[str, str]]:
+    """The four model sections for one parsed module.  Keys are
+    ``<relpath>::<scope>`` — file + enclosing scope (dotted through
+    closures, so the respawner's ``ProcRouter._respawn.respawn`` is
+    distinct) — deliberately line-free so the baseline survives
+    unrelated edits; multiple facts in one scope join with ``+``."""
+    imports = import_map(tree)
+    parents = build_parents(tree)
+    defs = _collect_defs(tree)
+    sync = _sync_vars(tree, imports)
+    sec: Dict[str, Dict[str, Set[str]]] = {s: {} for s in _SECTIONS}
+
+    def add(section: str, node: ast.AST, tag: str) -> None:
+        key = f"{relpath}::{_scope_name(node, parents)}"
+        sec[section].setdefault(key, set()).add(tag)
+
+    def kill_tag(node: ast.AST, sig: str) -> str:
+        fn = _enclosing_function(node, parents)
+        if fn is None:
+            return f"{sig}!noreap"
+        cls = _class_of(node, parents)
+        methods = _methods(cls) if cls is not None else {}
+        return sig if _has_reap(fn, sync, methods, defs) \
+            else f"{sig}!noreap"
+
+    for node in module_nodes(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        full = resolve(node.func, imports) or ""
+        attr = node.func.attr \
+            if isinstance(node.func, ast.Attribute) else None
+        recv = _recv_base(node)
+        if full == "subprocess.Popen":
+            add("roots", node, "popen")
+        elif full.rsplit(".", 1)[-1] == "Process" and \
+                "multiprocessing" in full:
+            add("roots", node, "mp-process")
+        elif attr == "spawn_many":
+            add("roots", node, "spawn-call")
+        elif full == "os.kill":
+            sig = "SIG?"
+            if len(node.args) >= 2:
+                d = dotted_name(node.args[1]) or ""
+                if d.rsplit(".", 1)[-1].startswith("SIG"):
+                    sig = d.rsplit(".", 1)[-1]
+            add("signals", node, kill_tag(node, sig))
+        elif attr == "kill" and recv is not None and recv != "os":
+            add("signals", node, kill_tag(node, "SIGKILL"))
+        elif attr == "terminate" and recv is not None:
+            add("signals", node, kill_tag(node, "SIGTERM"))
+        elif attr == "wait" and recv is not None and \
+                recv not in sync:
+            add("reaps", node, "wait")
+        elif attr == "join" and not node.args and \
+                recv is not None and recv not in sync:
+            add("reaps", node, "join")
+        elif attr in ("remove", "pop") and recv is not None and \
+                "procs" in recv.split("."):
+            add("reaps", node, "ledger")
+        elif full == "socket.socket":
+            add("sockets", node, "socket")
+        elif full == "socket.socketpair":
+            add("sockets", node, "socketpair")
+        elif attr == "accept" and not node.args:
+            add("sockets", node, "accept")
+    return {s: {k: "+".join(sorted(v)) for k, v in sec[s].items()}
+            for s in _SECTIONS}
+
+
+def model_hash(model: Dict) -> str:
+    """Content hash of the model's sections — recorded in the baseline
+    header so a hand-edited model.json fails the gate loudly and the
+    ``--update-baselines`` diff stays the only write path."""
+    payload = json.dumps({s: model.get(s, {}) for s in _SECTIONS},
+                         sort_keys=True, separators=(",", ":"))
+    return hashlib.blake2b(payload.encode(),
+                           digest_size=8).hexdigest()
+
+
+def discover_model(paths: Optional[Iterable[str]] = None,
+                   root: Optional[str] = None) -> Dict:
+    """The tree's process model: every spawn site, signal send, reap
+    site, and socket with its scope key.  Uses the framework parse
+    cache, so in a bare full audit (where the static rules already
+    parsed everything) discovery re-parses nothing."""
+    root = root or _REPO_ROOT
+    if paths is None:
+        paths = [os.path.join(root, t) for t in DEFAULT_TREES]
+    sections: Dict[str, Dict[str, str]] = {s: {} for s in _SECTIONS}
+    for path in iter_python_files(paths):
+        parsed = parse_file(path)
+        if parsed is None:
+            continue
+        tree, _src = parsed
+        rel = os.path.relpath(path, start=root).replace(os.sep, "/")
+        mod = _module_proc(tree, rel)
+        for s in _SECTIONS:
+            sections[s].update(mod[s])
+    model: Dict = {"schema": PROC_SCHEMA}
+    for s in _SECTIONS:
+        model[s] = dict(sorted(sections[s].items()))
+    model["hash"] = model_hash(model)
+    return model
+
+
+# ---------------------------------------------------------------------------
+# the baseline gate (SGL019) + the reviewed-update flow
+# ---------------------------------------------------------------------------
+
+#: per-section diff wording: (label, why a NEW entry needs review, why
+#: a VANISHED entry needs review)
+_SECTION_WORDING = {
+    "roots": ("process root",
+              "a new spawn site needs human review: check its reap "
+              "path and child-env scrub",
+              "removed or renamed spawn site (or a discovery "
+              "regression)"),
+    "signals": ("signal send",
+                "a new kill/terminate path needs human review: "
+                "'!noreap' means no reap is reachable from it",
+                "removed or renamed kill site"),
+    "reaps": ("reap site",
+              "a new reap path should correspond to a spawn or kill "
+              "that needs it",
+              "a spawn or kill whose reap vanished leaks zombie "
+              "processes"),
+    "sockets": ("socket site",
+                "a new socket/accept path widens the wire surface",
+                "removed or renamed socket site"),
+}
+
+
+def gate_findings(model: Optional[Dict] = None,
+                  baseline_path: Optional[str] = None,
+                  paths: Optional[Iterable[str]] = None,
+                  root: Optional[str] = None) -> List[Finding]:
+    """Diff the discovered process model against the committed
+    baseline; [] = the mesh is exactly what was last reviewed."""
+    baseline_path = baseline_path or MODEL_PATH
+    if model is None:
+        model = discover_model(paths, root=root)
+    base, err = _load_baseline(baseline_path)
+    if base is None:
+        what = "no committed process-model baseline" \
+            if err == "missing" \
+            else f"unreadable process-model baseline ({err})"
+        return [Finding(baseline_path, 1, 0, "SGL019",
+                        f"{what} — every spawn, signal, reap, and "
+                        f"socket site must be a reviewed baseline "
+                        f"entry; {_UPDATE_HINT}")]
+    if base.get("schema") != model.get("schema"):
+        return [Finding(baseline_path, 1, 0, "SGL019",
+                        f"process-model baseline schema "
+                        f"{base.get('schema')!r} does not match the "
+                        f"auditor's {model.get('schema')!r} — "
+                        f"{_UPDATE_HINT}")]
+    if base.get("hash") != model_hash(base):
+        return [Finding(baseline_path, 1, 0, "SGL019",
+                        f"process-model baseline hash "
+                        f"{base.get('hash')!r} does not match its own "
+                        f"sections — the committed model.json was "
+                        f"hand-edited; the reviewed-diff flow is the "
+                        f"only write path: {_UPDATE_HINT}")]
+    findings: List[Finding] = []
+    for s in _SECTIONS:
+        label, why_new, why_gone = _SECTION_WORDING[s]
+        bsec, msec = base.get(s, {}), model[s]
+        for key in sorted(set(msec) - set(bsec)):
+            f, line = _root_file_line(key)
+            findings.append(Finding(
+                f, line, 0, "SGL019",
+                f"NEW {label} {key} ({msec[key]}) is not in the "
+                f"committed process model — {why_new}, then "
+                f"{_UPDATE_HINT}"))
+        for key in sorted(set(bsec) - set(msec)):
+            findings.append(Finding(
+                baseline_path, 1, 0, "SGL019",
+                f"{label} {key} ({bsec[key]}) is in the committed "
+                f"model but was not discovered — {why_gone}; "
+                f"{_UPDATE_HINT}"))
+        for key in sorted(set(bsec) & set(msec)):
+            if bsec[key] != msec[key]:
+                f, line = _root_file_line(key)
+                findings.append(Finding(
+                    f, line, 0, "SGL019",
+                    f"{label} {key} changed: {bsec[key]} -> "
+                    f"{msec[key]} — a reap or signal appearing or "
+                    f"vanishing on a process path is exactly what "
+                    f"needs review; {_UPDATE_HINT}"))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.message))
+
+
+def audit_findings(root: Optional[str] = None) -> List[Finding]:
+    """Everything the ``--proc`` mode gates: the SGL019 model diff
+    plus the SGL016 protocol cross-check."""
+    out = gate_findings(root=root) + protocol_findings(root=root)
+    return sorted(out, key=lambda f: (f.path, f.line, f.message))
+
+
+def update_model_baseline(model: Optional[Dict] = None,
+                          baseline_path: Optional[str] = None,
+                          paths: Optional[Iterable[str]] = None,
+                          root: Optional[str] = None) -> str:
+    """Write the discovered model (hash included) as the new committed
+    baseline and return the human-readable diff — the reviewed
+    artifact of an intentional process-mesh change (same flow as the
+    conc/HLO baselines)."""
+    baseline_path = baseline_path or MODEL_PATH
+    if model is None:
+        model = discover_model(paths, root=root)
+    base, _err = _load_baseline(baseline_path)
+    base = base or {}
+    lines: List[str] = []
+    for s in _SECTIONS:
+        label = s[:-1]    # roots -> root, signals -> signal, ...
+        bsec, msec = base.get(s, {}), model[s]
+        for key in sorted(set(msec) - set(bsec)):
+            lines.append(f"+ {label} {key}: {msec[key]}")
+        for key in sorted(set(bsec) - set(msec)):
+            lines.append(f"- {label} {key}: {bsec[key]}")
+        for key in sorted(set(bsec) & set(msec)):
+            if bsec[key] != msec[key]:
+                lines.append(f"~ {label} {key}: {bsec[key]} -> "
+                             f"{msec[key]}")
+    if not lines:
+        lines.append("process model unchanged")
+    os.makedirs(os.path.dirname(baseline_path), exist_ok=True)
+    with open(baseline_path, "w", encoding="utf-8") as f:
+        json.dump(model, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return "\n".join(lines)
